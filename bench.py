@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Benchmark driver entry: one JSON line to stdout.
+
+Round-1 metric: BASELINE config 1 (fluid MNIST LeNet, static ProgramDesc,
+single chip) — examples/sec through the full Executor train step (feed,
+jitted forward+backward+adam, fetch). The reference publishes no numbers
+(BASELINE.md), so vs_baseline is the ratio against the first measured value
+recorded here once hardware numbers exist.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_lenet(batch=256, steps=30, warmup=5):
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import Executor, framework, optimizer, unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    from paddle_tpu.models import build_lenet_program
+
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup, feeds, fetches = build_lenet_program()
+        with framework.program_guard(main, startup):
+            opt = optimizer.Adam(learning_rate=1e-3)
+            opt.minimize(fetches["loss"])
+    scope = Scope()
+    with scope_guard(scope):
+        exe = Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img = rng.randn(batch, 1, 28, 28).astype("float32")
+        lab = rng.randint(0, 10, (batch, 1)).astype("int64")
+        for _ in range(warmup):
+            exe.run(main, feed={"img": img, "label": lab},
+                    fetch_list=[fetches["loss"]])
+        import jax
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = exe.run(main, feed={"img": img, "label": lab},
+                          fetch_list=[fetches["loss"]], return_numpy=False)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+    paddle.disable_static()
+    return batch * steps / dt
+
+
+def main():
+    eps = bench_lenet()
+    print(json.dumps({
+        "metric": "mnist_lenet_static_train_examples_per_sec",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

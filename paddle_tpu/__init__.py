@@ -1,0 +1,67 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle (~v2.0-beta "Fluid" era, reference at /root/reference).
+
+Architecture (see SURVEY.md §7):
+  * static-graph-first: Python builds a Program IR; the Executor lowers whole
+    blocks to single jitted XLA computations (no per-op interpreter loop)
+  * imperative (dygraph) mode: eager Tensors on jax arrays + tape autograd,
+    sharing the same op registry
+  * distribution: jax.sharding Mesh + XLA collectives over ICI/DCN behind the
+    fleet / paddle.distributed API surface
+
+Top-level namespace mirrors `import paddle` of the reference 2.0 API.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import fluid
+from .fluid import (CPUPlace, TPUPlace, CUDAPlace, ParamAttr, Program,
+                    get_flags, set_flags)
+from .fluid.core import Place
+from .fluid.dygraph import (guard, no_grad, to_variable, enable_dygraph,
+                            disable_dygraph, grad)
+from .fluid.dygraph.varbase import Tensor
+from .fluid.framework import in_dygraph_mode
+
+# 2.0-style namespaces
+from . import tensor
+from .tensor import *  # noqa: F401,F403
+from . import nn
+from . import static
+from . import optimizer
+from . import metric
+from . import io
+from . import distributed
+from . import amp
+from . import vision
+from . import text
+from . import jit
+from . import incubate
+from . import utils
+from . import models
+from . import ops as _pallas_ops  # pallas kernels register themselves
+
+from .tensor.creation import to_tensor
+from .framework_api import (get_default_dtype, set_default_dtype, seed,
+                            save, load, set_device, get_device, DataParallel,
+                            set_grad_enabled, is_grad_enabled, summary, flops)
+
+# dygraph is the default mode for the 2.0 API surface, like the reference
+enable_dygraph()
+
+
+def disable_static(place=None):
+    enable_dygraph()
+
+
+def enable_static():
+    disable_dygraph()
+
+
+def in_dynamic_mode():
+    return in_dygraph_mode()
+
+
+# commonly used aliases at top level (reference python/paddle/__init__.py)
+version = __version__

@@ -1,0 +1,11 @@
+"""paddle.amp — automatic mixed precision.
+
+TPU-native AMP = bfloat16 (no loss scaling needed for bf16; fp16 path keeps
+the dynamic loss-scale state machine for parity — reference
+contrib/mixed_precision/decorator.py:27 + dygraph/amp/*).
+"""
+from .auto_cast import auto_cast, amp_guard, white_list, black_list
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler",
+           "white_list", "black_list"]

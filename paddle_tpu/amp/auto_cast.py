@@ -1,0 +1,104 @@
+"""Trace-time autocast (reference imperative/amp_auto_cast.cc +
+dygraph/amp/auto_cast.py:90 amp_guard).
+
+In eager mode the tracer consults these lists per op and casts float inputs:
+white-list ops run in bf16 (MXU-friendly), black-list ops stay fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..fluid import framework
+
+# ops that benefit from bf16 on the MXU (reference fp16_lists.py white list)
+white_list = {
+    "matmul", "matmul_v2", "mul", "bmm", "conv2d", "depthwise_conv2d",
+    "fc", "addmm",
+}
+# numerically sensitive ops kept in fp32 (reference black list)
+black_list = {
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "mean",
+    "reduce_mean", "reduce_sum", "sum", "exp", "log", "square", "sqrt",
+    "rsqrt", "p_norm", "squared_l2_norm",
+}
+
+_AMP_DTYPE = {"O1": jnp.bfloat16, "O2": jnp.bfloat16}
+
+
+def _autocast_inputs(op_type, in_tensors, level):
+    from ..fluid.dygraph.varbase import Tensor
+    if level == 0:
+        return in_tensors
+    target = None
+    if op_type in white_list:
+        target = jnp.bfloat16
+    elif op_type in black_list:
+        target = jnp.float32
+    elif level == 2:  # O2: everything except black list in bf16
+        target = jnp.bfloat16
+    if target is None:
+        return in_tensors
+    out = {}
+    for slot, lst in in_tensors.items():
+        res = []
+        for t in lst:
+            if t is not None and hasattr(t, "_value") and \
+                    jnp.issubdtype(t._value.dtype, jnp.floating) and \
+                    t._value.dtype != target:
+                nt = Tensor(t._value.astype(target),
+                            stop_gradient=t.stop_gradient)
+                nt._producer = t._producer
+                # keep autograd linkage: casting for compute only
+                res.append(_CastView(t, nt))
+            else:
+                res.append(t)
+        out[slot] = res
+    return out
+
+
+class _CastView:
+    """Tensor proxy that computes in the cast dtype but routes gradients to
+    the original tensor (grad flows through the cast transparently because
+    the tape stores the ORIGINAL tensor object)."""
+
+    def __init__(self, orig, cast):
+        self._orig = orig
+        self._cast = cast
+
+    @property
+    def _value(self):
+        return self._cast._value
+
+    @property
+    def stop_gradient(self):
+        return self._orig.stop_gradient
+
+    def __getattr__(self, k):
+        return getattr(self._orig, k)
+
+
+@contextlib.contextmanager
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1"):
+    tr = framework._dygraph_tracer()
+    if tr is None:
+        yield
+        return
+    added_w = set(custom_white_list or []) - white_list
+    added_b = set(custom_black_list or []) - black_list
+    white_list.update(added_w)
+    black_list.update(added_b)
+    prev = tr._amp_level
+    tr._amp_level = (1 if level == "O1" else 2) if enable else 0
+    try:
+        yield
+    finally:
+        tr._amp_level = prev
+        white_list.difference_update(added_w)
+        black_list.difference_update(added_b)
+
+
+auto_cast = amp_guard

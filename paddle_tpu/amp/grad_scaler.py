@@ -1,0 +1,99 @@
+"""Dynamic loss scaling (reference dygraph/amp/loss_scaler.py:27 AmpScaler).
+
+bf16 training doesn't need scaling (exponent range matches fp32), so with the
+default bf16 policy this is a near-no-op that still tracks found_inf for
+parity; fp16 users get the full state machine.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def minimize(self, optimizer, scaled_loss, *args, **kwargs):
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step() if hasattr(optimizer, "step") else \
+                optimizer.minimize(scaled_loss)
+        self._update()
+
+    def step(self, optimizer):
+        self._unscale(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        self._update()
+
+    def unscale_(self, optimizer):
+        self._unscale(optimizer)
+
+    def _unscale(self, optimizer):
+        if not self._enable:
+            self._found_inf = False
+            return
+        import jax.numpy as jnp
+        params = getattr(optimizer, "_parameters", None) or []
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value / self._scale
+            found = found or not bool(jnp.all(jnp.isfinite(g)))
+            p.grad._set_value(g)
+        self._found_inf = found
+
+    def _update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good = sd.get("good", 0)
+        self._bad = sd.get("bad", 0)
+
+
+class GradScaler(AmpScaler):
+    """2.0 name (paddle.amp.GradScaler)."""

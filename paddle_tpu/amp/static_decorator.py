@@ -1,0 +1,94 @@
+"""Static-graph AMP decorator (reference contrib/mixed_precision/decorator.py:27
+OptimizerWithMixedPrecision).
+
+TPU-native design: instead of inserting cast ops per black/white list into the
+Program (the reference's rewrite_program), the executor traces the forward in
+a bf16 compute policy — matmuls/convs run bf16 on the MXU, reductions stay
+fp32 — by setting per-op dtype hints; dynamic loss scaling uses the
+check_finite_and_unscale / update_loss_scaling ops (operators/amp/).
+Round-1 scope: bf16 policy flag on the program + loss-scaling ops wired for
+fp16 parity.
+"""
+from __future__ import annotations
+
+from ..fluid import layers
+from ..fluid.layer_helper import LayerHelper
+
+__all__ = ["decorate_static", "OptimizerWithMixedPrecision"]
+
+
+class OptimizerWithMixedPrecision:
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.0**15,
+                 use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.5,
+                 use_pure_bf16=True):
+        self._optimizer = optimizer
+        self._init_loss_scaling = init_loss_scaling
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._use_pure_bf16 = use_pure_bf16
+
+    def __getattr__(self, k):
+        return getattr(self._optimizer, k)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        program._amp_policy = "bf16" if self._use_pure_bf16 else "fp16"
+        params_grads = self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        if not self._use_pure_bf16 and self._use_dynamic:
+            params_grads = self._scale_and_check(params_grads)
+        ops = self._optimizer.apply_gradients(params_grads)
+        return ops, params_grads
+
+    def _scale_and_check(self, params_grads):
+        helper = LayerHelper("amp_scaling")
+        scale = helper.create_global_variable(
+            shape=[1], dtype="float32", persistable=True,
+            value=self._init_loss_scaling)
+        good = helper.create_global_variable(
+            shape=[1], dtype="int32", persistable=True, value=0.0)
+        bad = helper.create_global_variable(
+            shape=[1], dtype="int32", persistable=True, value=0.0)
+        grads = [g for _, g in params_grads]
+        found = helper.create_variable_for_type_inference("bool", True)
+        unscaled = [helper.create_variable_for_type_inference(g.dtype)
+                    for g in grads]
+        helper.append_op(
+            type="check_finite_and_unscale",
+            inputs={"X": grads, "Scale": [scale]},
+            outputs={"Out": unscaled, "FoundInfinite": [found]})
+        outs = [helper.create_variable_for_type_inference(g.dtype)
+                for g in grads]
+        helper.append_op(
+            type="update_loss_scaling",
+            inputs={"X": unscaled, "FoundInfinite": [found],
+                    "PrevLossScaling": [scale], "InGoodSteps": [good],
+                    "InBadSteps": [bad]},
+            outputs={"Out": outs, "LossScaling": [scale.name],
+                     "OutGoodSteps": [good.name], "OutBadSteps": [bad.name]},
+            attrs={"incr_every_n_steps": self._incr_every,
+                   "decr_every_n_nan_or_inf": self._decr_every,
+                   "incr_ratio": self._incr_ratio,
+                   "decr_ratio": self._decr_ratio})
+        return [(p, o) for (p, _), o in zip(params_grads, outs)]
+
+
+def decorate_static(optimizer, amp_configs: dict):
+    return OptimizerWithMixedPrecision(
+        optimizer,
+        init_loss_scaling=amp_configs.get("init_loss_scaling", 2.0**15),
+        use_dynamic_loss_scaling=amp_configs.get(
+            "use_dynamic_loss_scaling", True),
+        incr_every_n_steps=amp_configs.get("incr_every_n_steps", 1000),
+        decr_every_n_nan_or_inf=amp_configs.get("decr_every_n_nan_or_inf", 2),
+        incr_ratio=amp_configs.get("incr_ratio", 2.0),
+        decr_ratio=amp_configs.get("decr_ratio", 0.5),
+        use_pure_bf16=amp_configs.get("use_pure_bf16", True))
+
+
+decorate = decorate_static

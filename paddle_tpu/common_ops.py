@@ -1,0 +1,76 @@
+"""Dual-mode op invocation for the 2.0 functional API.
+
+In dygraph mode ops execute eagerly through the Tracer (the reference's
+generated `core.ops.*` fast path, pybind/op_function_generator.cc); in static
+mode they append ops to the current Program via LayerHelper.
+"""
+from __future__ import annotations
+
+from .fluid import framework
+from .fluid.framework import in_dygraph_mode
+from .fluid.layer_helper import LayerHelper
+
+__all__ = ["run_op", "run_op_multi"]
+
+
+def run_op(op_type: str, inputs: dict, attrs: dict | None = None,
+           out_slot: str = "Out", out_dtype=None, extra_outs: tuple = (),
+           stop_gradient: bool = False):
+    """Run/append one op, returning the tensor of `out_slot`.
+
+    extra_outs: additional output slots to allocate (and discard) in static
+    mode — e.g. reshape2's XShape.
+    """
+    attrs = attrs or {}
+    if in_dygraph_mode():
+        tr = framework._dygraph_tracer()
+        res = tr.trace_op(op_type, inputs, {}, attrs,
+                          stop_gradient=stop_gradient)
+        return res[out_slot][0]
+    helper = LayerHelper(op_type)
+    dtype = out_dtype
+    if dtype is None:
+        for lst in inputs.values():
+            if lst:
+                v0 = lst[0] if isinstance(lst, (list, tuple)) else lst
+                dtype = getattr(v0, "dtype", None)
+                if dtype:
+                    break
+    out = helper.create_variable_for_type_inference(dtype or "float32")
+    outputs = {out_slot: [out]}
+    for slot in extra_outs:
+        outputs[slot] = [helper.create_variable_for_type_inference(
+            dtype or "float32", True)]
+    helper.append_op(type=op_type, inputs=_norm(inputs), outputs=outputs,
+                     attrs=attrs)
+    return out
+
+
+def run_op_multi(op_type: str, inputs: dict, attrs: dict | None = None,
+                 out_slots: dict | None = None, stop_gradient: bool = False):
+    """Run/append one op with several output slots.
+
+    out_slots: slot -> number of outputs (or dtype string for single output).
+    Returns dict slot -> list of tensors.
+    """
+    attrs = attrs or {}
+    if in_dygraph_mode():
+        tr = framework._dygraph_tracer()
+        return tr.trace_op(op_type, inputs, {}, attrs,
+                           stop_gradient=stop_gradient)
+    helper = LayerHelper(op_type)
+    outputs = {}
+    for slot, spec in (out_slots or {}).items():
+        if isinstance(spec, int):
+            outputs[slot] = [helper.create_variable_for_type_inference()
+                             for _ in range(spec)]
+        else:
+            outputs[slot] = [helper.create_variable_for_type_inference(spec)]
+    helper.append_op(type=op_type, inputs=_norm(inputs), outputs=outputs,
+                     attrs=attrs)
+    return outputs
+
+
+def _norm(inputs: dict) -> dict:
+    return {k: (v if isinstance(v, (list, tuple)) else [v])
+            for k, v in inputs.items() if v is not None}

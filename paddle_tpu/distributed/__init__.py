@@ -1,0 +1,22 @@
+"""paddle.distributed — mesh-collective distribution layer.
+
+Replaces the reference's NCCL/Gloo/gRPC triple stack (SURVEY §5) with XLA
+collectives over jax.sharding Mesh axes. Filled out across:
+  env.py         — rank/world bootstrap (jax.distributed)
+  collective.py  — all_reduce/all_gather/... API parity
+  mesh.py        — global device mesh management
+  fleet/         — fleet 2.0 facade + DistributedStrategy
+  parallel.py    — init_parallel_env / DataParallel
+"""
+from .env import (get_rank, get_world_size, init_parallel_env, ParallelEnv)
+from .mesh import (get_mesh, set_mesh, default_mesh)
+from .collective import (all_reduce, all_gather, broadcast, reduce, scatter,
+                         barrier, split, ReduceOp)
+from .parallel import DataParallel
+from . import fleet
+from .spawn import spawn
+
+__all__ = ["get_rank", "get_world_size", "init_parallel_env", "ParallelEnv",
+           "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+           "barrier", "split", "ReduceOp", "fleet", "DataParallel", "spawn",
+           "get_mesh", "set_mesh", "default_mesh"]

@@ -1,0 +1,267 @@
+"""Collective communication API (reference python/paddle/distributed/collective.py:59-419).
+
+Replaces c_allreduce_*/c_broadcast/... NCCL ops (operators/collective/) with
+XLA collectives. Two regimes:
+  * inside a sharded computation (shard_map/pjit trace): ops lower to
+    lax.psum/all_gather/ppermute over a named mesh axis — this is the ICI
+    fast path used by the static executor and fleet;
+  * eager cross-process: jax.experimental.multihost_utils (DCN) for the
+    dygraph API-parity path.
+Also registers the c_* op types so transpiled Programs keep working.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..fluid.registry import register, same_shape_as
+from ..fluid.ops.common import x, out
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "broadcast", "reduce",
+           "scatter", "barrier", "split", "current_axis"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+# name of the mesh axis collectives act on while tracing a sharded program;
+# set by the executor / shard_map wrappers (replaces ring_id)
+_axis_stack: list[str] = []
+
+
+def current_axis() -> str | None:
+    return _axis_stack[-1] if _axis_stack else None
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def collective_axis(name: str):
+    _axis_stack.append(name)
+    try:
+        yield
+    finally:
+        _axis_stack.pop()
+
+
+def _eager_value(t):
+    return t._value if hasattr(t, "_value") else t
+
+
+def _wrap_like(t, val):
+    from ..fluid.dygraph.varbase import Tensor
+    if hasattr(t, "_value"):
+        if isinstance(t, Tensor):
+            t._set_value(val)
+            return t
+    return val
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place all-reduce across processes (dygraph) or axis (traced)."""
+    ax = current_axis()
+    val = _eager_value(tensor)
+    if ax is not None:
+        fn = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+              ReduceOp.MIN: jax.lax.pmin}.get(op)
+        if fn is None:
+            raise NotImplementedError("PROD allreduce on mesh")
+        return _wrap_like(tensor, fn(val, ax))
+    if jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(val)
+    red = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+           ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod}[op]
+    return _wrap_like(tensor, red(gathered, axis=0))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    ax = current_axis()
+    val = _eager_value(tensor)
+    if ax is not None:
+        g = jax.lax.all_gather(val, ax)
+        parts = [g[i] for i in range(g.shape[0])]
+    elif jax.process_count() == 1:
+        parts = [val]
+    else:
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(val)
+        parts = [g[i] for i in range(g.shape[0])]
+    from ..fluid.dygraph.varbase import Tensor
+    tensor_list.extend(Tensor(p, stop_gradient=True) for p in parts)
+    return tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = current_axis()
+    val = _eager_value(tensor)
+    if ax is not None:
+        idx = jax.lax.axis_index(ax)
+        src_val = jax.lax.psum(
+            jnp.where(idx == src, val, jnp.zeros_like(val)), ax)
+        return _wrap_like(tensor, src_val)
+    if jax.process_count() == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+    return _wrap_like(tensor,
+                      multihost_utils.broadcast_one_to_all(
+                          val, jax.process_index() == src))
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if jax.process_count() == 1 and current_axis() is None:
+        if tensor_list:
+            return _wrap_like(tensor, _eager_value(tensor_list[0]))
+        return tensor
+    raise NotImplementedError("scatter across processes lands with fleet PS")
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def split(x_, num_partitions, axis=0):
+    from .. import tensor as T
+    return T.split(x_, num_partitions, axis)
+
+
+# ---------------------------------------------------------------------------
+# c_* collective OPS for static programs (operators/collective/ parity).
+# In a mesh-sharded execution these trace to axis collectives; in single
+# process single-shard execution they are identities.
+# ---------------------------------------------------------------------------
+
+def _c_allreduce(fn):
+    def compute(ctx, ins, attrs):
+        v = x(ins)
+        ax = attrs.get("axis_name") or current_axis() or \
+            (getattr(ctx, "mesh_axis", None))
+        if ax:
+            return out(fn(v, ax))
+        return out(v)
+    return compute
+
+
+register("c_allreduce_sum", _c_allreduce(jax.lax.psum),
+         infer_shape=same_shape_as("X"),
+         attrs={"ring_id": 0, "use_calc_stream": True, "axis_name": ""})
+register("c_allreduce_max", _c_allreduce(jax.lax.pmax),
+         infer_shape=same_shape_as("X"),
+         attrs={"ring_id": 0, "use_calc_stream": True, "axis_name": ""})
+register("c_allreduce_min", _c_allreduce(jax.lax.pmin),
+         infer_shape=same_shape_as("X"),
+         attrs={"ring_id": 0, "use_calc_stream": True, "axis_name": ""})
+
+
+@register("c_allgather", attrs={"ring_id": 0, "nranks": 1,
+                                "use_calc_stream": True, "axis_name": ""})
+def _c_allgather(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis_name") or current_axis()
+    if ax:
+        g = jax.lax.all_gather(v, ax)
+        return out(g.reshape((-1,) + v.shape[1:]))
+    return out(v)
+
+
+@register("c_broadcast", attrs={"ring_id": 0, "root": 0,
+                                "use_calc_stream": True, "axis_name": ""})
+def _c_broadcast(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis_name") or current_axis()
+    if ax:
+        idx = jax.lax.axis_index(ax)
+        return out(jax.lax.psum(
+            jnp.where(idx == attrs.get("root", 0), v, jnp.zeros_like(v)), ax))
+    return out(v)
+
+
+@register("c_reducescatter", attrs={"ring_id": 0, "nranks": 1,
+                                    "use_calc_stream": True, "axis_name": ""})
+def _c_reducescatter(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis_name") or current_axis()
+    if ax:
+        return out(jax.lax.psum_scatter(v, ax, tiled=True))
+    return out(v)
+
+
+@register("c_concat", attrs={"ring_id": 0, "nranks": 1, "rank": 0,
+                             "axis_name": ""})
+def _c_concat(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis_name") or current_axis()
+    if ax:
+        g = jax.lax.all_gather(v, ax)
+        return out(jnp.concatenate(
+            [g[i] for i in range(g.shape[0])], axis=-1))
+    return out(v)
+
+
+@register("c_identity", infer_shape=same_shape_as("X"),
+          attrs={"ring_id": 0, "use_calc_stream": True})
+def _c_identity(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("c_split", attrs={"ring_id": 0, "nranks": 1, "rank": 0,
+                            "axis_name": ""})
+def _c_split(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis_name") or current_axis()
+    n = attrs.get("nranks", 1)
+    if ax:
+        idx = jax.lax.axis_index(ax)
+        size = v.shape[-1] // n
+        return out(jax.lax.dynamic_slice_in_dim(v, idx * size, size, -1))
+    return out(v)
+
+
+@register("c_sync_calc_stream", grad=None, infer_shape=same_shape_as("X"))
+def _c_sync_calc(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("c_sync_comm_stream", grad=None, infer_shape=same_shape_as("X"))
+def _c_sync_comm(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("c_comm_init_all", grad=None, attrs={"ring_id": 0, "devices": []})
+def _c_comm_init_all(ctx, ins, attrs):
+    return {}  # comm setup is XLA's job; kept for program parity
+
+
+@register("c_gen_nccl_id", grad=None, attrs={"rank": 0})
+def _c_gen_nccl_id(ctx, ins, attrs):
+    return {}  # obsolete under jax.distributed bootstrap
+
+
+@register("c_comm_init", grad=None, attrs={"ring_id": 0, "rank": 0,
+                                           "nranks": 1})
+def _c_comm_init(ctx, ins, attrs):
+    return {}
+
+
+@register("c_wait_calc_stream", grad=None, infer_shape=same_shape_as("X"))
+def _c_wait_calc(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("barrier", grad=None)
+def _barrier_op(ctx, ins, attrs):
+    return out(x(ins)) if ins.get("X") else {}

@@ -1,0 +1,79 @@
+"""Process/world bootstrap.
+
+Replaces the reference's env-var parsing + NCCL-id TCP dance
+(imperative/nccl_context.cc:21-49, c_gen_nccl_id_op.cc) with
+jax.distributed.initialize: the coordinator handles rendezvous, XLA handles
+comm setup over ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """reference distributed/parallel.py:32. Under a fleetrun-style launcher
+    PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM (or JAX coordinator env) select the
+    process identity; single-process multi-device needs no init."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_COORDINATOR",
+                           os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """reference fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()
+
+    @property
+    def dev_id(self) -> int:
+        return 0
+
+    @property
+    def device_count(self) -> int:
+        return jax.local_device_count()
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self) -> list[str]:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:6170"]

@@ -1,0 +1,22 @@
+"""fleet 2.0 facade (reference python/paddle/distributed/fleet/).
+
+`fleet.init` + `DistributedStrategy` + `distributed_optimizer` — the strategy
+bag selects meta-optimizers (amp/recompute/gradient-merge/...) which rewrite
+the Program or wrap the optimizer, and the collective runtime maps data
+parallelism onto the device mesh.
+"""
+from .base.distributed_strategy import DistributedStrategy
+from .base.fleet_base import (Fleet, init, is_first_worker, worker_index,
+                              worker_num, is_worker, worker_endpoints,
+                              server_num, server_index, server_endpoints,
+                              is_server, barrier_worker, init_worker,
+                              init_server, run_server, stop_worker,
+                              distributed_optimizer, minimize)
+from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker, Role
+
+__all__ = ["DistributedStrategy", "init", "is_first_worker", "worker_index",
+           "worker_num", "is_worker", "worker_endpoints", "server_num",
+           "server_index", "server_endpoints", "is_server", "barrier_worker",
+           "init_worker", "init_server", "run_server", "stop_worker",
+           "distributed_optimizer", "minimize", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "Role", "Fleet"]

@@ -1,0 +1,165 @@
+"""Fleet facade (reference distributed/fleet/base/fleet_base.py:125,572,937).
+
+fleet.init → role discovery + mesh setup; distributed_optimizer wraps the
+user optimizer with the meta-optimizer stack chosen from DistributedStrategy
+(reference base/strategy_compiler.py); minimize rewrites the program for the
+selected parallelism and returns ops the TPU executor understands.
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+
+__all__ = ["Fleet", "init", "distributed_optimizer", "minimize"]
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: RoleMakerBase | None = None
+        self._strategy: DistributedStrategy | None = None
+        self._user_optimizer = None
+        self._is_collective = True
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        from ...env import init_parallel_env
+        self._is_collective = is_collective or role_maker is None
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=True)
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        return self
+
+    def is_first_worker(self):
+        return self._rm().is_first_worker()
+
+    def worker_index(self):
+        return self._rm().worker_index()
+
+    def worker_num(self):
+        return self._rm().worker_num()
+
+    def is_worker(self):
+        return self._rm().is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._rm().get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._rm().server_num()
+
+    def server_index(self):
+        return self._rm().server_index()
+
+    def server_endpoints(self, to_string=False):
+        eps = self._rm().get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._rm().is_server()
+
+    def barrier_worker(self):
+        self._rm()._barrier()
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        from ..runtime.parameter_server_runtime import ParameterServerRuntime
+        self._ps_runtime = ParameterServerRuntime(self._rm())
+        self._ps_runtime.init_server(*args)
+
+    def run_server(self):
+        self._ps_runtime.run_server()
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ....fluid import io
+        return io.save_inference_model(dirname, feeded_var_names,
+                                       target_vars, executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from ....fluid import io
+        return io.save_persistables(executor, dirname, main_program)
+
+    # -- optimization --------------------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._user_optimizer = optimizer
+        if strategy is not None:
+            self._strategy = strategy
+        return self
+
+    def distributed_model(self, model):
+        from ...parallel import DataParallel
+        return DataParallel(model)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from ..meta_optimizers import apply_meta_optimizers
+        opt = apply_meta_optimizers(self._user_optimizer, self._strategy,
+                                    self._rm())
+        res = opt.minimize(loss, startup_program, parameter_list, no_grad_set)
+        loss.block.program._sharding_info = _sharding_info_from_strategy(
+            self._strategy)
+        return res
+
+    @property
+    def user_defined_optimizer(self):
+        return self._user_optimizer
+
+    def _rm(self) -> RoleMakerBase:
+        if self._role_maker is None:
+            self.init()
+        return self._role_maker
+
+    # dygraph helpers
+    def get_loss_scaling(self):
+        return None
+
+
+def _sharding_info_from_strategy(strategy: DistributedStrategy) -> dict:
+    info = {"mode": "dp"}
+    if strategy.tensor_parallel:
+        info["tp"] = strategy.tensor_parallel_configs[
+            "tensor_parallel_degree"]
+    if strategy.pipeline:
+        info["pp"] = strategy.pipeline_configs
+    if strategy.sequence_parallel:
+        info["sp"] = strategy.sequence_parallel_configs["sp_degree"]
+    return info
+
+
+_fleet = Fleet()
+
+
+def init(role_maker=None, is_collective=False, strategy=None):
+    return _fleet.init(role_maker, is_collective, strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return _fleet.distributed_optimizer(optimizer, strategy)
+
+
+def minimize(loss, **kw):
+    return _fleet.minimize(loss, **kw)
+
+
+is_first_worker = _fleet.is_first_worker
+worker_index = _fleet.worker_index
+worker_num = _fleet.worker_num
+is_worker = _fleet.is_worker
+worker_endpoints = _fleet.worker_endpoints
+server_num = _fleet.server_num
+server_index = _fleet.server_index
+server_endpoints = _fleet.server_endpoints
+is_server = _fleet.is_server
+barrier_worker = _fleet.barrier_worker
+init_worker = _fleet.init_worker
+init_server = _fleet.init_server
+run_server = _fleet.run_server
+stop_worker = _fleet.stop_worker

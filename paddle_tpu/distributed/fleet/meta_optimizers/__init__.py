@@ -1,0 +1,32 @@
+"""Meta-optimizer stack (reference distributed/fleet/meta_optimizers/ +
+base/strategy_compiler.py:41).
+
+Each meta-optimizer wraps the inner optimizer when its strategy flag is on;
+compatible ones compose (amp ∘ recompute ∘ gradient_merge ∘ base)."""
+from __future__ import annotations
+
+__all__ = ["apply_meta_optimizers"]
+
+
+def apply_meta_optimizers(optimizer, strategy, role_maker):
+    from ....fluid import optimizer as fopt
+    opt = optimizer
+    if strategy is None:
+        return opt
+    if getattr(opt, "_static_optimizer", None):
+        opt = opt._static_optimizer()  # unwrap 2.0 wrapper to fluid opt
+    if strategy.lamb and hasattr(opt, "_learning_rate"):
+        cfg = strategy.lamb_configs
+        opt = fopt.LambOptimizer(
+            learning_rate=opt._learning_rate,
+            lamb_weight_decay=cfg["lamb_weight_decay"])
+    if strategy.recompute:
+        opt = fopt.RecomputeOptimizer(opt)
+        opt._set_checkpoints(strategy.recompute_configs.get("checkpoints"))
+    if strategy.gradient_merge:
+        cfg = strategy.gradient_merge_configs
+        opt = fopt.GradientMergeOptimizer(opt, cfg["k_steps"], cfg["avg"])
+    if strategy.amp:
+        from ....amp.static_decorator import decorate_static
+        opt = decorate_static(opt, strategy.amp_configs)
+    return opt

@@ -1,0 +1,82 @@
+"""Parameter-server runtime (reference distributed/fleet/runtime/parameter_server_runtime.py).
+
+TPU-native PS tier: a host-resident sharded KV store served over DCN for the
+sparse-embedding workload (PaddleRec configs). The dense path should instead
+use mesh-sharded embeddings + all_to_all (paddle_tpu.parallel.embedding).
+Round-1 scope: single-host in-process KV; the RPC transport lands with the
+C++ runtime batch.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ParameterServerRuntime", "LargeScaleKV"]
+
+
+class LargeScaleKV:
+    """In-memory sharded sparse table (reference operators/distributed/large_scale_kv.h)."""
+
+    def __init__(self, dim: int, init_std: float = 0.01, shards: int = 8):
+        self.dim = dim
+        self.init_std = init_std
+        self.shards = [dict() for _ in range(shards)]
+
+    def _shard(self, key: int) -> dict:
+        return self.shards[key % len(self.shards)]
+
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        out = np.empty((len(keys), self.dim), dtype=np.float32)
+        for i, k in enumerate(keys.tolist()):
+            s = self._shard(k)
+            row = s.get(k)
+            if row is None:
+                row = np.random.normal(
+                    0, self.init_std, self.dim).astype(np.float32)
+                s[k] = row
+            out[i] = row
+        return out
+
+    def push(self, keys: np.ndarray, grads: np.ndarray, lr: float = 1.0):
+        for k, g in zip(keys.tolist(), grads):
+            s = self._shard(k)
+            row = s.get(k)
+            if row is None:
+                row = np.random.normal(
+                    0, self.init_std, self.dim).astype(np.float32)
+            s[k] = row - lr * g
+
+    def size(self) -> int:
+        return sum(len(s) for s in self.shards)
+
+    def save(self, path: str):
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump(self.shards, f, protocol=4)
+
+    def load(self, path: str):
+        import pickle
+        with open(path, "rb") as f:
+            self.shards = pickle.load(f)
+
+
+class ParameterServerRuntime:
+    def __init__(self, role_maker):
+        self._role_maker = role_maker
+        self._tables: dict[str, LargeScaleKV] = {}
+
+    def init_server(self, *args):
+        pass
+
+    def run_server(self):
+        pass
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+    def get_table(self, name: str, dim: int) -> LargeScaleKV:
+        if name not in self._tables:
+            self._tables[name] = LargeScaleKV(dim)
+        return self._tables[name]

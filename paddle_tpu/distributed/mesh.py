@@ -1,0 +1,48 @@
+"""Global device-mesh management.
+
+The mesh replaces the reference's ring_id/comm registry
+(platform/collective_helper.h:62): collectives name mesh AXES instead of
+rings; XLA routes them over ICI (intra-slice) / DCN (inter-slice).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["default_mesh", "get_mesh", "set_mesh", "make_mesh"]
+
+_mesh: Mesh | None = None
+
+
+def make_mesh(axes: dict[str, int] | None = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; sizes must multiply to the device
+    count (-1 allowed once as wildcard)."""
+    devs = np.array(jax.devices())
+    if not axes:
+        return Mesh(devs.reshape(-1), ("dp",))
+    names = tuple(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    return Mesh(devs.reshape(sizes), names)
+
+
+def default_mesh() -> Mesh:
+    global _mesh
+    if _mesh is None:
+        _mesh = make_mesh(None)
+    return _mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _mesh
+
+
+def set_mesh(mesh: Mesh | dict | None):
+    global _mesh
+    _mesh = make_mesh(mesh) if isinstance(mesh, dict) or mesh is None \
+        else mesh
+    return _mesh

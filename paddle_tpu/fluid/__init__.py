@@ -1,0 +1,34 @@
+"""paddle_tpu.fluid — core framework layer (reference python/paddle/fluid/).
+
+Static-graph-first TPU-native framework core: Program IR, tracing Executor
+that lowers blocks to single XLA computations, graph-level autodiff, layers,
+optimizers. See SURVEY.md §7 for the design mapping.
+"""
+from . import core, framework, layers, initializer, regularizer, clip, \
+    unique_name, io
+from . import ops as _ops  # registers all built-in ops
+from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+                   get_flags, set_flags)
+from .executor import Executor, global_scope, scope_guard
+from .framework import (Program, Variable, default_main_program,
+                        default_startup_program, program_guard, name_scope,
+                        device_guard, in_dygraph_mode)
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr
+from .initializer import (Constant, Uniform, Normal, TruncatedNormal, Xavier,
+                          MSRA, NumpyArrayInitializer)
+from . import optimizer
+from .scope import Scope
+from . import dygraph
+from .dygraph.base import enable_dygraph, disable_dygraph, enabled
+from .data_feeder import DataFeeder
+
+__all__ = [
+    "core", "framework", "layers", "initializer", "regularizer", "clip",
+    "optimizer", "io", "CPUPlace", "TPUPlace", "CUDAPlace", "Executor",
+    "Program", "Variable", "default_main_program", "default_startup_program",
+    "program_guard", "append_backward", "gradients", "ParamAttr",
+    "global_scope", "scope_guard", "Scope", "unique_name", "dygraph",
+    "name_scope", "device_guard", "in_dygraph_mode", "get_flags", "set_flags",
+    "DataFeeder", "enable_dygraph", "disable_dygraph",
+]

@@ -1,0 +1,82 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+from . import layers
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class GradientClipBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(GradientClipBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        res = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                res.append((p, g))
+                continue
+            res.append((p, layers.clip(g, self.min, self.max)))
+        return res
+
+
+class GradientClipByNorm(GradientClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        res = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                res.append((p, g))
+                continue
+            res.append((p, layers.clip_by_norm(g, self.clip_norm)))
+        return res
+
+
+class GradientClipByGlobalNorm(GradientClipBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        helper = LayerHelper("global_norm_clip")
+        sq_sums = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            helper.append_op(type="squared_l2_norm", inputs={"X": [g]},
+                             outputs={"Out": [sq]})
+            sq_sums.append(sq)
+        if not sq_sums:
+            return params_grads
+        total = layers.sums(sq_sums) if len(sq_sums) > 1 else sq_sums[0]
+        global_norm = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="sqrt", inputs={"X": [total]},
+                         outputs={"Out": [global_norm]})
+        max_norm = layers.fill_constant([1], "float32", self.clip_norm)
+        denom = layers.elementwise_max(global_norm, max_norm)
+        scale = layers.elementwise_div(max_norm, denom)
+        res = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                res.append((p, g))
+                continue
+            res.append((p, layers.elementwise_mul(g, scale)))
+        return res
+
+
+# 2.0 aliases
+ClipGradByValue = GradientClipByValue
+ClipGradByNorm = GradientClipByNorm
+ClipGradByGlobalNorm = GradientClipByGlobalNorm

@@ -1,0 +1,47 @@
+"""DataFeeder (reference python/paddle/fluid/data_feeder.py): converts
+per-sample python/numpy data into a feed dict of batched arrays."""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import Variable
+
+__all__ = ["DataFeeder", "convert_dtype", "check_variable_and_dtype"]
+
+from .core import convert_dtype
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name):
+    return True
+
+
+def check_type(input, input_name, expected_type, op_name):
+    return True
+
+
+def check_dtype(dtype, name, expected, op_name):
+    return True
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                from .framework import default_main_program
+                v = (program or default_main_program()) \
+                    .global_block()._var_recursive(v)
+            self.feed_vars.append(v)
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            col = [np.asarray(r[i]) for r in rows]
+            arr = np.stack(col).astype(convert_dtype(var.dtype))
+            declared = var.shape
+            if declared is not None and len(declared) == arr.ndim + 1 and \
+                    declared[-1] == 1:
+                arr = arr[..., None]
+            out[var.name] = arr
+        return out

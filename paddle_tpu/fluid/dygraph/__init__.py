@@ -1,0 +1,12 @@
+"""Imperative (dygraph) mode (reference python/paddle/fluid/dygraph/)."""
+from . import base, layers, tracer, varbase
+from .base import (guard, enable_dygraph, disable_dygraph, enabled,
+                   to_variable, no_grad, grad)
+from .layers import Layer
+from .varbase import Tensor
+from .math_op_patch import monkey_patch_math
+
+monkey_patch_math()
+
+__all__ = ["guard", "enable_dygraph", "disable_dygraph", "enabled",
+           "to_variable", "no_grad", "grad", "Layer", "Tensor"]

@@ -1,0 +1,152 @@
+"""Dygraph mode switches + helpers (reference python/paddle/fluid/dygraph/base.py)."""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import numpy as np
+
+from .. import framework
+from .tracer import Tracer, no_grad_guard
+from .varbase import Tensor, to_tensor_value
+
+__all__ = ["guard", "enable_dygraph", "disable_dygraph", "enabled",
+           "to_variable", "no_grad", "grad"]
+
+
+def enabled() -> bool:
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    framework._dygraph_tracer_ = framework._dygraph_tracer_ or Tracer()
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    tracer = Tracer()
+    with framework._dygraph_guard(tracer):
+        yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    if isinstance(value, Tensor):
+        return value
+    return Tensor(to_tensor_value(value, dtype), name=name,
+                  stop_gradient=True)
+
+
+class no_grad:
+    """Both decorator and context manager (reference dygraph/base.py no_grad)."""
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad_guard():
+                return fn(*args, **kwargs)
+        return wrapper
+
+    def __enter__(self):
+        self._cm = no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def _create_eager_param(name, shape, dtype, attr, is_bias):
+    """Parameter creation in dygraph mode (used by LayerHelper)."""
+    import jax
+    import jax.numpy as jnp
+    from ..initializer import (ConstantInitializer, XavierInitializer,
+                               NormalInitializer, UniformInitializer,
+                               TruncatedNormalInitializer,
+                               NumpyArrayInitializer, MSRAInitializer)
+    init = attr.initializer or (ConstantInitializer(0.0) if is_bias
+                                else XavierInitializer())
+    key = jax.random.PRNGKey(np.random.randint(0, 2**31))
+    shape = [int(s) for s in shape]
+
+    class _FakeVar:
+        pass
+
+    fv = _FakeVar()
+    fv.shape = tuple(shape)
+    fv.dtype = dtype
+
+    if isinstance(init, ConstantInitializer):
+        val = jnp.full(shape, init.value, dtype=dtype)
+    elif isinstance(init, UniformInitializer):
+        val = jax.random.uniform(key, shape, minval=init.low,
+                                 maxval=init.high).astype(dtype)
+    elif isinstance(init, NormalInitializer):
+        val = (jax.random.normal(key, shape) * init.scale +
+               init.loc).astype(dtype)
+    elif isinstance(init, TruncatedNormalInitializer):
+        val = (jax.random.truncated_normal(key, -2., 2., shape) * init.scale +
+               init.loc).astype(dtype)
+    elif isinstance(init, (XavierInitializer, MSRAInitializer)):
+        fi, fo = init._fan_in_out(fv)
+        import math
+        if isinstance(init, XavierInitializer):
+            fi = init.fan_in if init.fan_in is not None else fi
+            fo = init.fan_out if init.fan_out is not None else fo
+            if init.uniform:
+                lim = math.sqrt(6.0 / (fi + fo))
+                val = jax.random.uniform(key, shape, minval=-lim,
+                                         maxval=lim).astype(dtype)
+            else:
+                val = (jax.random.normal(key, shape) *
+                       math.sqrt(2.0 / (fi + fo))).astype(dtype)
+        else:
+            fi = init.fan_in if init.fan_in is not None else fi
+            if init.uniform:
+                lim = math.sqrt(6.0 / fi)
+                val = jax.random.uniform(key, shape, minval=-lim,
+                                         maxval=lim).astype(dtype)
+            else:
+                val = (jax.random.normal(key, shape) *
+                       math.sqrt(2.0 / fi)).astype(dtype)
+    elif isinstance(init, NumpyArrayInitializer):
+        val = jnp.asarray(init.value).astype(dtype)
+    else:
+        val = jnp.zeros(shape, dtype=dtype)
+    t = Tensor(val, name=name, stop_gradient=not attr.trainable,
+               persistable=True, trainable=attr.trainable)
+    t.optimize_attr = {"learning_rate": attr.learning_rate}
+    t.regularizer = attr.regularizer
+    t.need_clip = attr.need_clip
+    t.is_parameter = True
+    return t
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad for dygraph (reference imperative/partial_grad_engine.cc).
+    First-order only in this build."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # save existing .grad, run backward, read, restore
+    from .tracer import run_backward
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    targets = {id(t) for t in inputs}
+    for o, go in zip(outputs, grad_outputs or [None] * len(outputs)):
+        run_backward(o, go, retain_graph=True if retain_graph is None
+                     else retain_graph, targets=targets)
+    res = []
+    for t in inputs:
+        if t.grad is None and not allow_unused:
+            res.append(None)
+        else:
+            res.append(None if t.grad is None else
+                       Tensor(t.grad._value, stop_gradient=True))
+    for t, g in saved:
+        t.grad = g
+    return res

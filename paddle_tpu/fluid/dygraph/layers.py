@@ -1,0 +1,261 @@
+"""Layer — the module system (reference python/paddle/fluid/dygraph/layers.py:678).
+
+Parameter/sublayer/buffer registries, hooks, state_dict, train/eval modes.
+Works in both eager mode (parameters are eager Tensors) and under the
+static-graph builders (paddle.nn reuses this class)."""
+from __future__ import annotations
+
+import collections
+from typing import Iterator
+
+import numpy as np
+
+from .. import framework, unique_name
+from ..framework import in_dygraph_mode
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from .varbase import Tensor
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, idx):
+        self._hooks, self._idx = hooks, idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or type(self).__name__.lower())
+        self._dtype = dtype
+        self.training = True
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        self.training = True
+        tr = framework._dygraph_tracer()
+        if tr is not None:
+            tr.train_mode = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        tr = framework._dygraph_tracer()
+        if tr is not None:
+            tr.train_mode = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- registration ------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Tensor) and getattr(value, "is_parameter", False):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, framework.Parameter):
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if layers is not None and name in layers:
+                del layers[name]
+            if buffers is not None and name in buffers:
+                del buffers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for d in ("_parameters", "_sub_layers", "_buffers"):
+            dd = self.__dict__.get(d)
+            if dd is not None and name in dd:
+                return dd[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None:
+            self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        helper = LayerHelper(self._full_name)
+        return helper.create_parameter(
+            attr if attr is not None else ParamAttr(), shape,
+            dtype or self._dtype, is_bias, default_initializer)
+
+    # -- traversal ---------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                for n, p in l.named_parameters(sub_prefix, True):
+                    if id(p) not in seen:
+                        seen.add(id(p))
+                        yield n, p
+
+    def sublayers(self, include_self=False):
+        res = [self] if include_self else []
+        for l in self._sub_layers.values():
+            res.append(l)
+            res.extend(l.sublayers())
+        return res
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if include_self:
+            yield prefix, self
+        for name, l in self._sub_layers.items():
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, l
+            yield from l.named_sublayers(p)
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, l in self._sub_layers.items():
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from l.named_buffers(sub_prefix, True)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        idx = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[idx] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, idx)
+
+    def register_forward_post_hook(self, hook):
+        idx = len(self._forward_post_hooks)
+        self._forward_post_hooks[idx] = hook
+        return HookRemoveHelper(self._forward_post_hooks, idx)
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix=""):
+        dest = destination if destination is not None \
+            else collections.OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = self._param_numpy(p)
+        for name, b in self.named_buffers():
+            if name.split(".")[-1] not in self._non_persistable_buffer_names:
+                dest[structured_name_prefix + name] = self._param_numpy(b)
+        return dest
+
+    @staticmethod
+    def _param_numpy(p):
+        if isinstance(p, Tensor):
+            return p.numpy()
+        from ..executor import global_scope
+        v = global_scope().find_var(p.name)
+        return None if v is None else np.asarray(v)
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        import jax.numpy as jnp
+        mapping = dict(self.named_parameters())
+        for name, b in self.named_buffers():
+            mapping.setdefault(name, b)
+        missing = []
+        for k, v in state_dict.items():
+            p = mapping.get(k)
+            if p is None:
+                missing.append(k)
+                continue
+            if isinstance(p, Tensor):
+                p._set_value(jnp.asarray(v))
+            else:
+                from ..executor import global_scope
+                global_scope().set(p.name, jnp.asarray(v))
+        return missing
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            if isinstance(p, Tensor):
+                p.clear_gradient()
+
+    def to(self, device=None, dtype=None, blocking=None):
+        return self
+
+    def astype(self, dtype):
+        import jax.numpy as jnp
+        for p in self.parameters():
+            if isinstance(p, Tensor):
+                p._set_value(p._value.astype(dtype))
+        return self

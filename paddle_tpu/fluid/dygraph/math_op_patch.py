@@ -1,0 +1,66 @@
+"""Operator overloads for eager Tensor (reference dygraph/math_op_patch.py).
+
+Each Python operator traces the matching elementwise op so autograd works.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tracer import default_tracer, trace_single
+from .varbase import Tensor, to_tensor_value
+
+
+def _to_tensor(other, like: Tensor):
+    if isinstance(other, Tensor):
+        return other
+    import jax.numpy as jnp
+    arr = jnp.asarray(np.asarray(other, dtype=like.dtype))
+    return Tensor(arr, stop_gradient=True)
+
+
+def _binary(op_type, reverse=False):
+    def fn(self: Tensor, other):
+        other = _to_tensor(other, self)
+        a, b = (other, self) if reverse else (self, other)
+        if default_tracer() is None:
+            from .. import registry
+            opdef = registry.require(op_type)
+            from .tracer import _EagerCtx
+            import jax
+            ctx = _EagerCtx(jax.random.PRNGKey(0))
+            res = opdef.compute(ctx, {"X": [a._value], "Y": [b._value]},
+                                dict(opdef.attrs))
+            return Tensor(res["Out"][0], stop_gradient=True)
+        return trace_single(op_type, {"X": [a], "Y": [b]}, {"axis": -1})
+    return fn
+
+
+def _unary(op_type, attrs=None):
+    def fn(self: Tensor):
+        return trace_single(op_type, {"X": [self]}, attrs or {})
+    return fn
+
+
+def monkey_patch_math():
+    T = Tensor
+    T.__add__ = _binary("elementwise_add")
+    T.__radd__ = _binary("elementwise_add", reverse=True)
+    T.__sub__ = _binary("elementwise_sub")
+    T.__rsub__ = _binary("elementwise_sub", reverse=True)
+    T.__mul__ = _binary("elementwise_mul")
+    T.__rmul__ = _binary("elementwise_mul", reverse=True)
+    T.__truediv__ = _binary("elementwise_div")
+    T.__rtruediv__ = _binary("elementwise_div", reverse=True)
+    T.__pow__ = _binary("elementwise_pow")
+    T.__mod__ = _binary("elementwise_mod")
+    T.__floordiv__ = _binary("elementwise_floordiv")
+    T.__matmul__ = _binary("matmul")
+    T.__neg__ = lambda self: trace_single("scale", {"X": [self]},
+                                          {"scale": -1.0})
+    T.__eq__ = _binary("equal")
+    T.__ne__ = _binary("not_equal")
+    T.__lt__ = _binary("less_than")
+    T.__le__ = _binary("less_equal")
+    T.__gt__ = _binary("greater_than")
+    T.__ge__ = _binary("greater_equal")
+    T.__hash__ = lambda self: id(self)

@@ -1,0 +1,251 @@
+"""Eager tracer + tape autograd engine.
+
+TPU-native replacement of the reference imperative engine:
+  Tracer::TraceOp      (/root/reference/paddle/fluid/imperative/tracer.cc:48)
+  BasicEngine backward (/root/reference/paddle/fluid/imperative/basic_engine.cc:161)
+  GradientAccumulator  (imperative/gradient_accumulator.cc)
+
+Ops execute eagerly through the SAME registry compute fns the static executor
+uses (one kernel story, two execution modes). Each op appends a tape entry;
+`run_backward` walks the tape in reverse, invoking the synthesised `<op>_grad`
+kernels (jax.vjp of forward) and sum-accumulating fan-in gradients.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import registry
+from ..registry import GRAD_SUFFIX
+from .varbase import Tensor
+
+__all__ = ["Tracer", "default_tracer", "run_backward", "trace_single",
+           "no_grad_guard"]
+
+
+class _EagerCtx:
+    """ExecContext clone for eager mode (see executor.ExecContext)."""
+
+    def __init__(self, rng_key, is_test=False):
+        self.rng_key = rng_key
+        self.is_test = is_test
+        self.mesh = None
+
+    def rng(self, attrs):
+        return jax.random.fold_in(self.rng_key, attrs.get("_rng_id", 0))
+
+    def exec_block(self, block, env):
+        raise RuntimeError("control-flow sub-blocks require static graph")
+
+
+@dataclasses.dataclass
+class TapeEntry:
+    op_type: str
+    inputs: dict      # slot -> list[Tensor | None]
+    outputs: dict     # slot -> list[Tensor | None]
+    attrs: dict
+    rng_id: int
+
+
+class Tracer:
+    """Eager op executor + tape recorder."""
+
+    def __init__(self, seed: int | None = None):
+        # lazy key creation: building a PRNGKey initialises the jax backend,
+        # which must not happen at import time (platform selection may still
+        # change — e.g. tests forcing the virtual CPU mesh)
+        self._seed = np.random.randint(0, 2**31) if seed is None else seed
+        self._base_key_cache = None
+        self._op_counter = 0
+        self._tape: list[TapeEntry] = []
+        self._has_grad = True
+        self._amp_level = 0  # set by amp_guard
+        self._amp_lists = None
+        self.train_mode = True
+
+    # -- rng ---------------------------------------------------------------
+    def _next_rng_id(self) -> int:
+        self._op_counter += 1
+        return self._op_counter
+
+    @property
+    def _base_key(self):
+        if self._base_key_cache is None:
+            self._base_key_cache = jax.random.PRNGKey(self._seed)
+        return self._base_key_cache
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        self._base_key_cache = jax.random.PRNGKey(self._seed)
+
+    # -- op execution ------------------------------------------------------
+    def trace_op(self, op_type: str, inputs: dict, outputs: dict,
+                 attrs: dict | None = None, stop_gradient: bool = False):
+        """Run `op_type` eagerly. `inputs`: slot -> Tensor/list[Tensor].
+        `outputs`: slot -> int (how many outputs) or list of placeholders.
+        Returns dict slot -> list[Tensor]."""
+        attrs = dict(attrs or {})
+        opdef = registry.require(op_type)
+        opdef.fill_default_attrs(attrs)
+        if opdef.stochastic:
+            attrs["_rng_id"] = self._next_rng_id()
+
+        in_tensors: dict[str, list] = {}
+        for slot, v in inputs.items():
+            if v is None:
+                continue
+            lst = v if isinstance(v, (list, tuple)) else [v]
+            in_tensors[slot] = [t for t in lst]
+
+        if self._amp_level:
+            from ...amp import auto_cast as amp_mod
+            in_tensors = amp_mod._autocast_inputs(op_type, in_tensors,
+                                                  self._amp_level)
+
+        ins_vals = {slot: [None if t is None else t._value for t in lst]
+                    for slot, lst in in_tensors.items()}
+        ctx = _EagerCtx(self._base_key, is_test=not self.train_mode)
+        out_vals = opdef.compute(ctx, ins_vals, attrs)
+
+        out_tensors: dict[str, list] = {}
+        requires_grad = (self._has_grad and not stop_gradient and
+                         opdef.grad is not None and any(
+                             not t.stop_gradient
+                             for lst in in_tensors.values()
+                             for t in lst if t is not None))
+        for slot, vals in out_vals.items():
+            outs = []
+            for v in vals:
+                if v is None:
+                    outs.append(None)
+                    continue
+                t = Tensor(v, stop_gradient=not requires_grad)
+                outs.append(t)
+            out_tensors[slot] = outs
+
+        if requires_grad:
+            entry = TapeEntry(op_type, in_tensors, out_tensors, attrs,
+                              attrs.get("_rng_id", 0))
+            for lst in out_tensors.values():
+                for t in lst:
+                    if t is not None:
+                        t._producer = entry
+            self._tape.append(entry)
+        return out_tensors
+
+    def reset_tape(self):
+        self._tape.clear()
+
+
+_global_tracer: Tracer | None = None
+
+
+def default_tracer() -> Tracer | None:
+    from .. import framework
+    return framework._dygraph_tracer_
+
+
+def trace_single(op_type, inputs, attrs=None, out_slot="Out"):
+    tr = default_tracer()
+    if tr is None:
+        raise RuntimeError("not in dygraph mode")
+    res = tr.trace_op(op_type, inputs, {}, attrs or {})
+    return res[out_slot][0]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    tr = default_tracer()
+    if tr is None:
+        yield
+        return
+    prev = tr._has_grad
+    tr._has_grad = False
+    try:
+        yield
+    finally:
+        tr._has_grad = prev
+
+
+# ---------------------------------------------------------------------------
+# backward engine
+# ---------------------------------------------------------------------------
+
+def run_backward(loss: Tensor, grad_tensor=None, retain_graph=False,
+                 targets: set | None = None):
+    tr = default_tracer()
+    if tr is None:
+        raise RuntimeError("backward() requires dygraph mode")
+    if loss.stop_gradient:
+        raise RuntimeError(f"{loss.name} has stop_gradient=True")
+
+    grads: dict[int, Any] = {}  # id(Tensor) -> accumulated grad array
+    seed = grad_tensor._value if isinstance(grad_tensor, Tensor) else \
+        (jnp.ones_like(loss._value) if grad_tensor is None
+         else jnp.asarray(grad_tensor))
+    grads[id(loss)] = seed
+    keep = {id(loss): loss}
+
+    ctx = _EagerCtx(tr._base_key, is_test=not tr.train_mode)
+
+    for entry in reversed(tr._tape):
+        out_has_grad = any(
+            t is not None and id(t) in grads
+            for lst in entry.outputs.values() for t in lst)
+        if not out_has_grad:
+            continue
+        opdef = registry.require(entry.op_type)
+        grad_def = registry.lookup(entry.op_type + "_grad")
+        # build grad-op inputs: fwd inputs + upstream out-grads
+        g_ins: dict[str, list] = {}
+        for slot, lst in entry.inputs.items():
+            g_ins[slot] = [None if t is None else t._value for t in lst]
+        for slot, lst in entry.outputs.items():
+            if slot in opdef.no_grad_out_slots:
+                continue
+            g_ins[slot + GRAD_SUFFIX] = [
+                None if t is None else grads.get(id(t)) for t in lst]
+        if grad_def is None and callable(opdef.grad):
+            raise NotImplementedError(
+                f"custom graph-grad op {entry.op_type} lacks eager path")
+        out_grads = grad_def.compute(ctx, g_ins, entry.attrs)
+        # scatter grads onto input tensors
+        for slot, lst in entry.inputs.items():
+            gs = out_grads.get(slot + GRAD_SUFFIX)
+            if gs is None:
+                continue
+            for t, g in zip(lst, gs):
+                if t is None or g is None or t.stop_gradient:
+                    continue
+                t = getattr(t, "_orig", t)  # unwrap amp cast views
+                if hasattr(g, "dtype") and g.dtype != t._value.dtype:
+                    g = g.astype(t._value.dtype)
+                prev = grads.get(id(t))
+                grads[id(t)] = g if prev is None else prev + g
+                keep[id(t)] = t
+                for hook in t._hooks:
+                    hv = hook(Tensor(grads[id(t)], stop_gradient=True))
+                    if hv is not None:
+                        grads[id(t)] = hv._value if isinstance(hv, Tensor) \
+                            else hv
+
+    # deposit .grad on leaf tensors (params) and explicitly requested targets
+    for tid, t in keep.items():
+        if (t._producer is None and not t.stop_gradient) or \
+                (targets is not None and tid in targets):
+            g = grads.get(tid)
+            if g is None:
+                continue
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad._set_value(t.grad._value + g)
+    if not retain_graph:
+        tr.reset_tape()

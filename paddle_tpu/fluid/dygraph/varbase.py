@@ -1,0 +1,147 @@
+"""Eager Tensor (VarBase) — a jax.Array plus autograd metadata.
+
+TPU-native replacement for the reference imperative VarBase/VariableWrapper
+(/root/reference/paddle/fluid/imperative/layer.h, variable_wrapper.h): the
+payload is an XLA device buffer; autograd metadata (grad tensor, leaf flag,
+tape hooks) lives host-side. Op execution and the tape are in tracer.py.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import core, unique_name
+
+__all__ = ["Tensor", "to_tensor_value"]
+
+
+def to_tensor_value(data, dtype=None):
+    if isinstance(data, Tensor):
+        return data._value
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        return data.astype(core.convert_dtype(dtype)) if dtype else data
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(core.convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)  # paddle default dtype
+    return jnp.asarray(arr)
+
+
+class Tensor:
+    """Eager tensor. `stop_gradient=True` (default for data) detaches it."""
+
+    def __init__(self, value, name=None, stop_gradient=True,
+                 persistable=False, trainable=None):
+        self._value = value if isinstance(value, (jnp.ndarray, jax.Array)) \
+            else to_tensor_value(value)
+        self.name = name or unique_name.generate("eager_tmp")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable if trainable is not None \
+            else not stop_gradient
+        self.grad: "Tensor | None" = None
+        # tape linkage (set by Tracer when this tensor is an op output)
+        self._producer = None
+        self._hooks = []
+
+    # -- payload access ----------------------------------------------------
+    def value(self):
+        return self._value
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def _set_value(self, v):
+        self._value = v if isinstance(v, (jnp.ndarray, jax.Array)) \
+            else jnp.asarray(v)
+
+    set_value = _set_value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(self._value.size)
+
+    def item(self):
+        return np.asarray(self._value).item()
+
+    def __len__(self):
+        return self.shape[0] if self.shape else 0
+
+    def __repr__(self):
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{np.asarray(self._value)})")
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .tracer import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    clear_grad = clear_gradient
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        return t
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+        return hook
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- conversion / manipulation heads (filled by math_op_patch) ---------
+    def astype(self, dtype):
+        from .tracer import trace_single
+        return trace_single("cast", {"X": [self]},
+                            {"in_dtype": self.dtype,
+                             "out_dtype": core.convert_dtype(dtype)})
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def __getitem__(self, idx):
+        # direct jax indexing; differentiable path flows through slice op
+        from .tracer import trace_single, default_tracer
+        if default_tracer() is None or self.stop_gradient:
+            return Tensor(self._value[idx], stop_gradient=True)
+        n = self.shape[0] if self.shape else 0
+        if isinstance(idx, int):
+            i = idx % n if n else idx  # normalise negative indices
+            return trace_single(
+                "slice", {"Input": [self]},
+                {"axes": [0], "starts": [i], "ends": [i + 1],
+                 "decrease_axis": [0], "infer_flags": [1]})
+        if isinstance(idx, slice):
+            start = idx.start or 0
+            stop = idx.stop if idx.stop is not None else n
+            if start < 0:
+                start += n
+            if stop < 0:
+                stop += n
+            return trace_single("slice", {"Input": [self]},
+                                {"axes": [0], "starts": [start],
+                                 "ends": [stop], "decrease_axis": [],
+                                 "infer_flags": [1]})
+        return Tensor(self._value[idx], stop_gradient=self.stop_gradient)
+
+    # filled in by math_op_patch at import time

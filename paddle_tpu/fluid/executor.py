@@ -1,0 +1,242 @@
+"""Executor: lowers a whole Program block to ONE jitted XLA computation.
+
+The reference Executor is a per-op interpreter — `for op in ops: op->Run`
+(/root/reference/paddle/fluid/framework/executor.cc:476), with kernel choice,
+data transfer and shape inference on every step. On TPU that loop is the
+enemy: instead we trace the Block once with jax (each op's registered compute
+fn), `jit` the result, and let XLA fuse/schedule. Parameter updates become
+functional: updated persistables are returned from the jitted step and
+donated, so optimizer ops get in-place semantics without a mutable Scope on
+device (replaces inplace_op_inference.h behaviors).
+
+Public surface mirrors reference python/paddle/fluid/executor.py:474,915
+(`Executor(place).run(program, feed, fetch_list, ...)`).
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import core, registry
+from .framework import Block, Program, Variable, default_main_program
+from .scope import Scope, global_scope
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Executor", "ExecContext", "global_scope", "scope_guard"]
+
+from .scope import scope_guard  # re-export for API parity
+
+
+class ExecContext:
+    """Per-trace context handed to op compute fns.
+
+    Carries the step RNG key (rng streams are derived per-op via fold_in on
+    the op's stable `_rng_id`, so fwd and auto-vjp grad ops see identical
+    randomness — the mask-saving trick of the reference's dropout grad for
+    free), test/train mode, and a re-entrant block runner for control flow.
+    """
+
+    def __init__(self, rng_key, is_test: bool = False, executor=None):
+        self.rng_key = rng_key
+        self.is_test = is_test
+        self.executor = executor
+        self.mesh = None  # set by distributed executors
+
+    def rng(self, attrs: dict):
+        rid = attrs.get("_rng_id", 0)
+        return jax.random.fold_in(self.rng_key, rid)
+
+    def exec_block(self, block: Block, env: dict) -> dict:
+        return trace_block(block, env, self)
+
+
+def _env_get(env: dict, name: str):
+    try:
+        return env[name]
+    except KeyError:
+        raise RuntimeError(
+            f"variable {name!r} is not initialised — feed it, produce it with "
+            f"an op, or run the startup program first") from None
+
+
+def trace_block(block: Block, env: dict, ctx: ExecContext) -> dict:
+    """Symbolically run every op of `block` against `env` (name -> value)."""
+    for op in block.ops:
+        opdef = registry.require(op.type)
+        ins = {slot: [_env_get(env, n) for n in names]
+               for slot, names in op.inputs.items()}
+        scope_name = op.attrs.get("name_scope") or op.type
+        with jax.named_scope(scope_name.replace("/", ".") or op.type):
+            outs = opdef.compute(ctx, ins, op.attrs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot) or []
+            for name, val in zip(names, vals):
+                if val is not None and name != "@EMPTY@":
+                    env[name] = val
+    return env
+
+
+def _analyze_program(program: Program):
+    """Find names read before written (external inputs) and all writes."""
+    written: set[str] = set()
+    ext_reads: set[str] = set()
+
+    def visit(block: Block):
+        for op in block.ops:
+            for n in op.input_arg_names:
+                if n not in written:
+                    ext_reads.add(n)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    visit(v)  # conservative: sub-block reads count here
+            for n in op.output_arg_names:
+                written.add(n)
+
+    visit(program.global_block())
+    return ext_reads, written
+
+
+class Executor:
+    """Reference executor.py:474 — but `run` compiles, caches and launches a
+    single XLA computation per (program-structure, arg-signature)."""
+
+    def __init__(self, place: core.Place | None = None):
+        self.place = place or core.default_place()
+        self._cache: dict[tuple, Any] = {}
+        self._run_counter = 0
+
+    # -- public API --------------------------------------------------------
+    def run(self, program: Program | None = None, feed: dict | None = None,
+            fetch_list: Sequence | None = None, scope: Scope | None = None,
+            return_numpy: bool = True, use_program_cache: bool = True):
+        program = program if program is not None else default_main_program()
+        feed = dict(feed or {})
+        scope = scope or global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+
+        if program._analysis_cache is None:
+            ext_reads, written = _analyze_program(program)
+            persistable = {v.name for v in program.list_vars()
+                           if v.persistable}
+            program._analysis_cache = (ext_reads, written, persistable,
+                                       program._structure_key())
+        ext_reads, written, persistable, skey = program._analysis_cache
+
+        feed_names = sorted(feed)
+        # persistables the computation must read from the scope
+        ro_names, upd_names = [], []
+        for n in sorted(persistable):
+            is_input = n in ext_reads and n not in feed
+            is_output = n in written
+            if not is_input and not is_output:
+                continue
+            if is_output:
+                upd_names.append(n)
+            elif is_input:
+                ro_names.append(n)
+        # updated vars that are also read need their current value too
+        upd_in_names = [n for n in upd_names if n in ext_reads]
+
+        missing = [n for n in ext_reads - set(feed)
+                   if n in persistable and not scope.has(n)]
+        if missing:
+            raise RuntimeError(
+                f"persistable vars {missing[:8]} not found in scope — run the "
+                f"startup program first")
+
+        feed_vals = []
+        for n in feed_names:
+            var = program.global_block()._var_recursive(n)
+            dtype = var.dtype if var is not None and var.dtype else None
+            val = _to_array(feed[n], dtype)
+            if var is not None and var.shape is not None:
+                declared = var.shape
+                ok = len(declared) == len(val.shape) and all(
+                    d < 0 or d == s for d, s in zip(declared, val.shape))
+                if not ok:
+                    raise ValueError(
+                        f"feed {n!r} has shape {tuple(val.shape)} but the "
+                        f"graph declares {tuple(declared)}")
+            feed_vals.append(val)
+
+        upd_in_vals = [scope.find_var(n) for n in upd_in_names]
+        ro_vals = [scope.find_var(n) for n in ro_names]
+
+        fn = self._compile(program, skey, feed_names, feed_vals, ro_names,
+                           ro_vals, upd_names, upd_in_names, upd_in_vals,
+                           fetch_names)
+
+        self._run_counter += 1
+        seed = np.uint32(
+            (program.random_seed * 1000003 + self._run_counter) & 0xFFFFFFFF
+            if program.random_seed
+            else np.random.randint(0, 2**31))
+        fetches, updates = fn(tuple(upd_in_vals), tuple(ro_vals),
+                              tuple(feed_vals), seed)
+        for n, v in zip(upd_names, updates):
+            scope.set(n, v)
+        if core.get_flags("FLAGS_benchmark")["FLAGS_benchmark"]:
+            jax.block_until_ready(fetches)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- compilation -------------------------------------------------------
+    def _compile(self, program, skey, feed_names, feed_vals, ro_names,
+                 ro_vals, upd_names, upd_in_names, upd_in_vals, fetch_names):
+        sig = (
+            skey,
+            tuple(ro_names), tuple(upd_names), tuple(upd_in_names),
+            tuple(fetch_names),
+            tuple((n, v.shape, str(jnp.result_type(v)))
+                  for n, v in zip(feed_names, feed_vals)),
+            tuple((v.shape, str(jnp.result_type(v)))
+                  for v in list(upd_in_vals) + list(ro_vals)),
+            program._is_test,
+        )
+        fn = self._cache.get(sig)
+        if fn is not None:
+            return fn
+
+        is_test = program._is_test
+        gb = program.global_block()
+
+        def step(upd_in, ro, feeds, seed):
+            env: dict[str, Any] = {}
+            env.update(zip(upd_in_names, upd_in))
+            env.update(zip(ro_names, ro))
+            env.update(zip(feed_names, feeds))
+            ctx = ExecContext(jax.random.PRNGKey(seed), is_test=is_test,
+                              executor=self)
+            trace_block(gb, env, ctx)
+            fetches = tuple(_env_get(env, n) for n in fetch_names)
+            updates = tuple(env[n] for n in upd_names)
+            return fetches, updates
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # cpu donation warnings
+            fn = jax.jit(step, donate_argnums=(0,))
+        if len(self._cache) >= core.get_flags(
+                "FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]:
+            self._cache.clear()
+        self._cache[sig] = fn
+        return fn
+
+    def close(self):
+        self._cache.clear()
+
+
+def _to_array(x, dtype=None):
+    if hasattr(x, "dtype") and not isinstance(x, np.ndarray):
+        return x  # already a device array / Tensor value
+    arr = np.asarray(x)
+    if dtype is not None and arr.dtype != np.dtype(dtype):
+        arr = arr.astype(dtype)
+    return jnp.asarray(arr)

@@ -1,0 +1,159 @@
+"""Parameter initializers — append init ops to the startup program.
+
+Parity with reference python/paddle/fluid/initializer.py (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, NumpyArray). Initialisation
+runs as ops of the startup Program, exactly like the reference, so the whole
+init is one jitted XLA computation too.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import framework
+
+__all__ = [
+    "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
+    "Xavier", "MSRA", "NumpyArrayInitializer", "ConstantInitializer",
+    "UniformInitializer", "NormalInitializer", "XavierInitializer",
+    "MSRAInitializer", "TruncatedNormalInitializer",
+]
+
+
+class Initializer:
+    def __call__(self, var, block):
+        raise NotImplementedError
+
+    def _fan_in_out(self, var):
+        shape = var.shape
+        if len(shape) < 2:
+            return (shape[0] if shape else 1,) * 2
+        recep = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        return shape[1] * recep, shape[0] * recep
+
+
+class ConstantInitializer(Initializer):
+    def __init__(self, value: float = 0.0, force_cpu: bool = False):
+        self.value = value
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="fill_constant", outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "value": float(self.value),
+                   "dtype": var.dtype})
+
+
+class UniformInitializer(Initializer):
+    def __init__(self, low=-1.0, high=1.0, seed=0):
+        self.low, self.high, self.seed = low, high, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="uniform_random", outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "min": self.low,
+                   "max": self.high, "seed": self.seed, "dtype": var.dtype})
+
+
+class NormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="gaussian_random", outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "mean": self.loc,
+                   "std": self.scale, "seed": self.seed, "dtype": var.dtype})
+
+
+class TruncatedNormalInitializer(Initializer):
+    def __init__(self, loc=0.0, scale=1.0, seed=0):
+        self.loc, self.scale, self.seed = loc, scale, seed
+
+    def __call__(self, var, block):
+        return block.append_op(
+            type="truncated_gaussian_random", outputs={"Out": [var.name]},
+            attrs={"shape": list(var.shape), "mean": self.loc,
+                   "std": self.scale, "seed": self.seed, "dtype": var.dtype})
+
+
+class XavierInitializer(Initializer):
+    """Glorot. fan_in/fan_out from the param shape (conv-aware)."""
+
+    def __init__(self, uniform=True, fan_in=None, fan_out=None, seed=0):
+        self.uniform, self.fan_in, self.fan_out, self.seed = \
+            uniform, fan_in, fan_out, seed
+
+    def __call__(self, var, block):
+        fi, fo = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        if self.uniform:
+            limit = math.sqrt(6.0 / (fi + fo))
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        std = math.sqrt(2.0 / (fi + fo))
+        return NormalInitializer(0.0, std, self.seed)(var, block)
+
+
+class MSRAInitializer(Initializer):
+    """Kaiming He init."""
+
+    def __init__(self, uniform=True, fan_in=None, seed=0,
+                 negative_slope=0.0, nonlinearity="relu"):
+        self.uniform, self.fan_in, self.seed = uniform, fan_in, seed
+
+    def __call__(self, var, block):
+        fi, _ = self._fan_in_out(var)
+        fi = self.fan_in if self.fan_in is not None else fi
+        if self.uniform:
+            limit = math.sqrt(6.0 / fi)
+            return UniformInitializer(-limit, limit, self.seed)(var, block)
+        return NormalInitializer(0.0, math.sqrt(2.0 / fi), self.seed)(var, block)
+
+
+class NumpyArrayInitializer(Initializer):
+    def __init__(self, value: np.ndarray):
+        self.value = np.asarray(value)
+
+    def __call__(self, var, block):
+        v = self.value
+        attrs = {"shape": list(v.shape), "dtype": var.dtype}
+        if v.dtype in (np.float32, np.float64, np.float16):
+            attrs["fp32_values"] = [float(q) for q in v.flatten()]
+        else:
+            attrs["int64_values"] = [int(q) for q in v.flatten()]
+        return block.append_op(type="assign_value",
+                               outputs={"Out": [var.name]}, attrs=attrs)
+
+
+class BilinearInitializer(Initializer):
+    def __call__(self, var, block):
+        shape = var.shape
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        c, k = shape[1], shape[3]
+        f = int(np.ceil(k / 2.0))
+        cc = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, dtype="float32")
+        for i in range(int(np.prod(shape))):
+            idx = np.unravel_index(i, shape)
+            w[idx] = (1 - abs(idx[3] / f - cc)) * (1 - abs(idx[2] / f - cc))
+        return NumpyArrayInitializer(w)(var, block)
+
+
+# reference-style aliases
+Constant = ConstantInitializer
+Uniform = UniformInitializer
+Normal = NormalInitializer
+TruncatedNormal = TruncatedNormalInitializer
+Xavier = XavierInitializer
+MSRA = MSRAInitializer
+Bilinear = BilinearInitializer
+
+
+def _global_weight_initializer():
+    return XavierInitializer()
+
+
+def _global_bias_initializer():
+    return ConstantInitializer(0.0)

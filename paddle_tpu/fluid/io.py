@@ -1,0 +1,145 @@
+"""Save/load of parameters and inference programs.
+
+Parity with reference python/paddle/fluid/io.py (save_persistables,
+load_persistables, save_inference_model, load_inference_model) and
+paddle.static.save/load (io.py:1669,1730). Storage format: one `.pdparams`
+npz-style archive for tensors + a serialised Program (paddle_tpu proto) for
+inference models.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .executor import global_scope
+from .framework import Program, Variable, default_main_program
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save", "load",
+]
+
+
+def _collect(program, predicate):
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def _is_persistable(v):
+    return v.persistable and not v.is_data
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or default_main_program()
+    if vars is None:
+        vars = _collect(program, predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    blob = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        blob[v.name] = np.asarray(val)
+    path = os.path.join(dirname, filename or "__all__.pdparams")
+    with open(path, "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    return path
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: getattr(v, "trainable", False),
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+    path = os.path.join(dirname, filename or "__all__.pdparams")
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    scope = global_scope()
+    program = main_program or default_main_program()
+    want = None
+    if vars is not None:
+        want = {v.name for v in vars}
+    elif predicate is not None:
+        want = {v.name for v in _collect(program, predicate)}
+    for name, arr in blob.items():
+        if want is None or name in want:
+            scope.set(name, jnp.asarray(arr))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """Prune program to feed→fetch path + save params
+    (reference io.py:1164). Program serialisation via paddle_tpu proto."""
+    from .proto import serialize_program
+    program = main_program or default_main_program()
+    program = program.clone(for_test=True)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+    }
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "wb") as f:
+        f.write(serialize_program(program, meta))
+    if not program_only:
+        save_persistables(executor, dirname, program,
+                          filename=params_filename)
+    return meta["fetch_names"]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    from .proto import deserialize_program
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        program, meta = deserialize_program(f.read())
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_vars = [program.global_block()._var_recursive(n)
+                  for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+def save(program: Program, model_path: str):
+    """paddle.static.save (reference io.py:1669): params + opt state."""
+    dirname = os.path.dirname(model_path) or "."
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    blob = {}
+    for v in program.list_vars():
+        if v.persistable:
+            val = scope.find_var(v.name)
+            if val is not None:
+                blob[v.name] = np.asarray(val)
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    import jax.numpy as jnp
+    with open(model_path + ".pdparams", "rb") as f:
+        blob = pickle.load(f)
+    scope = global_scope()
+    for name, arr in blob.items():
+        scope.set(name, jnp.asarray(arr))

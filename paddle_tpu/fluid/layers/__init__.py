@@ -1,0 +1,9 @@
+"""fluid.layers namespace (reference python/paddle/fluid/layers/)."""
+from . import nn, tensor
+from .nn import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+
+from .nn import __all__ as _nn_all
+from .tensor import __all__ as _tensor_all
+
+__all__ = list(_nn_all) + list(_tensor_all)

@@ -1,0 +1,52 @@
+"""Shared helpers for op compute/infer functions."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def x(ins, slot="X"):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+def out(val, slot="Out"):
+    return {slot: [val]}
+
+
+def bcast_to_x(xv, yv, axis: int):
+    """Paddle elementwise broadcast: align y's dims to x starting at `axis`
+    (reference operators/elementwise/elementwise_op_function.h)."""
+    if axis == -1 or xv.ndim == yv.ndim:
+        return yv
+    axis = int(axis)
+    new_shape = (1,) * axis + yv.shape + (1,) * (xv.ndim - axis - yv.ndim)
+    return yv.reshape(new_shape)
+
+
+def normalize_axes(dim, ndim):
+    if dim is None:
+        return tuple(range(ndim))
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(sorted(d % ndim for d in dim))
+
+
+def static_reduce_shape(shape, dim, keep_dim, reduce_all):
+    if shape is None:
+        return None
+    nd = len(shape)
+    axes = set(range(nd)) if reduce_all or not dim else {d % nd for d in dim}
+    if keep_dim:
+        return tuple(1 if i in axes else s for i, s in enumerate(shape))
+    kept = tuple(s for i, s in enumerate(shape) if i not in axes)
+    return kept if kept else (1,)
+
+
+def np_dtype(dtype) -> np.dtype:
+    import paddle_tpu.fluid.core as core
+    return np.dtype(core.convert_dtype(dtype))
+
+
+def astype(v, dtype):
+    return v.astype(np_dtype(dtype)) if v is not None else None

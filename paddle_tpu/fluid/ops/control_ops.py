@@ -1,0 +1,129 @@
+"""Control-flow ops: cond / while with sub-blocks, print/assert, feed/fetch.
+
+Replaces reference operators/controlflow/ (while_op, conditional_block_op —
+sub-block attrs per framework.proto:34 AttrType BLOCK). TPU-native mechanism:
+the sub-Block is traced into the SAME jitted computation through
+`lax.cond` / `lax.while_loop` — no step-scopes, no host interpreter.
+Constraint inherited from XLA (and embraced): loop-carried vars keep fixed
+shape/dtype across iterations.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register
+from .common import x, out
+
+
+@register("feed", grad=None, attrs={"col": 0})
+def _feed(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("fetch", grad=None, attrs={"col": 0})
+def _fetch(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("print", attrs={"first_n": -1, "message": "", "summarize": 20,
+                          "print_tensor_name": True, "print_tensor_type": True,
+                          "print_tensor_shape": True, "print_tensor_lod": False,
+                          "print_phase": "BOTH"})
+def _print(ctx, ins, attrs):
+    v = x(ins, "In") or x(ins, "X")
+    jax.debug.print(attrs.get("message", "") + " {v}", v=v)
+    return out(v)
+
+
+@register("assert", grad=None, attrs={"summarize": -1})
+def _assert(ctx, ins, attrs):
+    c = x(ins, "Cond")
+    jax.debug.print("assert cond={c}", c=c)
+    return {}
+
+
+@register("select_input", grad=None)
+def _select_input(ctx, ins, attrs):
+    mask = x(ins, "Mask").reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    if len(xs) == 2:
+        return out(jax.lax.select(mask == 1, xs[1], xs[0]))
+    return out(jax.lax.switch(mask, [lambda i=i: xs[i]
+                                     for i in range(len(xs))]))
+
+
+@register("select_output", grad=None)
+def _select_output(ctx, ins, attrs):
+    # with functional cond this degenerates to identity fan-out
+    return {"Out": [x(ins, "X")]}
+
+
+# ---------------------------------------------------------------------------
+# cond: attrs {sub_block_true, sub_block_false}, inputs Cond + Input (captured)
+# outputs Out = vars produced by the chosen branch (same names both branches)
+# ---------------------------------------------------------------------------
+
+@register("cond")
+def _cond(ctx, ins, attrs):
+    from ..framework import Block
+    bt: Block = attrs["sub_block_true"]
+    bf: Block = attrs["sub_block_false"]
+    pred = x(ins, "Cond").reshape(()).astype(bool)
+    cap_names = attrs.get("capture_names", [])
+    caps = ins.get("Input", [])
+    out_names = attrs["out_names"]
+
+    def run(block):
+        def f(cap_vals):
+            env = dict(zip(cap_names, cap_vals))
+            ctx.exec_block(block, env)
+            return tuple(env[n] for n in out_names)
+        return f
+
+    res = jax.lax.cond(pred, run(bt), run(bf), tuple(caps))
+    return {"Out": list(res)}
+
+
+# ---------------------------------------------------------------------------
+# while: attrs {sub_block, cond_name, carry_names}, inputs Condition + X
+# Loop semantics of reference while_op (operators/controlflow/while_op.cc):
+# run sub-block until cond var (recomputed inside the block) is false.
+# ---------------------------------------------------------------------------
+
+@register("while")
+def _while(ctx, ins, attrs):
+    from ..framework import Block
+    body: Block = attrs["sub_block"]
+    cond_name: str = attrs["cond_name"]
+    carry_names: list = attrs["carry_names"]
+    init = [x(ins, "Condition")] + list(ins.get("X", []))
+
+    def cond_fn(state):
+        return state[0].reshape(()).astype(bool)
+
+    def body_fn(state):
+        env = dict(zip([cond_name] + carry_names, state))
+        ctx.exec_block(body, env)
+        return tuple(env[n] for n in [cond_name] + carry_names)
+
+    final = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
+    return {"Out": list(final[1:]), "CondOut": [final[0]]}
+
+
+# ---------------------------------------------------------------------------
+# py_func: host python callback (reference operators/py_func_op)
+# ---------------------------------------------------------------------------
+
+@register("py_func", grad=None, attrs={"forward_callable_id": 0})
+def _py_func(ctx, ins, attrs):
+    fn = attrs["_callable"]
+    xs = ins.get("X", [])
+    result_shapes = attrs.get("result_shapes")
+    if result_shapes is None:
+        res = fn(*[jnp.asarray(v) for v in xs])
+        return {"Out": list(res) if isinstance(res, (list, tuple)) else [res]}
+    import jax.experimental
+    res = jax.pure_callback(
+        fn, [jax.ShapeDtypeStruct(tuple(s), d) for s, d in result_shapes], *xs)
+    return {"Out": list(res)}

@@ -1,0 +1,405 @@
+"""Dense math ops: elementwise, matmul, activations, reductions.
+
+Replaces the reference kernel families:
+  operators/elementwise/* (broadcast engine elementwise_op_function.h)
+  operators/matmul_op.cc, matmul_v2_op.cc, mul_op.cc
+  operators/activation_op.* (~40 functors)
+  operators/reduce_ops/*, mean_op, sum_op, scale_op, cast_op, clip_op
+All are jnp/lax expressions — XLA maps matmuls onto the MXU and fuses the
+elementwise neighbourhood automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import registry
+from ..registry import register, same_shape_as, elementwise_infer
+from .common import x, out, bcast_to_x, static_reduce_shape, np_dtype
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary ops (axis-broadcast semantics of the reference)
+# ---------------------------------------------------------------------------
+
+def _ew(name, fn):
+    def compute(ctx, ins, attrs, _fn=fn):
+        a, b = x(ins, "X"), x(ins, "Y")
+        b = bcast_to_x(a, b, attrs.get("axis", -1))
+        return out(_fn(a, b))
+    register("elementwise_" + name, compute, attrs={"axis": -1},
+             infer_shape=elementwise_infer)
+
+
+_ew("add", jnp.add)
+_ew("sub", jnp.subtract)
+_ew("mul", jnp.multiply)
+_ew("div", jnp.divide)
+_ew("max", jnp.maximum)
+_ew("min", jnp.minimum)
+_ew("pow", jnp.power)
+_ew("mod", jnp.mod)
+_ew("floordiv", jnp.floor_divide)
+
+
+# ---------------------------------------------------------------------------
+# matmul family → XLA dot_general on the MXU
+# ---------------------------------------------------------------------------
+
+def _matmul_infer(op):
+    xv, yv = op.invar("X"), op.invar("Y")
+    if xv is None or yv is None or xv.shape is None or yv.shape is None:
+        return
+    tx = op.attr("transpose_X", op.attr("trans_x", False))
+    ty = op.attr("transpose_Y", op.attr("trans_y", False))
+    xs, ys = list(xv.shape), list(yv.shape)
+    if len(xs) == 1:
+        xs = [1, xs[0]]
+    if len(ys) == 1:
+        ys = [ys[0], 1]
+    if tx:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if ty:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    batch = xs[:-2] if len(xs) >= len(ys) else ys[:-2]
+    shape = tuple(batch + [xs[-2], ys[-1]])
+    for n in op.output("Out"):
+        op.block.create_var(name=n, shape=shape, dtype=xv.dtype)
+
+
+def _matmul(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    if attrs.get("transpose_X") or attrs.get("trans_x"):
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if attrs.get("transpose_Y") or attrs.get("trans_y"):
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    r = jnp.matmul(a, b)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        r = r * alpha
+    return out(r)
+
+
+register("matmul", _matmul, infer_shape=_matmul_infer,
+         attrs={"transpose_X": False, "transpose_Y": False, "alpha": 1.0})
+register("matmul_v2", _matmul, infer_shape=_matmul_infer,
+         attrs={"trans_x": False, "trans_y": False})
+
+
+def _mul_infer(op):
+    xv, yv = op.invar("X"), op.invar("Y")
+    if xv is None or xv.shape is None or yv is None or yv.shape is None:
+        return
+    xn = op.attr("x_num_col_dims", 1)
+    yn = op.attr("y_num_col_dims", 1)
+    shape = tuple(list(xv.shape[:xn]) + list(yv.shape[yn:]))
+    for n in op.output("Out"):
+        op.block.create_var(name=n, shape=shape, dtype=xv.dtype)
+
+
+@register("mul", infer_shape=_mul_infer,
+          attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})
+def _mul(ctx, ins, attrs):
+    # reference mul_op: flatten x to 2-D at x_num_col_dims, same for y
+    a, b = x(ins, "X"), x(ins, "Y")
+    xn, yn = attrs["x_num_col_dims"], attrs["y_num_col_dims"]
+    a2 = a.reshape((int(jnp.prod(jnp.array(a.shape[:xn]))), -1)) \
+        if a.ndim > 2 or xn != 1 else a
+    b2 = b.reshape((int(jnp.prod(jnp.array(b.shape[:yn]))), -1)) \
+        if b.ndim > 2 or yn != 1 else b
+    r = a2 @ b2
+    out_shape = a.shape[:xn] + b.shape[yn:]
+    return out(r.reshape(out_shape))
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _act(name, fn, extra_attrs=None):
+    def compute(ctx, ins, attrs, _fn=fn):
+        return out(_fn(x(ins), attrs))
+    register(name, compute, attrs=extra_attrs or {},
+             infer_shape=same_shape_as("X"))
+
+
+_act("relu", lambda v, a: jax.nn.relu(v))
+_act("relu6", lambda v, a: jnp.clip(v, 0, a.get("threshold", 6.0)),
+     {"threshold": 6.0})
+_act("sigmoid", lambda v, a: jax.nn.sigmoid(v))
+_act("tanh", lambda v, a: jnp.tanh(v))
+_act("exp", lambda v, a: jnp.exp(v))
+_act("log", lambda v, a: jnp.log(v))
+_act("log2", lambda v, a: jnp.log2(v))
+_act("log10", lambda v, a: jnp.log10(v))
+_act("log1p", lambda v, a: jnp.log1p(v))
+_act("sqrt", lambda v, a: jnp.sqrt(v))
+_act("rsqrt", lambda v, a: jax.lax.rsqrt(v))
+_act("square", lambda v, a: jnp.square(v))
+_act("abs", lambda v, a: jnp.abs(v))
+_act("ceil", lambda v, a: jnp.ceil(v))
+_act("floor", lambda v, a: jnp.floor(v))
+_act("round", lambda v, a: jnp.round(v))
+_act("reciprocal", lambda v, a: 1.0 / v)
+_act("sin", lambda v, a: jnp.sin(v))
+_act("cos", lambda v, a: jnp.cos(v))
+_act("tan", lambda v, a: jnp.tan(v))
+_act("asin", lambda v, a: jnp.arcsin(v))
+_act("acos", lambda v, a: jnp.arccos(v))
+_act("atan", lambda v, a: jnp.arctan(v))
+_act("sinh", lambda v, a: jnp.sinh(v))
+_act("cosh", lambda v, a: jnp.cosh(v))
+_act("gelu", lambda v, a: jax.nn.gelu(v, approximate=a.get("approximate", False)),
+     {"approximate": False})
+_act("leaky_relu", lambda v, a: jax.nn.leaky_relu(v, a.get("alpha", 0.02)),
+     {"alpha": 0.02})
+_act("elu", lambda v, a: jax.nn.elu(v, a.get("alpha", 1.0)), {"alpha": 1.0})
+_act("selu", lambda v, a: jax.nn.selu(v),
+     {"scale": 1.0507009873554805, "alpha": 1.6732632423543772})
+_act("softplus", lambda v, a: jax.nn.softplus(v))
+_act("softsign", lambda v, a: jax.nn.soft_sign(v))
+_act("silu", lambda v, a: jax.nn.silu(v))
+_act("swish", lambda v, a: v * jax.nn.sigmoid(a.get("beta", 1.0) * v),
+     {"beta": 1.0})
+_act("mish", lambda v, a: v * jnp.tanh(jax.nn.softplus(v)))
+_act("hard_sigmoid",
+     lambda v, a: jnp.clip(a.get("slope", 0.2) * v + a.get("offset", 0.5), 0, 1),
+     {"slope": 0.2, "offset": 0.5})
+_act("hard_swish",
+     lambda v, a: v * jnp.clip(v + a.get("offset", 3.0), 0,
+                               a.get("threshold", 6.0)) / a.get("scale", 6.0),
+     {"threshold": 6.0, "scale": 6.0, "offset": 3.0})
+_act("hard_tanh",
+     lambda v, a: jnp.clip(v, a.get("t_min", -1.0), a.get("t_max", 1.0)),
+     {"t_min": -1.0, "t_max": 1.0})
+_act("logsigmoid", lambda v, a: jax.nn.log_sigmoid(v))
+_act("erf", lambda v, a: jax.scipy.special.erf(v))
+_act("tanh_shrink", lambda v, a: v - jnp.tanh(v))
+_act("softshrink",
+     lambda v, a: jnp.where(v > a.get("lambda", 0.5), v - a.get("lambda", 0.5),
+                            jnp.where(v < -a.get("lambda", 0.5),
+                                      v + a.get("lambda", 0.5), 0.0)),
+     {"lambda": 0.5})
+_act("hard_shrink",
+     lambda v, a: jnp.where(jnp.abs(v) > a.get("threshold", 0.5), v, 0.0),
+     {"threshold": 0.5})
+_act("thresholded_relu",
+     lambda v, a: jnp.where(v > a.get("threshold", 1.0), v, 0.0),
+     {"threshold": 1.0})
+_act("stanh",
+     lambda v, a: a.get("scale_b", 1.7159) * jnp.tanh(a.get("scale_a", 0.67) * v),
+     {"scale_a": 0.67, "scale_b": 1.7159})
+
+
+_act("sign", lambda v, a: jnp.sign(v))
+
+
+@register("pow", infer_shape=same_shape_as("X"), attrs={"factor": 1.0})
+def _pow(ctx, ins, attrs):
+    f = x(ins, "FactorTensor")
+    return out(jnp.power(x(ins), f if f is not None else attrs["factor"]))
+
+
+@register("clip", infer_shape=same_shape_as("X"),
+          attrs={"min": float("-inf"), "max": float("inf")})
+def _clip(ctx, ins, attrs):
+    lo = x(ins, "Min")
+    hi = x(ins, "Max")
+    lo = attrs["min"] if lo is None else lo
+    hi = attrs["max"] if hi is None else hi
+    return out(jnp.clip(x(ins), lo, hi))
+
+
+@register("scale", infer_shape=same_shape_as("X"),
+          attrs={"scale": 1.0, "bias": 0.0, "bias_after_scale": True})
+def _scale(ctx, ins, attrs):
+    v = x(ins)
+    s = x(ins, "ScaleTensor")
+    s = attrs["scale"] if s is None else s
+    if attrs["bias_after_scale"]:
+        return out(v * s + attrs["bias"])
+    return out((v + attrs["bias"]) * s)
+
+
+@register("sum", infer_shape=same_shape_as("X"))
+def _sum(ctx, ins, attrs):
+    vals = [v for v in ins.get("X", []) if v is not None]
+    r = vals[0]
+    for v in vals[1:]:
+        r = r + v
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(name, fn):
+    def infer(op):
+        v = op.invar("X")
+        if v is None or v.shape is None:
+            return
+        shape = static_reduce_shape(v.shape, op.attr("dim"),
+                                    op.attr("keep_dim", False),
+                                    op.attr("reduce_all", False))
+        for n in op.output("Out"):
+            op.block.create_var(name=n, shape=shape, dtype=v.dtype)
+
+    def compute(ctx, ins, attrs, _fn=fn):
+        v = x(ins)
+        axes = None if attrs.get("reduce_all") or not attrs.get("dim") \
+            else tuple(d % v.ndim for d in attrs["dim"])
+        r = _fn(v, axis=axes, keepdims=attrs.get("keep_dim", False))
+        if r.ndim == 0:
+            r = r.reshape((1,))
+        return out(r)
+
+    register(name, compute, infer_shape=infer,
+             attrs={"dim": [0], "keep_dim": False, "reduce_all": False})
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_any", jnp.any)
+_reduce("reduce_all", jnp.all)
+
+
+def _mean_infer(op):
+    v = op.invar("X")
+    for n in op.output("Out"):
+        op.block.create_var(name=n, shape=(1,),
+                            dtype=v.dtype if v is not None else "float32")
+
+
+@register("mean", infer_shape=_mean_infer)
+def _mean(ctx, ins, attrs):
+    return out(jnp.mean(x(ins)).reshape((1,)))
+
+
+@register("squared_l2_norm", infer_shape=_mean_infer)
+def _squared_l2_norm(ctx, ins, attrs):
+    return out(jnp.sum(jnp.square(x(ins))).reshape((1,)))
+
+
+@register("frobenius_norm", infer_shape=_mean_infer)
+def _frobenius_norm(ctx, ins, attrs):
+    return out(jnp.sqrt(jnp.sum(jnp.square(x(ins)))).reshape((1,)))
+
+
+@register("p_norm", infer_shape=_mean_infer,
+          attrs={"porder": 2.0, "axis": -1, "epsilon": 1e-12, "keepdim": False,
+                 "asvector": False})
+def _p_norm(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs["porder"]
+    if attrs.get("asvector"):
+        r = jnp.sum(jnp.abs(v) ** p) ** (1.0 / p)
+        return out(r.reshape((1,)))
+    r = jnp.sum(jnp.abs(v) ** p, axis=attrs["axis"],
+                keepdims=attrs["keepdim"]) ** (1.0 / p)
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# comparison / logical (non-differentiable)
+# ---------------------------------------------------------------------------
+
+def _cmp(name, fn):
+    def infer(op):
+        v = op.invar("X")
+        if v is None:
+            return
+        for n in op.output("Out"):
+            op.block.create_var(name=n, shape=v.shape, dtype="bool")
+
+    def compute(ctx, ins, attrs, _fn=fn):
+        return out(_fn(x(ins, "X"), x(ins, "Y")))
+    register(name, compute, grad=None, infer_shape=infer, attrs={"axis": -1})
+
+
+_cmp("equal", jnp.equal)
+_cmp("not_equal", jnp.not_equal)
+_cmp("less_than", jnp.less)
+_cmp("less_equal", jnp.less_equal)
+_cmp("greater_than", jnp.greater)
+_cmp("greater_equal", jnp.greater_equal)
+_cmp("logical_and", jnp.logical_and)
+_cmp("logical_or", jnp.logical_or)
+_cmp("logical_xor", jnp.logical_xor)
+
+
+@register("logical_not", grad=None, infer_shape=same_shape_as("X"))
+def _logical_not(ctx, ins, attrs):
+    return out(jnp.logical_not(x(ins)))
+
+
+@register("isfinite", grad=None, infer_shape=_mean_infer)
+def _isfinite(ctx, ins, attrs):
+    return out(jnp.all(jnp.isfinite(x(ins))).reshape((1,)))
+
+
+@register("isfinite_v2", grad=None, infer_shape=same_shape_as("X"))
+def _isfinite_v2(ctx, ins, attrs):
+    return out(jnp.isfinite(x(ins)))
+
+
+@register("isnan_v2", grad=None, infer_shape=same_shape_as("X"))
+def _isnan(ctx, ins, attrs):
+    return out(jnp.isnan(x(ins)))
+
+
+@register("isinf_v2", grad=None, infer_shape=same_shape_as("X"))
+def _isinf(ctx, ins, attrs):
+    return out(jnp.isinf(x(ins)))
+
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+@register("maximum", infer_shape=elementwise_infer)
+def _maximum(ctx, ins, attrs):
+    return out(jnp.maximum(x(ins, "X"), x(ins, "Y")))
+
+
+@register("minimum", infer_shape=elementwise_infer)
+def _minimum(ctx, ins, attrs):
+    return out(jnp.minimum(x(ins, "X"), x(ins, "Y")))
+
+
+@register("dot", infer_shape=_mean_infer)
+def _dot(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    return out(jnp.sum(a * b, axis=-1, keepdims=True))
+
+
+@register("bmm", infer_shape=_matmul_infer)
+def _bmm(ctx, ins, attrs):
+    return out(jnp.matmul(x(ins, "X"), x(ins, "Y")))
+
+
+@register("addmm", attrs={"Alpha": 1.0, "Beta": 1.0})
+def _addmm(ctx, ins, attrs):
+    inp, a, b = x(ins, "Input"), x(ins, "X"), x(ins, "Y")
+    return out(attrs["Beta"] * inp + attrs["Alpha"] * (a @ b))
+
+
+@register("cumsum", infer_shape=same_shape_as("X"),
+          attrs={"axis": -1, "flatten": False, "exclusive": False,
+                 "reverse": False})
+def _cumsum(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("flatten"):
+        v = v.reshape(-1)
+    axis = attrs["axis"]
+    if attrs.get("reverse"):
+        v = jnp.flip(v, axis)
+    r = jnp.cumsum(v, axis=axis)
+    if attrs.get("exclusive"):
+        r = r - v
+    if attrs.get("reverse"):
+        r = jnp.flip(r, axis)
+    return out(r)

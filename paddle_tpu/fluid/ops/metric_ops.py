@@ -1,0 +1,56 @@
+"""In-graph metric ops (reference operators/metrics/: accuracy_op, auc_op)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register
+from .common import x
+
+
+def _acc_infer(op):
+    for name in op.output("Accuracy") + op.output("Correct") + op.output("Total"):
+        op.block.create_var(name=name, shape=(1,), dtype="float32")
+
+
+@register("accuracy", grad=None, infer_shape=_acc_infer)
+def _accuracy(ctx, ins, attrs):
+    """Inputs: Out (topk values), Indices (topk indices), Label."""
+    idx, label = x(ins, "Indices"), x(ins, "Label")
+    lab = label.reshape(label.shape[0], -1)[:, :1].astype(jnp.int64)
+    hit = jnp.any(idx.reshape(idx.shape[0], -1) == lab, axis=1)
+    total = jnp.asarray(idx.shape[0], jnp.float32)
+    correct = jnp.sum(hit.astype(jnp.float32))
+    return {"Accuracy": [(correct / total).reshape((1,))],
+            "Correct": [correct.reshape((1,)).astype(jnp.int32)],
+            "Total": [total.reshape((1,)).astype(jnp.int32)]}
+
+
+@register("auc", grad=None,
+          attrs={"curve": "ROC", "num_thresholds": 4095, "slide_steps": 1})
+def _auc(ctx, ins, attrs):
+    """Streaming AUC with stat buffers carried as persistable vars
+    (reference operators/metrics/auc_op.cc)."""
+    preds, label = x(ins, "Predict"), x(ins, "Label")
+    stat_pos, stat_neg = x(ins, "StatPos"), x(ins, "StatNeg")
+    nt = attrs["num_thresholds"]
+    p1 = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else \
+        preds.reshape(-1)
+    lab = label.reshape(-1).astype(bool)
+    bins = jnp.clip((p1 * nt).astype(jnp.int32), 0, nt)
+    pos = jnp.zeros(nt + 1, jnp.int64).at[bins].add(lab.astype(jnp.int64))
+    neg = jnp.zeros(nt + 1, jnp.int64).at[bins].add((~lab).astype(jnp.int64))
+    new_pos = stat_pos.reshape(-1) + pos
+    new_neg = stat_neg.reshape(-1) + neg
+    # integrate (trapezoid over descending threshold)
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tp_prev = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp_prev = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
+    auc = jnp.where((tot_pos > 0) & (tot_neg > 0),
+                    area / jnp.maximum(tot_pos * tot_neg, 1), 0.0)
+    return {"AUC": [auc.reshape((1,)).astype(jnp.float64)
+                    if auc.dtype == jnp.float64 else auc.reshape((1,))],
+            "StatPosOut": [new_pos.reshape(stat_pos.shape)],
+            "StatNegOut": [new_neg.reshape(stat_neg.shape)]}

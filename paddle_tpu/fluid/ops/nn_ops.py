@@ -1,0 +1,596 @@
+"""NN ops: conv, pool, norm, softmax/losses, dropout, embedding.
+
+Replaces reference kernel families:
+  operators/conv_op.* + conv_cudnn (algo search)  -> lax.conv_general_dilated
+  operators/pool_op.*                             -> lax.reduce_window
+  operators/{batch,layer,instance,group}_norm_*   -> jnp (XLA fuses)
+  operators/softmax_*, cross_entropy, bce, ...    -> jax.nn
+  operators/dropout_op.*                          -> threefry rng via ctx.rng
+  operators/lookup_table_v2 (SelectedRows grads)  -> dense take; sharded
+                                                     embedding lives in
+                                                     paddle_tpu.distributed
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register, same_shape_as
+from .common import x, out
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+def _conv_pad(paddings, algorithm, ksize, dilations):
+    if algorithm == "SAME":
+        return "SAME"
+    if algorithm == "VALID":
+        return "VALID"
+    if len(paddings) == 2:
+        return [(paddings[0], paddings[0]), (paddings[1], paddings[1])]
+    if len(paddings) == 4:
+        return [(paddings[0], paddings[1]), (paddings[2], paddings[3])]
+    raise ValueError(f"bad paddings {paddings}")
+
+
+def _conv2d_infer(op):
+    iv, fv = op.invar("Input"), op.invar("Filter")
+    if iv is None or iv.shape is None or fv is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1])
+    p = op.attr("paddings", [0, 0])
+    d = op.attr("dilations", [1, 1])
+    algo = op.attr("padding_algorithm", "EXPLICIT")
+    n, _, h, w = iv.shape
+    oc, _, kh, kw = fv.shape
+    if algo == "SAME":
+        oh = -(-h // s[0]) if h > 0 else h
+        ow = -(-w // s[1]) if w > 0 else w
+    else:
+        if algo == "VALID":
+            ph0 = ph1 = pw0 = pw1 = 0
+        elif len(p) == 2:
+            ph0 = ph1 = p[0]; pw0 = pw1 = p[1]
+        else:
+            ph0, ph1, pw0, pw1 = p
+        ekh, ekw = (kh - 1) * d[0] + 1, (kw - 1) * d[1] + 1
+        oh = (h + ph0 + ph1 - ekh) // s[0] + 1 if h > 0 else h
+        ow = (w + pw0 + pw1 - ekw) // s[1] + 1 if w > 0 else w
+    for name in op.output("Output"):
+        op.block.create_var(name=name, shape=(n, oc, oh, ow), dtype=iv.dtype)
+
+
+def _conv2d(ctx, ins, attrs):
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    strides = attrs.get("strides", [1, 1])
+    dilations = attrs.get("dilations", [1, 1])
+    pad = _conv_pad(attrs.get("paddings", [0, 0]),
+                    attrs.get("padding_algorithm", "EXPLICIT"),
+                    flt.shape[2:], dilations)
+    r = jax.lax.conv_general_dilated(
+        inp, flt, window_strides=strides, padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=attrs.get("groups", 1) or 1,
+        preferred_element_type=jnp.float32
+        if inp.dtype == jnp.bfloat16 else None)
+    return {"Output": [r.astype(inp.dtype)]}
+
+
+register("conv2d", _conv2d, infer_shape=_conv2d_infer,
+         attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                "groups": 1, "padding_algorithm": "EXPLICIT",
+                "data_format": "NCHW", "use_cudnn": False})
+register("depthwise_conv2d", _conv2d, infer_shape=_conv2d_infer,
+         attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                "groups": 1, "padding_algorithm": "EXPLICIT",
+                "data_format": "NCHW", "use_cudnn": False})
+
+
+def _conv2d_transpose(ctx, ins, attrs):
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    strides = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0])
+    pads = _conv_pad(p, attrs.get("padding_algorithm", "EXPLICIT"),
+                     flt.shape[2:], [1, 1])
+    # filter layout for transpose conv in reference is (in, out/groups, kh, kw)
+    r = jax.lax.conv_transpose(
+        inp, jnp.swapaxes(flt, 0, 1), strides=strides,
+    padding=pads if isinstance(pads, str) else [tuple(q) for q in pads],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": [r]}
+
+
+register("conv2d_transpose", _conv2d_transpose,
+         attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+                "groups": 1, "padding_algorithm": "EXPLICIT",
+                "data_format": "NCHW", "output_size": [], "use_cudnn": False})
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _pool2d_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    n, c, h, w = v.shape
+    if op.attr("global_pooling", False) or op.attr("adaptive", False) and \
+            list(op.attr("ksize", [1, 1])) == [1, 1]:
+        oh = ow = 1
+    elif op.attr("adaptive", False):
+        oh, ow = op.attr("ksize")
+    else:
+        k = op.attr("ksize", [2, 2]); s = op.attr("strides", [2, 2])
+        p = op.attr("paddings", [0, 0])
+        if op.attr("ceil_mode", False):
+            oh = -(-(h + 2 * p[0] - k[0]) // s[0]) + 1 if h > 0 else h
+            ow = -(-(w + 2 * p[1] - k[1]) // s[1]) + 1 if w > 0 else w
+        else:
+            oh = (h + 2 * p[0] - k[0]) // s[0] + 1 if h > 0 else h
+            ow = (w + 2 * p[1] - k[1]) // s[1] + 1 if w > 0 else w
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=(n, c, oh, ow), dtype=v.dtype)
+
+
+@register("pool2d", infer_shape=_pool2d_infer,
+          attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+                 "paddings": [0, 0], "global_pooling": False,
+                 "ceil_mode": False, "exclusive": True, "adaptive": False,
+                 "data_format": "NCHW", "use_cudnn": False})
+def _pool2d(ctx, ins, attrs):
+    v = x(ins)
+    ptype = attrs["pooling_type"]
+    if attrs.get("global_pooling") or (attrs.get("adaptive") and
+                                       list(attrs["ksize"]) == [1, 1]):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return out(fn(v, axis=(2, 3), keepdims=True))
+    if attrs.get("adaptive"):
+        oh, ow = attrs["ksize"]
+        h, w = v.shape[2], v.shape[3]
+        if h % oh == 0 and w % ow == 0:
+            r = v.reshape(v.shape[0], v.shape[1], oh, h // oh, ow, w // ow)
+            fn = jnp.max if ptype == "max" else jnp.mean
+            return out(fn(r, axis=(3, 5)))
+        raise NotImplementedError("adaptive pool with non-divisible size")
+    k = list(attrs["ksize"]); s = list(attrs["strides"])
+    p = list(attrs["paddings"])
+    dims = (1, 1, k[0], k[1])
+    strides = (1, 1, s[0], s[1])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo(v.dtype).min
+        r = jax.lax.reduce_window(v, init, jax.lax.max, dims, strides, pads)
+    else:
+        ssum = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides, pads)
+        if attrs.get("exclusive", True) and (p[0] or p[1]):
+            ones = jnp.ones_like(v)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                        pads)
+            r = ssum / cnt
+        else:
+            r = ssum / (k[0] * k[1])
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+def _bn_infer(op):
+    v = op.invar("X")
+    if v is None:
+        return
+    for name in op.output("Y"):
+        op.block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+    sv = op.invar("Scale")
+    cshape = sv.shape if sv is not None else None
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        for name in op.output(slot):
+            op.block.create_var(name=name, shape=cshape, dtype="float32")
+
+
+@register("batch_norm", infer_shape=_bn_infer,
+          attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+                 "data_layout": "NCHW", "use_global_stats": False,
+                 "trainable_statistics": False},
+          no_grad_out_slots=("MeanOut", "VarianceOut", "SavedMean",
+                             "SavedVariance", "ReserveSpace"))
+def _batch_norm(ctx, ins, attrs):
+    v = x(ins)
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    mean, var = x(ins, "Mean"), x(ins, "Variance")
+    layout = attrs.get("data_layout", "NCHW")
+    caxis = 1 if layout == "NCHW" else v.ndim - 1
+    axes = tuple(i for i in range(v.ndim) if i != caxis)
+    bshape = [1] * v.ndim
+    bshape[caxis] = v.shape[caxis]
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    use_global = attrs.get("use_global_stats", False) or is_test
+    eps = attrs["epsilon"]
+    m = attrs["momentum"]
+    if use_global:
+        bm, bv = mean, var
+        mean_out, var_out = mean, var
+    else:
+        fp = v.astype(jnp.float32)
+        bm = jnp.mean(fp, axis=axes)
+        bv = jnp.var(fp, axis=axes)
+        mean_out = m * mean + (1 - m) * bm
+        var_out = m * var + (1 - m) * bv
+    inv = jax.lax.rsqrt(bv.astype(jnp.float32) + eps)
+    y = (v - bm.reshape(bshape).astype(v.dtype)) * \
+        (inv.reshape(bshape) * scale.reshape(bshape)).astype(v.dtype) + \
+        bias.reshape(bshape).astype(v.dtype)
+    return {"Y": [y], "MeanOut": [mean_out], "VarianceOut": [var_out],
+            "SavedMean": [bm], "SavedVariance": [inv]}
+
+
+def _ln_infer(op):
+    v = op.invar("X")
+    if v is None:
+        return
+    for name in op.output("Y"):
+        op.block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+    if v.shape is not None:
+        ax = op.attr("begin_norm_axis", 1)
+        rows = int(np.prod([s for s in v.shape[:ax]])) \
+            if all(s >= 0 for s in v.shape[:ax]) else -1
+        for slot in ("Mean", "Variance"):
+            for name in op.output(slot):
+                op.block.create_var(name=name, shape=(rows,), dtype="float32")
+
+
+@register("layer_norm", infer_shape=_ln_infer,
+          attrs={"epsilon": 1e-5, "begin_norm_axis": 1},
+          no_grad_out_slots=("Mean", "Variance"))
+def _layer_norm(ctx, ins, attrs):
+    v = x(ins)
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    ax = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(ax, v.ndim))
+    fp = v.astype(jnp.float32)
+    mean = jnp.mean(fp, axis=axes, keepdims=True)
+    var = jnp.var(fp, axis=axes, keepdims=True)
+    y = (fp - mean) * jax.lax.rsqrt(var + attrs["epsilon"])
+    feat = v.shape[ax:]
+    if scale is not None:
+        y = y * scale.reshape(feat).astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.reshape(feat).astype(jnp.float32)
+    rows = int(np.prod(v.shape[:ax])) if v.ndim > ax else 1
+    return {"Y": [y.astype(v.dtype)], "Mean": [mean.reshape(rows)],
+            "Variance": [var.reshape(rows)]}
+
+
+@register("instance_norm", attrs={"epsilon": 1e-5},
+          no_grad_out_slots=("SavedMean", "SavedVariance"))
+def _instance_norm(ctx, ins, attrs):
+    v = x(ins)
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    axes = tuple(range(2, v.ndim))
+    mean = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    y = (v - mean) * jax.lax.rsqrt(var + attrs["epsilon"])
+    cshape = (1, -1) + (1,) * (v.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": [y], "SavedMean": [mean.reshape(-1)],
+            "SavedVariance": [var.reshape(-1)]}
+
+
+@register("group_norm", attrs={"epsilon": 1e-5, "groups": 1,
+                               "data_layout": "NCHW"},
+          no_grad_out_slots=("Mean", "Variance"))
+def _group_norm(ctx, ins, attrs):
+    v = x(ins)
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    g = attrs["groups"]
+    n, c = v.shape[0], v.shape[1]
+    rest = v.shape[2:]
+    r = v.reshape((n, g, c // g) + rest)
+    axes = tuple(range(2, r.ndim))
+    mean = jnp.mean(r, axis=axes, keepdims=True)
+    var = jnp.var(r, axis=axes, keepdims=True)
+    y = ((r - mean) * jax.lax.rsqrt(var + attrs["epsilon"])).reshape(v.shape)
+    cshape = (1, c) + (1,) * (v.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": [y], "Mean": [mean.reshape(n, g)],
+            "Variance": [var.reshape(n, g)]}
+
+
+# ---------------------------------------------------------------------------
+# softmax & losses
+# ---------------------------------------------------------------------------
+
+@register("softmax", infer_shape=same_shape_as("X"),
+          attrs={"axis": -1, "use_cudnn": False})
+def _softmax(ctx, ins, attrs):
+    return out(jax.nn.softmax(x(ins), axis=attrs["axis"]))
+
+
+@register("log_softmax", infer_shape=same_shape_as("X"), attrs={"axis": -1})
+def _log_softmax(ctx, ins, attrs):
+    return out(jax.nn.log_softmax(x(ins), axis=attrs["axis"]))
+
+
+def _xent_infer(op):
+    v = op.invar("X") or op.invar("Logits")
+    if v is None or v.shape is None:
+        return
+    shape = tuple(list(v.shape[:-1]) + [1])
+    for name in op.output("Y") + op.output("Loss"):
+        op.block.create_var(name=name, shape=shape, dtype=v.dtype)
+    for name in op.output("Softmax"):
+        op.block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+
+
+@register("cross_entropy", infer_shape=_xent_infer,
+          attrs={"soft_label": False, "ignore_index": -100},
+          no_grad_slots=("Label",))
+def _cross_entropy(ctx, ins, attrs):
+    probs, label = x(ins, "X"), x(ins, "Label")
+    logp = jnp.log(jnp.clip(probs, 1e-20, None))
+    if attrs.get("soft_label"):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
+            else label
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                     axis=-1)
+        loss = -picked
+        ii = attrs.get("ignore_index", -100)
+        loss = jnp.where(lab[..., None] == ii, 0.0, loss)
+    return {"Y": [loss]}
+
+
+@register("softmax_with_cross_entropy", infer_shape=_xent_infer,
+          attrs={"soft_label": False, "ignore_index": -100, "axis": -1,
+                 "numeric_stable_mode": True},
+          no_grad_slots=("Label",), no_grad_out_slots=("Softmax",))
+def _softmax_xent(ctx, ins, attrs):
+    logits, label = x(ins, "Logits"), x(ins, "Label")
+    axis = attrs.get("axis", -1)
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    sm = jnp.exp(logp)
+    if attrs.get("soft_label"):
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 \
+            else label
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
+                                     axis=axis)
+        loss = -picked
+        ii = attrs.get("ignore_index", -100)
+        loss = jnp.where(lab[..., None] == ii, 0.0, loss)
+    return {"Loss": [loss], "Softmax": [sm]}
+
+
+@register("mse_loss", infer_shape=same_shape_as("X"))
+def _mse(ctx, ins, attrs):
+    d = x(ins, "X") - x(ins, "Y")
+    return out(jnp.square(d))
+
+
+@register("bce_loss", infer_shape=same_shape_as("X"),
+          no_grad_slots=("Label",))
+def _bce(ctx, ins, attrs):
+    p, lab = x(ins, "X"), x(ins, "Label")
+    p = jnp.clip(p, 1e-12, 1 - 1e-12)
+    return out(-(lab * jnp.log(p) + (1 - lab) * jnp.log1p(-p)))
+
+
+@register("sigmoid_cross_entropy_with_logits",
+          infer_shape=same_shape_as("X"),
+          attrs={"ignore_index": -100, "normalize": False},
+          no_grad_slots=("Label",))
+def _sce_logits(ctx, ins, attrs):
+    z, lab = x(ins, "X"), x(ins, "Label")
+    loss = jnp.maximum(z, 0) - z * lab + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    ii = attrs.get("ignore_index", -100)
+    loss = jnp.where(lab == ii, 0.0, loss)
+    if attrs.get("normalize"):
+        denom = jnp.maximum(jnp.sum((lab != ii).astype(loss.dtype)), 1.0)
+        loss = loss / denom
+    return out(loss)
+
+
+@register("huber_loss", attrs={"delta": 1.0}, no_grad_slots=("Y",),
+          infer_shape=same_shape_as("X", "Out"),
+          no_grad_out_slots=("Residual",))
+def _huber(ctx, ins, attrs):
+    pred, lab = x(ins, "X"), x(ins, "Y")
+    d = attrs["delta"]
+    r = lab - pred
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register("kldiv_loss", attrs={"reduction": "mean"}, no_grad_slots=("Target",))
+def _kldiv(ctx, ins, attrs):
+    logp, target = x(ins, "X"), x(ins, "Target")
+    loss = target * (jnp.log(jnp.clip(target, 1e-20, None)) - logp)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return out(jnp.mean(loss).reshape((1,)))
+    if red == "sum":
+        return out(jnp.sum(loss).reshape((1,)))
+    if red == "batchmean":
+        return out((jnp.sum(loss) / loss.shape[0]).reshape((1,)))
+    return out(loss)
+
+
+@register("nll_loss", attrs={"reduction": "mean", "ignore_index": -100},
+          no_grad_slots=("Label",), no_grad_out_slots=("Total_weight",))
+def _nll(ctx, ins, attrs):
+    logp, lab = x(ins, "X"), x(ins, "Label")
+    w = x(ins, "Weight")
+    picked = jnp.take_along_axis(logp, lab[:, None].astype(jnp.int32),
+                                 axis=1)[:, 0]
+    wt = w[lab] if w is not None else jnp.ones_like(picked)
+    loss = -picked * wt
+    red = attrs.get("reduction", "mean")
+    tot = jnp.sum(wt)
+    if red == "mean":
+        return {"Out": [(jnp.sum(loss) / tot).reshape((1,))],
+                "Total_weight": [tot.reshape((1,))]}
+    if red == "sum":
+        return {"Out": [jnp.sum(loss).reshape((1,))],
+                "Total_weight": [tot.reshape((1,))]}
+    return {"Out": [loss], "Total_weight": [tot.reshape((1,))]}
+
+
+@register("smooth_l1_loss", no_grad_slots=("Y",),
+          no_grad_out_slots=("Diff",), attrs={"sigma": 1.0})
+def _smooth_l1(ctx, ins, attrs):
+    pred, lab = x(ins, "X"), x(ins, "Y")
+    sigma2 = attrs["sigma"] ** 2
+    d = pred - lab
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / sigma2, 0.5 * d * d * sigma2, a - 0.5 / sigma2)
+    return {"Out": [jnp.sum(loss, axis=tuple(range(1, loss.ndim)),
+                            keepdims=False)[..., None]], "Diff": [d]}
+
+
+@register("squared_error_cost", infer_shape=same_shape_as("X"),
+          no_grad_slots=("Y",))
+def _squared_error(ctx, ins, attrs):
+    d = x(ins, "X") - x(ins, "Y")
+    return out(jnp.square(d))
+
+
+# ---------------------------------------------------------------------------
+# dropout (stochastic — stable per-op rng stream via ctx.rng)
+# ---------------------------------------------------------------------------
+
+@register("dropout", infer_shape=same_shape_as("X"), stochastic=True,
+          attrs={"dropout_prob": 0.5, "is_test": False, "fix_seed": False,
+                 "seed": 0, "dropout_implementation": "downgrade_in_infer"},
+          no_grad_out_slots=("Mask",))
+def _dropout(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs["dropout_prob"]
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    if is_test:
+        y = v * (1.0 - p) if impl == "downgrade_in_infer" else v
+        return {"Out": [y], "Mask": [None]}
+    if p >= 1.0:
+        return {"Out": [jnp.zeros_like(v)], "Mask": [jnp.zeros_like(v)]}
+    key = ctx.rng(attrs)
+    mask = jax.random.bernoulli(key, 1.0 - p, v.shape)
+    if impl == "upscale_in_train":
+        y = jnp.where(mask, v / (1.0 - p), 0.0)
+    else:
+        y = jnp.where(mask, v, 0.0)
+    return {"Out": [y], "Mask": [mask.astype(jnp.uint8)]}
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+def _embed_infer(op):
+    ids, w = op.invar("Ids"), op.invar("W")
+    if ids is None or w is None or ids.shape is None or w.shape is None:
+        return
+    idshape = ids.shape
+    if op.type == "lookup_table" and idshape and idshape[-1] == 1:
+        idshape = idshape[:-1]
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(idshape) + (w.shape[-1],),
+                            dtype=w.dtype)
+
+
+def _lookup(ctx, ins, attrs, squeeze_last):
+    ids, w = x(ins, "Ids"), x(ins, "W")
+    if squeeze_last and ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    pad = attrs.get("padding_idx", -1)
+    r = jnp.take(w, ids.astype(jnp.int32), axis=0)
+    if pad is not None and pad != -1:
+        r = jnp.where((ids == pad)[..., None], 0.0, r)
+    return out(r)
+
+
+register("lookup_table_v2",
+         lambda ctx, ins, attrs: _lookup(ctx, ins, attrs, False),
+         infer_shape=_embed_infer, no_grad_slots=("Ids",),
+         attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False})
+register("lookup_table",
+         lambda ctx, ins, attrs: _lookup(ctx, ins, attrs, True),
+         infer_shape=_embed_infer, no_grad_slots=("Ids",),
+         attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False})
+
+
+@register("one_hot_v2", grad=None, attrs={"depth": -1, "dtype": "float32",
+                                          "allow_out_of_range": False})
+def _one_hot(ctx, ins, attrs):
+    ids = x(ins)
+    return out(jax.nn.one_hot(ids.astype(jnp.int32), attrs["depth"],
+                              dtype=jnp.dtype(attrs.get("dtype", "float32"))))
+
+
+register("one_hot", lambda ctx, ins, attrs: _one_hot(ctx, ins, attrs),
+         grad=None, attrs={"depth": -1, "dtype": "float32",
+                           "allow_out_of_range": False})
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+
+@register("label_smooth", attrs={"epsilon": 0.1})
+def _label_smooth(ctx, ins, attrs):
+    lab = x(ins)
+    eps = attrs["epsilon"]
+    prior = x(ins, "PriorDist")
+    k = lab.shape[-1]
+    if prior is None:
+        return out((1 - eps) * lab + eps / k)
+    return out((1 - eps) * lab + eps * prior)
+
+
+@register("pad", attrs={"paddings": [], "pad_value": 0.0})
+def _pad(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs["paddings"]
+    cfg = [(p[2 * i], p[2 * i + 1]) for i in range(v.ndim)]
+    return out(jnp.pad(v, cfg, constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register("pad2d", attrs={"paddings": [0, 0, 0, 0], "mode": "constant",
+                          "pad_value": 0.0, "data_format": "NCHW"})
+def _pad2d(ctx, ins, attrs):
+    v = x(ins)
+    p = attrs["paddings"]
+    mode = {"constant": "constant", "reflect": "reflect",
+            "edge": "edge"}[attrs.get("mode", "constant")]
+    cfg = ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3]))
+    if mode == "constant":
+        return out(jnp.pad(v, cfg, constant_values=attrs.get("pad_value", 0.0)))
+    return out(jnp.pad(v, cfg, mode=mode))
+
+
+@register("interp_nearest", grad="auto",
+          attrs={"out_h": -1, "out_w": -1, "scale": 0.0,
+                 "data_layout": "NCHW", "align_corners": False})
+def _interp_nearest(ctx, ins, attrs):
+    v = x(ins)
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    if oh <= 0:
+        oh = int(v.shape[2] * attrs["scale"])
+        ow = int(v.shape[3] * attrs["scale"])
+    return out(jax.image.resize(v, v.shape[:2] + (oh, ow), method="nearest"))

@@ -1,0 +1,295 @@
+"""Optimizer + gradient-utility ops.
+
+Replaces reference operators/optimizers/* (sgd, momentum, adam, adamw, lamb,
+rmsprop, adagrad, ...) and grad utilities (clip_by_norm, amp ops,
+coalesce_tensor — SURVEY §2.3). Each op is functional: it returns the updated
+param/accumulator arrays; the executor donates the old buffers so the update
+is in-place on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register, same_shape_as
+from .common import x
+
+
+def _lr(ins):
+    v = x(ins, "LearningRate")
+    return v.reshape(()) if v is not None and getattr(v, "ndim", 0) else v
+
+
+@register("sgd", grad=None, no_grad_slots=("Param", "Grad", "LearningRate"))
+def _sgd(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+
+
+@register("momentum", grad=None, attrs={"mu": 0.9, "use_nesterov": False,
+                                        "regularization_method": "",
+                                        "regularization_coeff": 0.0})
+def _momentum(ctx, ins, attrs):
+    p, g, v = x(ins, "Param"), x(ins, "Grad"), x(ins, "Velocity")
+    lr = _lr(ins)
+    mu = attrs["mu"]
+    if attrs.get("regularization_method") == "l2_decay":
+        g = g + attrs["regularization_coeff"] * p
+    v_new = mu * v + g
+    if attrs.get("use_nesterov"):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register("adam", grad=None,
+          attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                 "lazy_mode": False, "min_row_size_to_use_multithread": 1000})
+def _adam(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow"), x(ins, "Beta2Pow")
+    lr = _lr(ins)
+    b1 = x(ins, "Beta1Tensor")
+    b2 = x(ins, "Beta2Tensor")
+    b1 = attrs["beta1"] if b1 is None else b1.reshape(())
+    b2 = attrs["beta2"] if b2 is None else b2.reshape(())
+    eps = attrs["epsilon"]
+    g = g.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    b1pn, b2pn = b1p * b1, b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2pn.reshape(())) / (1 - b1pn.reshape(()))
+    p_new = p - lr_t * (m1n / (jnp.sqrt(m2n) + eps)).astype(p.dtype)
+    return {"ParamOut": [p_new], "Moment1Out": [m1n], "Moment2Out": [m2n],
+            "Beta1PowOut": [b1pn], "Beta2PowOut": [b2pn]}
+
+
+@register("adamw", grad=None,
+          attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                 "coeff": 0.01, "lr_ratio": 1.0, "with_decay": True,
+                 "lazy_mode": False})
+def _adamw(ctx, ins, attrs):
+    p = x(ins, "Param")
+    lr = _lr(ins)
+    if attrs.get("with_decay", True):
+        p = p * (1.0 - lr * attrs["coeff"] * attrs.get("lr_ratio", 1.0))
+    ins2 = dict(ins)
+    ins2["Param"] = [p]
+    return _adam(ctx, ins2, attrs)
+
+
+@register("adamax", grad=None,
+          attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8})
+def _adamax(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    m, inf = x(ins, "Moment"), x(ins, "InfNorm")
+    b1p = x(ins, "Beta1Pow")
+    lr = _lr(ins)
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p.reshape(()))
+    p_new = p - lr_t * m_new / (inf_new + eps)
+    return {"ParamOut": [p_new], "MomentOut": [m_new], "InfNormOut": [inf_new]}
+
+
+@register("adagrad", grad=None, attrs={"epsilon": 1e-6})
+def _adagrad(ctx, ins, attrs):
+    p, g, mom = x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment")
+    lr = _lr(ins)
+    mom_new = mom + jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(mom_new) + attrs["epsilon"])
+    return {"ParamOut": [p_new], "MomentOut": [mom_new]}
+
+
+@register("adadelta", grad=None, attrs={"rho": 0.95, "epsilon": 1e-6})
+def _adadelta(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    avg_sq, avg_upd = x(ins, "AvgSquaredGrad"), x(ins, "AvgSquaredUpdate")
+    rho, eps = attrs["rho"], attrs["epsilon"]
+    sq = rho * avg_sq + (1 - rho) * jnp.square(g)
+    upd = g * jnp.sqrt(avg_upd + eps) / jnp.sqrt(sq + eps)
+    upd_acc = rho * avg_upd + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [p - upd], "AvgSquaredGradOut": [sq],
+            "AvgSquaredUpdateOut": [upd_acc]}
+
+
+@register("rmsprop", grad=None,
+          attrs={"epsilon": 1e-10, "decay": 0.9, "momentum": 0.0,
+                 "centered": False})
+def _rmsprop(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    ms, mom = x(ins, "MeanSquare"), x(ins, "Moment")
+    mg = x(ins, "MeanGrad")
+    lr = _lr(ins)
+    rho, eps, mu = attrs["decay"], attrs["epsilon"], attrs["momentum"]
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered"):
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - jnp.square(mg_new) + eps
+    else:
+        mg_new = mg
+        denom = ms_new + eps
+    mom_new = mu * mom + lr * g / jnp.sqrt(denom)
+    outs = {"ParamOut": [p - mom_new], "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new]}
+    if mg is not None:
+        outs["MeanGradOut"] = [mg_new]
+    return outs
+
+
+@register("lamb", grad=None,
+          attrs={"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-6,
+                 "weight_decay": 0.01})
+def _lamb(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
+    b1p, b2p = x(ins, "Beta1Pow"), x(ins, "Beta2Pow")
+    lr = _lr(ins)
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    wd = attrs["weight_decay"]
+    g = g.astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    mhat = m1n / (1 - b1p.reshape(()))
+    vhat = m2n / (1 - b2p.reshape(()))
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p.astype(jnp.float32)
+    p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = p - (lr * trust * r).astype(p.dtype)
+    return {"ParamOut": [p_new], "Moment1Out": [m1n], "Moment2Out": [m2n],
+            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+
+
+@register("ftrl", grad=None, attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5})
+def _ftrl(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    sq, lin = x(ins, "SquaredAccumulator"), x(ins, "LinearAccumulator")
+    lr = _lr(ins)
+    l1, l2, lrp = attrs["l1"], attrs["l2"], attrs["lr_power"]
+    new_sq = sq + jnp.square(g)
+    sigma = (new_sq ** -lrp - sq ** -lrp) / lr
+    new_lin = lin + g - sigma * p
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    denom = new_sq ** -lrp / lr + 2 * l2
+    return {"ParamOut": [pre / denom], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register("dpsgd", grad=None, stochastic=True,
+          attrs={"clip": 10.0, "batch_size": 16.0, "sigma": 1.0})
+def _dpsgd(ctx, ins, attrs):
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    lr = _lr(ins)
+    gn = jnp.linalg.norm(g)
+    scale = jnp.minimum(1.0, attrs["clip"] / jnp.maximum(gn, 1e-12))
+    noise = jax.random.normal(ctx.rng(attrs), g.shape) * \
+        attrs["sigma"] * attrs["clip"] / attrs["batch_size"]
+    return {"ParamOut": [p - lr * (g * scale + noise)]}
+
+
+@register("decayed_adagrad", grad=None,
+          attrs={"decay": 0.95, "epsilon": 1e-6})
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment")
+    lr = _lr(ins)
+    mom_new = attrs["decay"] * mom + (1 - attrs["decay"]) * jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(mom_new) + attrs["epsilon"])],
+            "MomentOut": [mom_new]}
+
+
+# ---------------------------------------------------------------------------
+# gradient utilities
+# ---------------------------------------------------------------------------
+
+@register("clip_by_norm", attrs={"max_norm": 1.0},
+          infer_shape=same_shape_as("X"))
+def _clip_by_norm(ctx, ins, attrs):
+    v = x(ins)
+    n = jnp.sqrt(jnp.sum(jnp.square(v)))
+    mx = attrs["max_norm"]
+    return {"Out": [jnp.where(n > mx, v * (mx / jnp.maximum(n, 1e-12)), v)]}
+
+
+@register("lerp")
+def _lerp(ctx, ins, attrs):
+    a, b, w = x(ins, "X"), x(ins, "Y"), x(ins, "Weight")
+    return {"Out": [a + w * (b - a)]}
+
+
+@register("check_finite_and_unscale", grad=None)
+def _check_finite_and_unscale(ctx, ins, attrs):
+    """AMP: outs = ins/scale; FoundInfinite = any nonfinite
+    (reference operators/amp/check_finite_and_unscale_op.cc)."""
+    scale = x(ins, "Scale").reshape(())
+    xs = ins.get("X", [])
+    found = jnp.zeros((), dtype=bool)
+    outs = []
+    for v in xs:
+        found = found | ~jnp.all(jnp.isfinite(v))
+        outs.append(v / scale)
+    return {"Out": outs, "FoundInfinite": [found.reshape((1,))]}
+
+
+@register("update_loss_scaling", grad=None,
+          attrs={"incr_every_n_steps": 1000, "decr_every_n_nan_or_inf": 2,
+                 "incr_ratio": 2.0, "decr_ratio": 0.5,
+                 "stop_update": False})
+def _update_loss_scaling(ctx, ins, attrs):
+    """AMP dynamic loss-scale state machine
+    (reference operators/amp/update_loss_scaling_op.cc)."""
+    found = x(ins, "FoundInfinite").reshape(()).astype(bool)
+    scale = x(ins, "PrevLossScaling").reshape(())
+    good = x(ins, "InGoodSteps").reshape(()).astype(jnp.int32)
+    bad = x(ins, "InBadSteps").reshape(()).astype(jnp.int32)
+    incr_n = attrs["incr_every_n_steps"]
+    decr_n = attrs["decr_every_n_nan_or_inf"]
+    bad_new = jnp.where(found, bad + 1, 0)
+    good_new = jnp.where(found, 0, good + 1)
+    scale_up = good_new >= incr_n
+    scale_dn = bad_new >= decr_n
+    new_scale = jnp.where(
+        scale_dn, jnp.maximum(scale * attrs["decr_ratio"], 1.0),
+        jnp.where(scale_up, scale * attrs["incr_ratio"], scale))
+    good_new = jnp.where(scale_up, 0, good_new)
+    bad_new = jnp.where(scale_dn, 0, bad_new)
+    outs = []
+    for v in ins.get("X", []):
+        outs.append(jnp.where(found, jnp.zeros_like(v), v))
+    return {"Out": outs, "LossScaling": [new_scale.reshape((1,))],
+            "OutGoodSteps": [good_new.reshape((1,))],
+            "OutBadSteps": [bad_new.reshape((1,))]}
+
+
+@register("coalesce_tensor", grad=None,
+          attrs={"copy_data": True, "use_align": True, "dtype": "float32"})
+def _coalesce_tensor(ctx, ins, attrs):
+    """Fuse N tensors into one flat buffer (reference coalesce_tensor_op.cc).
+    Under XLA this is only needed for API parity — fusion of collectives is
+    handled by the compiler."""
+    xs = ins.get("Input", [])
+    flat = jnp.concatenate([v.reshape(-1) for v in xs])
+    outs = []
+    off = 0
+    for v in xs:
+        outs.append(flat[off:off + v.size].reshape(v.shape))
+        off += v.size
+    return {"Output": outs, "FusedOutput": [flat]}
+
+
+@register("average_accumulates", grad=None,
+          attrs={"average_window": 10000.0, "max_average_window": 10000,
+                 "min_average_window": 10000})
+def _average_accumulates(ctx, ins, attrs):
+    param = x(ins, "param")
+    s1 = x(ins, "in_sum_1")
+    n = x(ins, "in_num_accumulates").reshape(()).astype(jnp.int64)
+    return {"out_sum_1": [s1 + param],
+            "out_sum_2": [x(ins, "in_sum_2")],
+            "out_sum_3": [x(ins, "in_sum_3")],
+            "out_num_accumulates": [(n + 1).reshape((1,))],
+            "out_old_num_accumulates": [x(ins, "in_old_num_accumulates")],
+            "out_num_updates": [x(ins, "in_num_updates")]}

@@ -1,0 +1,122 @@
+"""Random ops — threefry-keyed, per-op stable streams.
+
+Replaces reference operators/{gaussian,uniform,truncated_gaussian}_random,
+randint, randperm, bernoulli (SURVEY §2.3 "Fill/random") and the Philox
+Generator (framework/generator.h). The executor hands every stochastic op a
+key folded from (step_key, op._rng_id) so runs are reproducible under
+program.random_seed and identical between forward and auto-vjp grad replay.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register
+from .common import x, out, np_dtype
+
+
+def _shape_from(ins, attrs):
+    st = x(ins, "ShapeTensor")
+    if st is not None:
+        return [int(s) for s in np.asarray(st)]
+    return list(attrs.get("shape", []))
+
+
+def _rand_infer(op):
+    shape = tuple(op.attr("shape", []))
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape,
+                            dtype=op.attr("dtype", "float32"))
+
+
+@register("gaussian_random", grad=None, stochastic=True,
+          infer_shape=_rand_infer,
+          attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                 "dtype": "float32"})
+def _gaussian(ctx, ins, attrs):
+    shape = _shape_from(ins, attrs)
+    r = jax.random.normal(ctx.rng(attrs), shape,
+                          dtype=np_dtype(attrs["dtype"]))
+    return out(r * attrs["std"] + attrs["mean"])
+
+
+@register("uniform_random", grad=None, stochastic=True,
+          infer_shape=_rand_infer,
+          attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                 "dtype": "float32"})
+def _uniform(ctx, ins, attrs):
+    shape = _shape_from(ins, attrs)
+    return out(jax.random.uniform(
+        ctx.rng(attrs), shape, dtype=np_dtype(attrs["dtype"]),
+        minval=attrs["min"], maxval=attrs["max"]))
+
+
+@register("uniform_random_batch_size_like", grad=None, stochastic=True,
+          attrs={"shape": [], "min": -1.0, "max": 1.0, "seed": 0,
+                 "dtype": "float32", "input_dim_idx": 0, "output_dim_idx": 0})
+def _uniform_bsl(ctx, ins, attrs):
+    v = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs["output_dim_idx"]] = v.shape[attrs["input_dim_idx"]]
+    return out(jax.random.uniform(
+        ctx.rng(attrs), shape, dtype=np_dtype(attrs["dtype"]),
+        minval=attrs["min"], maxval=attrs["max"]))
+
+
+@register("truncated_gaussian_random", grad=None, stochastic=True,
+          infer_shape=_rand_infer,
+          attrs={"shape": [], "mean": 0.0, "std": 1.0, "seed": 0,
+                 "dtype": "float32"})
+def _trunc_gaussian(ctx, ins, attrs):
+    r = jax.random.truncated_normal(
+        ctx.rng(attrs), -2.0, 2.0, attrs["shape"],
+        dtype=np_dtype(attrs["dtype"]))
+    return out(r * attrs["std"] + attrs["mean"])
+
+
+@register("randint", grad=None, stochastic=True, infer_shape=_rand_infer,
+          attrs={"shape": [], "low": 0, "high": 100, "seed": 0,
+                 "dtype": "int64"})
+def _randint(ctx, ins, attrs):
+    return out(jax.random.randint(
+        ctx.rng(attrs), _shape_from(ins, attrs), attrs["low"], attrs["high"],
+        dtype=np_dtype(attrs["dtype"])))
+
+
+@register("randperm", grad=None, stochastic=True,
+          attrs={"n": 0, "seed": 0, "dtype": "int64"})
+def _randperm(ctx, ins, attrs):
+    return out(jax.random.permutation(ctx.rng(attrs), attrs["n"])
+               .astype(np_dtype(attrs["dtype"])))
+
+
+@register("bernoulli", grad=None, stochastic=True)
+def _bernoulli(ctx, ins, attrs):
+    v = x(ins)
+    return out(jax.random.bernoulli(ctx.rng(attrs), v).astype(v.dtype))
+
+
+@register("multinomial", grad=None, stochastic=True,
+          attrs={"num_samples": 1, "replacement": False})
+def _multinomial(ctx, ins, attrs):
+    v = x(ins)
+    logits = jnp.log(jnp.clip(v, 1e-20, None))
+    n = attrs["num_samples"]
+    return out(jax.random.categorical(
+        ctx.rng(attrs), logits, axis=-1,
+        shape=(n,) + logits.shape[:-1]).T.astype(jnp.int64))
+
+
+@register("sampling_id", grad=None, stochastic=True,
+          attrs={"min": 0.0, "max": 1.0, "seed": 0})
+def _sampling_id(ctx, ins, attrs):
+    v = x(ins)
+    logits = jnp.log(jnp.clip(v, 1e-20, None))
+    return out(jax.random.categorical(ctx.rng(attrs), logits, axis=-1))
+
+
+@register("seed", grad=None, attrs={"seed": 0})
+def _seed(ctx, ins, attrs):
+    return out(jnp.asarray([attrs["seed"]], dtype=jnp.int32))

@@ -1,0 +1,699 @@
+"""Tensor manipulation + fill ops.
+
+Replaces reference operators: reshape/squeeze/unsqueeze/transpose/concat/
+split/stack/slice/gather/scatter/expand/tile/... and fill_constant family
+(/root/reference/paddle/fluid/operators/, SURVEY §2.3 "Tensor manipulation").
+XLA handles these as free layout ops or fused gathers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register, same_shape_as
+from .common import x, out, np_dtype
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+def _resolve_shape(shape, total):
+    shape = list(shape)
+    if -1 in shape:
+        i = shape.index(-1)
+        known = int(np.prod([s for s in shape if s != -1])) or 1
+        shape[i] = total // known
+    return shape
+
+
+def _reshape_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    shape = list(op.attr("shape", []))
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = v.shape[i]
+    if -1 in shape and all(s >= 0 for s in v.shape):
+        total = int(np.prod(v.shape))
+        shape = _resolve_shape(shape, total)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=v.dtype)
+
+
+def _reshape(ctx, ins, attrs):
+    v = x(ins)
+    st = x(ins, "ShapeTensor") or x(ins, "Shape")
+    shape = list(attrs.get("shape", []))
+    if st is not None:
+        shape = [int(s) for s in np.asarray(st)]
+    shape = [v.shape[i] if s == 0 else s for i, s in enumerate(shape)] \
+        if 0 in shape else shape
+    return {"Out": [v.reshape(shape)], "XShape": [None]}
+
+
+register("reshape2", _reshape, infer_shape=_reshape_infer,
+         attrs={"shape": []}, no_grad_out_slots=("XShape",))
+register("reshape", _reshape, infer_shape=_reshape_infer, attrs={"shape": []})
+
+
+def _transpose_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    perm = op.attr("axis", [])
+    shape = tuple(v.shape[p] for p in perm)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape, dtype=v.dtype)
+
+
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(x(ins), attrs["axis"])], "XShape": [None]}
+
+
+register("transpose2", _transpose, infer_shape=_transpose_infer,
+         attrs={"axis": []}, no_grad_out_slots=("XShape",))
+register("transpose", _transpose, infer_shape=_transpose_infer,
+         attrs={"axis": []})
+
+
+def _squeeze_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    axes = op.attr("axes", [])
+    if axes:
+        shape = tuple(s for i, s in enumerate(v.shape)
+                      if not (i in axes or i - v.ndim in axes) or s != 1)
+    else:
+        shape = tuple(s for s in v.shape if s != 1)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape, dtype=v.dtype)
+
+
+def _squeeze(ctx, ins, attrs):
+    v = x(ins)
+    axes = attrs.get("axes", [])
+    if not axes:
+        r = jnp.squeeze(v)
+    else:
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        r = jnp.squeeze(v, axis=axes) if axes else v
+    return {"Out": [r], "XShape": [None]}
+
+
+register("squeeze2", _squeeze, attrs={"axes": []},
+         infer_shape=_squeeze_infer, no_grad_out_slots=("XShape",))
+register("squeeze", _squeeze, attrs={"axes": []}, infer_shape=_squeeze_infer)
+
+
+def _unsqueeze_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    shape = list(v.shape)
+    for a in sorted(op.attr("axes", [])):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=v.dtype)
+
+
+def _unsqueeze(ctx, ins, attrs):
+    v = x(ins)
+    for a in sorted(attrs["axes"]):
+        v = jnp.expand_dims(v, a)
+    return {"Out": [v], "XShape": [None]}
+
+
+register("unsqueeze2", _unsqueeze, attrs={"axes": []},
+         infer_shape=_unsqueeze_infer, no_grad_out_slots=("XShape",))
+register("unsqueeze", _unsqueeze, attrs={"axes": []},
+         infer_shape=_unsqueeze_infer)
+
+
+def _flatten_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    if op.type.startswith("flatten_contiguous"):
+        start = op.attr("start_axis", 1)
+        stop = op.attr("stop_axis", -1) % len(v.shape)
+        mid = v.shape[start:stop + 1]
+        mid_n = -1 if any(s < 0 for s in mid) else int(np.prod(mid))
+        shape = v.shape[:start] + (mid_n,) + v.shape[stop + 1:]
+    else:
+        ax = op.attr("axis", 1)
+        lead, tail = v.shape[:ax], v.shape[ax:]
+        l = -1 if any(s < 0 for s in lead) else int(np.prod(lead)) if lead else 1
+        t = -1 if any(s < 0 for s in tail) else int(np.prod(tail)) if tail else 1
+        shape = (l, t)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape, dtype=v.dtype)
+
+
+def _flatten_range(ctx, ins, attrs):
+    v = x(ins)
+    start = attrs.get("start_axis", 1)
+    stop = attrs.get("stop_axis", -1) % v.ndim
+    shape = v.shape[:start] + (-1,) + v.shape[stop + 1:]
+    return {"Out": [v.reshape(shape)], "XShape": [None]}
+
+
+register("flatten_contiguous_range", _flatten_range,
+         attrs={"start_axis": 1, "stop_axis": -1},
+         infer_shape=_flatten_infer, no_grad_out_slots=("XShape",))
+
+
+def _flatten2(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis", 1)
+    r = v.reshape((int(np.prod(v.shape[:ax])) if ax else 1, -1))
+    return {"Out": [r], "XShape": [None]}
+
+
+register("flatten2", _flatten2, attrs={"axis": 1},
+         infer_shape=_flatten_infer, no_grad_out_slots=("XShape",))
+register("flatten", lambda ctx, ins, attrs: {"Out": _flatten2(ctx, ins, attrs)["Out"]},
+         attrs={"axis": 1}, infer_shape=_flatten_infer)
+
+
+# ---------------------------------------------------------------------------
+# concat / split / stack
+# ---------------------------------------------------------------------------
+
+def _concat_infer(op):
+    vs = [op.block._var_recursive(n) for n in op.input("X")]
+    if not vs or any(v is None or v.shape is None for v in vs):
+        return
+    ax = op.attr("axis", 0) % len(vs[0].shape)
+    shape = list(vs[0].shape)
+    shape[ax] = sum(v.shape[ax] for v in vs) \
+        if all(v.shape[ax] >= 0 for v in vs) else -1
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=vs[0].dtype)
+
+
+@register("concat", infer_shape=_concat_infer, attrs={"axis": 0})
+def _concat(ctx, ins, attrs):
+    ax = x(ins, "AxisTensor")
+    axis = int(np.asarray(ax)) if ax is not None else attrs["axis"]
+    return out(jnp.concatenate(ins["X"], axis=axis))
+
+
+def _split_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    ax = op.attr("axis", 0) % len(v.shape)
+    num = op.attr("num", 0)
+    sections = op.attr("sections", [])
+    names = op.output("Out")
+    if sections:
+        sizes = sections
+    else:
+        n = num or len(names)
+        sizes = [v.shape[ax] // n] * n if v.shape[ax] >= 0 else [-1] * n
+    for name, s in zip(names, sizes):
+        shape = list(v.shape)
+        shape[ax] = s
+        op.block.create_var(name=name, shape=tuple(shape), dtype=v.dtype)
+
+
+@register("split", infer_shape=_split_infer,
+          attrs={"axis": 0, "num": 0, "sections": []})
+def _split(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs["axis"]
+    sections = attrs.get("sections") or []
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        parts = jnp.split(v, idx, axis=ax)
+    else:
+        parts = jnp.split(v, attrs.get("num") or 1, axis=ax)
+    return {"Out": list(parts)}
+
+
+def _stack_infer(op):
+    vs = [op.block._var_recursive(n) for n in op.input("X")]
+    if not vs or any(v is None or v.shape is None for v in vs):
+        return
+    ax = op.attr("axis", 0)
+    shape = list(vs[0].shape)
+    shape.insert(ax if ax >= 0 else ax + len(shape) + 1, len(vs))
+    for name in op.output("Y"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=vs[0].dtype)
+
+
+@register("stack", infer_shape=_stack_infer, attrs={"axis": 0})
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs["axis"])]}
+
+
+@register("unstack", attrs={"axis": 0, "num": 0})
+def _unstack(ctx, ins, attrs):
+    v = x(ins)
+    parts = [jnp.squeeze(p, attrs["axis"])
+             for p in jnp.split(v, v.shape[attrs["axis"]], axis=attrs["axis"])]
+    return {"Y": parts}
+
+
+# ---------------------------------------------------------------------------
+# slicing / gather / scatter
+# ---------------------------------------------------------------------------
+
+def _slice_infer(op):
+    v = op.invar("Input")
+    if v is None or v.shape is None:
+        return
+    axes = op.attr("axes", [])
+    starts, ends = op.attr("starts", []), op.attr("ends", [])
+    shape = list(v.shape)
+    for a, s, e in zip(axes, starts, ends):
+        if shape[a] < 0:
+            continue
+        s2 = s if s >= 0 else s + shape[a]
+        e2 = min(e if e >= 0 else e + shape[a], shape[a])
+        shape[a] = max(e2 - s2, 0)
+    for d in sorted(op.attr("decrease_axis", []), reverse=True):
+        shape.pop(d)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=v.dtype)
+
+
+@register("slice", infer_shape=_slice_infer,
+          attrs={"axes": [], "starts": [], "ends": [], "decrease_axis": [],
+                 "infer_flags": []})
+def _slice(ctx, ins, attrs):
+    v = x(ins, "Input")
+    idx = [slice(None)] * v.ndim
+    for a, s, e in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        idx[a] = slice(s, e)
+    r = v[tuple(idx)]
+    dec = attrs.get("decrease_axis", [])
+    if dec:
+        r = r.reshape([d for i, d in enumerate(r.shape) if i not in dec])
+    return out(r)
+
+
+@register("strided_slice",
+          attrs={"axes": [], "starts": [], "ends": [], "strides": [],
+                 "infer_flags": [], "decrease_axis": []})
+def _strided_slice(ctx, ins, attrs):
+    v = x(ins, "Input")
+    idx = [slice(None)] * v.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs["strides"]):
+        idx[a] = slice(s, e, st)
+    return out(v[tuple(idx)])
+
+
+def _gather_infer(op):
+    v, ids = op.invar("X"), op.invar("Index")
+    if v is None or v.shape is None or ids is None or ids.shape is None:
+        return
+    shape = tuple(list(ids.shape[:1]) + list(v.shape[1:]))
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape, dtype=v.dtype)
+
+
+@register("gather", infer_shape=_gather_infer, no_grad_slots=("Index",),
+          attrs={"axis": 0})
+def _gather(ctx, ins, attrs):
+    v, idx = x(ins), x(ins, "Index")
+    ax = x(ins, "Axis")
+    axis = int(np.asarray(ax)) if ax is not None else attrs.get("axis", 0)
+    if idx.ndim == 2 and idx.shape[1] == 1:
+        idx = idx[:, 0]
+    return out(jnp.take(v, idx.astype(jnp.int32), axis=axis))
+
+
+@register("gather_nd", no_grad_slots=("Index",))
+def _gather_nd(ctx, ins, attrs):
+    v, idx = x(ins), x(ins, "Index")
+    idx = idx.astype(jnp.int32)
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    return out(v[flat_idx])
+
+
+@register("index_select", no_grad_slots=("Index",), attrs={"dim": 0})
+def _index_select(ctx, ins, attrs):
+    v, idx = x(ins), x(ins, "Index")
+    return out(jnp.take(v, idx.astype(jnp.int32), axis=attrs["dim"]))
+
+
+@register("index_sample", no_grad_slots=("Index",))
+def _index_sample(ctx, ins, attrs):
+    v, idx = x(ins), x(ins, "Index")
+    return out(jnp.take_along_axis(v, idx.astype(jnp.int32), axis=1))
+
+
+@register("scatter", no_grad_slots=("Ids",), attrs={"overwrite": True})
+def _scatter(ctx, ins, attrs):
+    v, ids, upd = x(ins), x(ins, "Ids"), x(ins, "Updates")
+    ids = ids.astype(jnp.int32)
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if attrs.get("overwrite", True):
+        return out(v.at[ids].set(upd))
+    return out(v.at[ids].add(upd))
+
+
+@register("scatter_nd_add", no_grad_slots=("Index",))
+def _scatter_nd_add(ctx, ins, attrs):
+    v, idx, upd = x(ins), x(ins, "Index"), x(ins, "Updates")
+    idx = idx.astype(jnp.int32)
+    k = idx.shape[-1]
+    return out(v.at[tuple(idx[..., i] for i in range(k))].add(upd))
+
+
+@register("where", no_grad_slots=("Condition",))
+def _where(ctx, ins, attrs):
+    return out(jnp.where(x(ins, "Condition"), x(ins, "X"), x(ins, "Y")))
+
+
+@register("masked_fill", no_grad_slots=("Mask",), attrs={"value": 0.0})
+def _masked_fill(ctx, ins, attrs):
+    return out(jnp.where(x(ins, "Mask"), attrs["value"], x(ins, "X")))
+
+
+# ---------------------------------------------------------------------------
+# expand / tile / repeat
+# ---------------------------------------------------------------------------
+
+def _expand_v2_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    shape = list(op.attr("shape", []))
+    nd = len(shape)
+    xs = [1] * (nd - len(v.shape)) + list(v.shape)
+    final = [xs[i] if shape[i] == -1 else shape[i] for i in range(nd)]
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(final), dtype=v.dtype)
+
+
+@register("expand_v2", infer_shape=_expand_v2_infer, attrs={"shape": []})
+def _expand_v2(ctx, ins, attrs):
+    v = x(ins)
+    shape = list(attrs["shape"])
+    xs = [1] * (len(shape) - v.ndim) + list(v.shape)
+    v = v.reshape(xs)
+    final = [xs[i] if s == -1 else s for i, s in enumerate(shape)]
+    return out(jnp.broadcast_to(v, final))
+
+
+@register("expand", attrs={"expand_times": []})
+def _expand(ctx, ins, attrs):
+    return out(jnp.tile(x(ins), attrs["expand_times"]))
+
+
+@register("tile", attrs={"repeat_times": []})
+def _tile(ctx, ins, attrs):
+    return out(jnp.tile(x(ins), attrs["repeat_times"]))
+
+
+@register("expand_as_v2", no_grad_slots=("target_tensor", "Y"))
+def _expand_as(ctx, ins, attrs):
+    tgt = x(ins, "target_tensor")
+    if tgt is None:
+        tgt = x(ins, "Y")
+    return out(jnp.broadcast_to(x(ins), tgt.shape))
+
+
+# ---------------------------------------------------------------------------
+# fill / creation ops
+# ---------------------------------------------------------------------------
+
+def _fill_constant_infer(op):
+    shape = tuple(op.attr("shape", []))
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape,
+                            dtype=op.attr("dtype", "float32"))
+
+
+@register("fill_constant", grad=None, infer_shape=_fill_constant_infer,
+          attrs={"shape": [], "value": 0.0, "dtype": "float32",
+                 "force_cpu": False})
+def _fill_constant(ctx, ins, attrs):
+    st = x(ins, "ShapeTensor")
+    shape = [int(s) for s in np.asarray(st)] if st is not None \
+        else list(attrs["shape"])
+    vt = x(ins, "ValueTensor")
+    value = vt if vt is not None else attrs["value"]
+    return out(jnp.full(shape, value, dtype=np_dtype(attrs["dtype"])))
+
+
+@register("fill_zeros_like", grad=None, infer_shape=same_shape_as("X"))
+def _fill_zeros_like(ctx, ins, attrs):
+    return out(jnp.zeros_like(x(ins)))
+
+
+@register("fill_any_like", grad=None, infer_shape=same_shape_as("X"),
+          attrs={"value": 0.0, "dtype": -1})
+def _fill_any_like(ctx, ins, attrs):
+    v = x(ins)
+    dt = attrs.get("dtype", -1)
+    dtype = v.dtype if dt in (-1, None) else np_dtype(dt)
+    return out(jnp.full(v.shape, attrs["value"], dtype=dtype))
+
+
+@register("assign", infer_shape=same_shape_as("X"))
+def _assign(ctx, ins, attrs):
+    return out(x(ins))
+
+
+@register("assign_value", grad=None, infer_shape=_fill_constant_infer,
+          attrs={"shape": [], "dtype": "float32", "fp32_values": [],
+                 "int32_values": [], "int64_values": [], "bool_values": []})
+def _assign_value(ctx, ins, attrs):
+    vals = attrs.get("fp32_values") or attrs.get("int32_values") or \
+        attrs.get("int64_values") or attrs.get("bool_values")
+    return out(jnp.asarray(
+        np.array(vals, dtype=np_dtype(attrs["dtype"])).reshape(attrs["shape"])))
+
+
+@register("shape", grad=None)
+def _shape(ctx, ins, attrs):
+    v = x(ins, "Input")
+    return out(jnp.asarray(v.shape, dtype=jnp.int32))
+
+
+@register("eye", grad=None, attrs={"num_rows": 0, "num_columns": -1,
+                                   "dtype": "float32"})
+def _eye(ctx, ins, attrs):
+    nc = attrs["num_columns"]
+    return out(jnp.eye(attrs["num_rows"], nc if nc > 0 else None,
+                       dtype=np_dtype(attrs["dtype"])))
+
+
+@register("linspace", grad=None, attrs={"dtype": "float32"})
+def _linspace(ctx, ins, attrs):
+    start = x(ins, "Start")
+    stop = x(ins, "Stop")
+    num = int(np.asarray(x(ins, "Num")))
+    return out(jnp.linspace(jnp.reshape(start, ()), jnp.reshape(stop, ()),
+                            num, dtype=np_dtype(attrs["dtype"])))
+
+
+@register("range", grad=None)
+def _range(ctx, ins, attrs):
+    s = np.asarray(x(ins, "Start")).item()
+    e = np.asarray(x(ins, "End")).item()
+    st = np.asarray(x(ins, "Step")).item()
+    return out(jnp.arange(s, e, st))
+
+
+@register("cast", infer_shape=None, attrs={"in_dtype": "float32",
+                                           "out_dtype": "float32"})
+def _cast(ctx, ins, attrs):
+    v = x(ins)
+    return out(v.astype(np_dtype(attrs["out_dtype"])))
+
+
+def _cast_infer(op):
+    v = op.invar("X")
+    if v is None:
+        return
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=v.shape,
+                            dtype=op.attr("out_dtype", "float32"))
+
+
+from .. import registry as _registry
+_registry._REGISTRY["cast"].infer_shape = _cast_infer
+
+
+# ---------------------------------------------------------------------------
+# search / sort (non-differentiable outputs are ints)
+# ---------------------------------------------------------------------------
+
+def _argminmax_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    ax = op.attr("axis", -1) % len(v.shape)
+    shape = list(v.shape)
+    if op.attr("keepdims", False):
+        shape[ax] = 1
+    else:
+        shape.pop(ax)
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape),
+                            dtype=op.attr("dtype", "int64"))
+
+
+@register("arg_max", grad=None, infer_shape=_argminmax_infer,
+          attrs={"axis": -1, "keepdims": False, "dtype": "int64",
+                 "flatten": False})
+def _arg_max(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("flatten"):
+        v = v.reshape(-1)
+    r = jnp.argmax(v, axis=attrs["axis"], keepdims=attrs.get("keepdims", False))
+    return out(r.astype(np_dtype(attrs.get("dtype", "int64"))))
+
+
+@register("arg_min", grad=None, infer_shape=_argminmax_infer,
+          attrs={"axis": -1, "keepdims": False, "dtype": "int64",
+                 "flatten": False})
+def _arg_min(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("flatten"):
+        v = v.reshape(-1)
+    r = jnp.argmin(v, axis=attrs["axis"], keepdims=attrs.get("keepdims", False))
+    return out(r.astype(np_dtype(attrs.get("dtype", "int64"))))
+
+
+@register("argsort", grad=None, attrs={"axis": -1, "descending": False})
+def _argsort(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs["axis"]
+    idx = jnp.argsort(-v if attrs["descending"] else v, axis=ax)
+    srt = jnp.take_along_axis(v, idx, axis=ax)
+    return {"Out": [srt], "Indices": [idx.astype(jnp.int64)]}
+
+
+def _topk_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    k = op.attr("k", 1)
+    ax = op.attr("axis", -1) % len(v.shape)
+    shape = list(v.shape)
+    shape[ax] = k
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype=v.dtype)
+    for name in op.output("Indices"):
+        op.block.create_var(name=name, shape=tuple(shape), dtype="int64")
+
+
+def _topk(ctx, ins, attrs):
+    v = x(ins)
+    kt = x(ins, "K")
+    k = int(np.asarray(kt)) if kt is not None else attrs.get("k", 1)
+    ax = attrs.get("axis", -1)
+    if ax not in (-1, v.ndim - 1):
+        v2 = jnp.moveaxis(v, ax, -1)
+        vals, idx = jax.lax.top_k(v2, k)
+        if attrs.get("largest", True) is False:
+            vals, idx = jax.lax.top_k(-v2, k)
+            vals = -vals
+        return {"Out": [jnp.moveaxis(vals, -1, ax)],
+                "Indices": [jnp.moveaxis(idx, -1, ax).astype(jnp.int64)]}
+    if attrs.get("largest", True) is False:
+        vals, idx = jax.lax.top_k(-v, k)
+        vals = -vals
+    else:
+        vals, idx = jax.lax.top_k(v, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+register("top_k", _topk, infer_shape=_topk_infer,
+         attrs={"k": 1, "axis": -1, "largest": True},
+         no_grad_out_slots=("Indices",))
+register("top_k_v2", _topk, infer_shape=_topk_infer,
+         attrs={"k": 1, "axis": -1, "largest": True, "sorted": True},
+         no_grad_out_slots=("Indices",))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+@register("flip", attrs={"axis": []})
+def _flip(ctx, ins, attrs):
+    return out(jnp.flip(x(ins), axis=tuple(attrs["axis"])))
+
+
+@register("roll", attrs={"shifts": [], "axis": []})
+def _roll(ctx, ins, attrs):
+    ax = attrs.get("axis") or None
+    return out(jnp.roll(x(ins), attrs["shifts"],
+                        axis=tuple(ax) if ax else None))
+
+
+@register("tril_triu", attrs={"diagonal": 0, "lower": True})
+def _tril_triu(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("lower", True):
+        return out(jnp.tril(v, attrs.get("diagonal", 0)))
+    return out(jnp.triu(v, attrs.get("diagonal", 0)))
+
+
+@register("meshgrid")
+def _meshgrid(ctx, ins, attrs):
+    return {"Out": list(jnp.meshgrid(*ins["X"], indexing="ij"))}
+
+
+@register("kron")
+def _kron(ctx, ins, attrs):
+    return out(jnp.kron(x(ins, "X"), x(ins, "Y")))
+
+
+@register("diag_v2", attrs={"offset": 0, "padding_value": 0.0})
+def _diag_v2(ctx, ins, attrs):
+    v = x(ins)
+    if v.ndim == 1:
+        r = jnp.diag(v, k=attrs["offset"])
+        pv = attrs.get("padding_value", 0.0)
+        if pv:
+            mask = jnp.diag(jnp.ones_like(v), k=attrs["offset"])
+            r = jnp.where(mask > 0, r, pv)
+        return out(r)
+    return out(jnp.diagonal(v, offset=attrs["offset"]))
+
+
+@register("unbind", attrs={"axis": 0})
+def _unbind(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs["axis"]
+    return {"Out": [jnp.squeeze(p, ax)
+                    for p in jnp.split(v, v.shape[ax], axis=ax)]}
+
+
+@register("unique", grad=None, attrs={"dtype": "int64"})
+def _unique(ctx, ins, attrs):
+    # static-shape constrained: returns padded unique with count
+    v = x(ins)
+    u, idx = jnp.unique(v, return_inverse=True, size=v.size)
+    return {"Out": [u], "Index": [idx.astype(jnp.int64)]}
+
+
+@register("shard_index", grad=None,
+          attrs={"index_num": 0, "nshards": 1, "shard_id": 0,
+                 "ignore_value": -1})
+def _shard_index(ctx, ins, attrs):
+    v = x(ins)
+    shard_size = (attrs["index_num"] + attrs["nshards"] - 1) // attrs["nshards"]
+    sid = attrs["shard_id"]
+    in_shard = (v // shard_size) == sid
+    return out(jnp.where(in_shard, v % shard_size, attrs["ignore_value"]))
+
+
+@register("increment", attrs={"step": 1.0})
+def _increment(ctx, ins, attrs):
+    return out(x(ins) + attrs["step"])

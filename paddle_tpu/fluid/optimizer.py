@@ -1,0 +1,647 @@
+"""Static-graph optimizers (reference python/paddle/fluid/optimizer.py:56).
+
+`minimize` = append_backward + apply_gradients (regularization → grad clip →
+per-param optimizer op). Optimizer ops are functional on TPU: the executor
+donates the old param/accumulator buffers, so updates are in-place on device.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers, unique_name
+from .backward import append_backward
+from .framework import (Parameter, Program, Variable, default_main_program,
+                        default_startup_program, in_dygraph_mode,
+                        program_guard)
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "Optimizer", "SGD", "SGDOptimizer", "Momentum", "MomentumOptimizer",
+    "Adagrad", "AdagradOptimizer", "Adam", "AdamOptimizer", "Adamax",
+    "AdamaxOptimizer", "RMSProp", "RMSPropOptimizer", "Adadelta",
+    "AdadeltaOptimizer", "Lamb", "LambOptimizer", "Ftrl", "FtrlOptimizer",
+    "DecayedAdagrad", "DecayedAdagradOptimizer", "ExponentialMovingAverage",
+    "RecomputeOptimizer", "GradientMergeOptimizer", "LookaheadOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = parameter_list
+        self.regularization = regularization
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators: dict[str, dict[str, Variable]] = {}
+        self._lr_var = None
+        self.type = getattr(self, "type", "sgd")
+
+    # -- learning rate -----------------------------------------------------
+    def _create_global_learning_rate(self):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        helper = LayerHelper("learning_rate")
+        self._lr_var = helper.create_global_variable(
+            name=unique_name.generate("learning_rate"), shape=[1],
+            dtype="float32", persistable=True,
+            value=float(self._learning_rate))
+
+    def _global_learning_rate(self):
+        return self._lr_var
+
+    @property
+    def learning_rate_var(self):
+        return self._lr_var
+
+    def current_step_lr(self):
+        return float(self._learning_rate) \
+            if not isinstance(self._learning_rate, Variable) else None
+
+    def set_lr(self, value):
+        from .executor import global_scope
+        import jax.numpy as jnp
+        self._learning_rate = value
+        if self._lr_var is not None:
+            global_scope().set(self._lr_var.name,
+                               jnp.full((1,), value, dtype=jnp.float32))
+
+    # -- accumulators --------------------------------------------------------
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        key = (name, param.name)
+        if name in self._accumulators and \
+                param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        helper = LayerHelper(name)
+        var = helper.create_global_variable(
+            name=unique_name.generate(f"{param.name}_{name}"),
+            shape=shape or list(param.shape), dtype=dtype or "float32",
+            persistable=True, value=float(fill_value))
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # -- main entry points ---------------------------------------------------
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        parameter_list = parameter_list or self._parameter_list
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        params_grads = [pg for pg in params_grads if pg[1] is not None]
+        # regularization
+        block = default_main_program().current_block()
+        if self.regularization is not None:
+            new_pg = []
+            for p, g in params_grads:
+                reg = p.regularizer or self.regularization
+                new_pg.append((p, reg(p, g, block) if reg else g))
+            params_grads = new_pg
+        else:
+            new_pg = []
+            for p, g in params_grads:
+                new_pg.append((p, p.regularizer(p, g, block)
+                               if p.regularizer else g))
+            params_grads = new_pg
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        return self._apply_optimize_ops(params_grads)
+
+    def _apply_optimize_ops(self, params_grads):
+        self._create_global_learning_rate()
+        self._create_accumulators(
+            default_main_program().current_block(),
+            [p for p, _ in params_grads])
+        ops = []
+        for p, g in params_grads:
+            ops.append(self._append_optimize_op(
+                default_main_program().current_block(), (p, g)))
+        self._finish_update(default_main_program().current_block(),
+                            params_grads)
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def _dygraph_minimize(self, loss, parameter_list=None, no_grad_set=None):
+        from .dygraph import base as dybase
+        params = parameter_list or self._parameter_list
+        if params is None:
+            raise ValueError("dygraph optimizer needs parameter_list "
+                             "(pass model.parameters())")
+        params_grads = [(p, p.grad) for p in params
+                        if p.trainable and p.grad is not None]
+        self._dygraph_apply(params_grads)
+        return None, params_grads
+
+    def _dygraph_apply(self, params_grads):
+        from .dygraph.tracer import eager_run_op
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            self._eager_update(p, g)
+
+    def _eager_update(self, p, g):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no dygraph update")
+
+    # subclass hooks
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    # -- state dict (dygraph parity) ----------------------------------------
+    def state_dict(self):
+        from .executor import global_scope
+        sd = {}
+        for name, per_param in self._accumulators.items():
+            for pname, var in per_param.items():
+                val = global_scope().find_var(var.name)
+                if val is not None:
+                    sd[var.name] = np.asarray(val)
+        return sd
+
+    def set_state_dict(self, sd):
+        from .executor import global_scope
+        import jax.numpy as jnp
+        for k, v in sd.items():
+            global_scope().set(k, jnp.asarray(v))
+
+    load_state_dict = set_state_dict
+
+
+class SGDOptimizer(Optimizer):
+    type = "sgd"
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="sgd",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name]})
+
+    def _eager_update(self, p, g):
+        lr = self._current_lr_value()
+        p._set_value(p.value() - lr * np.asarray(g.value()))
+
+    def _current_lr_value(self):
+        lr = self._learning_rate
+        return lr() if callable(lr) else float(lr)
+
+
+class MomentumOptimizer(Optimizer):
+    type = "momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, use_nesterov=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class AdagradOptimizer(Optimizer):
+    type = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self._epsilon})
+
+
+class AdamOptimizer(Optimizer):
+    type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=1.0,
+                                  shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=1.0,
+                                  shape=[1])
+
+    def _adam_inputs(self, p, g):
+        return {"Param": [p], "Grad": [g],
+                "LearningRate": [self._lr_var],
+                "Moment1": [self._get_accumulator("moment1", p)],
+                "Moment2": [self._get_accumulator("moment2", p)],
+                "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)],
+                "Beta2Pow": [self._get_accumulator("beta2_pow_acc", p)]}
+
+    def _adam_outputs(self, p):
+        return {"ParamOut": [p.name],
+                "Moment1Out": [self._get_accumulator("moment1", p).name],
+                "Moment2Out": [self._get_accumulator("moment2", p).name],
+                "Beta1PowOut": [self._get_accumulator("beta1_pow_acc", p).name],
+                "Beta2PowOut": [self._get_accumulator("beta2_pow_acc", p).name]}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="adam", inputs=self._adam_inputs(p, g),
+            outputs=self._adam_outputs(p),
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+
+class AdamaxOptimizer(Optimizer):
+    type = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1,
+                                  shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            type="adamax",
+            inputs={"Param": [p], "Grad": [g],
+                    "LearningRate": [self._lr_var],
+                    "Moment": [self._get_accumulator("moment", p)],
+                    "InfNorm": [self._get_accumulator("inf_norm", p)],
+                    "Beta1Pow": [self._get_accumulator("beta1_pow_acc", p)]},
+            outputs={"ParamOut": [p.name],
+                     "MomentOut": [self._get_accumulator("moment", p).name],
+                     "InfNormOut": [self._get_accumulator("inf_norm", p).name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon})
+
+    def _finish_update(self, block, params_grads):
+        for p, g in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            block.append_op(type="scale", inputs={"X": [b1p]},
+                            outputs={"Out": [b1p.name]},
+                            attrs={"scale": self._beta1})
+
+
+class RMSPropOptimizer(Optimizer):
+    type = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("momentum_acc", p)
+            self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("momentum_acc", p)
+        mg = self._get_accumulator("mean_grad", p)
+        return block.append_op(
+            type="rmsprop",
+            inputs={"Param": [p], "Grad": [g], "MeanSquare": [ms],
+                    "Moment": [mom], "MeanGrad": [mg],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "MeanSquareOut": [ms.name],
+                     "MomentOut": [mom.name], "MeanGradOut": [mg.name]},
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered})
+
+
+class AdadeltaOptimizer(Optimizer):
+    type = "adadelta"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            type="adadelta",
+            inputs={"Param": [p], "Grad": [g], "AvgSquaredGrad": [asg],
+                    "AvgSquaredUpdate": [asu]},
+            outputs={"ParamOut": [p.name], "AvgSquaredGradOut": [asg.name],
+                     "AvgSquaredUpdateOut": [asu.name]},
+            attrs={"rho": self._rho, "epsilon": self._epsilon})
+
+
+class LambOptimizer(AdamOptimizer):
+    type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 exclude_from_weight_decay_fn=None, **kwargs):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kwargs)
+        self._weight_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        wd = 0.0 if (self._exclude_fn and self._exclude_fn(p)) \
+            else self._weight_decay
+        return block.append_op(
+            type="lamb", inputs=self._adam_inputs(p, g),
+            outputs=self._adam_outputs(p),
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, "weight_decay": wd})
+
+
+class FtrlOptimizer(Optimizer):
+    type = "ftrl"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            type="ftrl",
+            inputs={"Param": [p], "Grad": [g], "SquaredAccumulator": [sq],
+                    "LinearAccumulator": [lin],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "SquaredAccumOut": [sq.name],
+                     "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2,
+                   "lr_power": self._lr_power})
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    type = "decayed_adagrad"
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={"Param": [p], "Grad": [g], "Moment": [m],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference optimizer.py:3416)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        self._params = []
+
+    def update(self):
+        block = default_main_program().current_block()
+        helper = LayerHelper("ema")
+        for p in default_main_program().all_parameters():
+            if not p.trainable:
+                continue
+            ema = helper.create_global_variable(
+                name=unique_name.generate(f"{p.name}_ema"),
+                shape=list(p.shape), dtype=p.dtype, persistable=True,
+                value=0.0)
+            self._ema_vars[p.name] = ema
+            self._params.append(p)
+            scaled_p = helper.create_variable_for_type_inference(p.dtype)
+            block.append_op(type="scale", inputs={"X": [p]},
+                            outputs={"Out": [scaled_p]},
+                            attrs={"scale": 1 - self._decay})
+            scaled_e = helper.create_variable_for_type_inference(p.dtype)
+            block.append_op(type="scale", inputs={"X": [ema]},
+                            outputs={"Out": [scaled_e]},
+                            attrs={"scale": self._decay})
+            block.append_op(type="sum",
+                            inputs={"X": [scaled_e, scaled_p]},
+                            outputs={"Out": [ema.name]})
+
+    def apply(self, executor, need_restore=True):
+        from .executor import global_scope
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            scope = global_scope()
+            backup = {}
+            for p in self._params:
+                backup[p.name] = scope.find_var(p.name)
+                ema = scope.find_var(self._ema_vars[p.name].name)
+                if ema is not None:
+                    scope.set(p.name, ema)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for name, val in backup.items():
+                        scope.set(name, val)
+        return guard()
+
+    def restore(self, executor):
+        pass
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation recompute wrapper (reference optimizer.py:4518). On TPU
+    rematerialisation is expressed with jax.checkpoint policies applied at
+    executor trace time over the checkpoint-delimited segments."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        # checkpoints guide XLA remat; graph-level backward is unchanged
+        # (grad ops recompute forward via vjp, XLA CSE decides sharing).
+        return self._optimizer.minimize(loss, startup_program,
+                                        parameter_list, no_grad_set)
+
+
+class GradientMergeOptimizer(Optimizer):
+    """Gradient accumulation over k_steps micro-batches
+    (reference optimizer.py:4994): accumulate grads into persistable buffers
+    every step; every k-th step a `cond` sub-block applies the inner
+    optimizer on the averaged accumulation and zeroes the buffers (the
+    reference gates with conditional_block the same way)."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_optimizer = inner_optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        helper = LayerHelper("gradient_merge")
+        params_grads = self.inner_optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        program = default_main_program()
+        block = program.current_block()
+        step = helper.create_global_variable(
+            name=unique_name.generate("gm_step"), shape=[1], dtype="float32",
+            persistable=True, value=0.0)
+        block.append_op(type="increment", inputs={"X": [step]},
+                        outputs={"Out": [step.name]}, attrs={"step": 1.0})
+        k = layers.fill_constant([1], "float32", float(self.k_steps))
+        rem = layers.elementwise_mod(step, k)
+        reached = layers.equal(rem, layers.fill_constant([1], "float32", 0.0))
+
+        accum_pg = []
+        for p, g in params_grads:
+            acc = helper.create_global_variable(
+                name=unique_name.generate(f"{p.name}_gm_acc"),
+                shape=list(p.shape), dtype=p.dtype, persistable=True,
+                value=0.0)
+            block.append_op(type="sum", inputs={"X": [acc, g]},
+                            outputs={"Out": [acc.name]})
+            accum_pg.append((p, block._var_recursive(acc.name)))
+
+        # true branch: apply inner optimizer on (averaged) accumulation,
+        # then zero the buffers
+        tb = program._create_block()
+        scaled = []
+        for p, acc in accum_pg:
+            sg = layers.scale(acc, scale=1.0 / self.k_steps) if self.avg \
+                else acc
+            scaled.append((p, sg))
+        self.inner_optimizer.apply_gradients(scaled)
+        for p, acc in accum_pg:
+            tb.append_op(type="scale", inputs={"X": [acc]},
+                         outputs={"Out": [acc.name]}, attrs={"scale": 0.0})
+        program._rollback()
+        written = sorted({n for op in tb.ops for n in op.output_arg_names})
+
+        # false branch: identity-assign every var the true branch writes so
+        # both branches produce the same outputs for lax.cond
+        fb = program._create_block()
+        for n in written:
+            fb.append_op(type="assign", inputs={"X": [n]},
+                         outputs={"Out": [n]})
+        program._rollback()
+
+        # captures: names read before being defined within each branch
+        caps = set()
+        for blk in (tb, fb):
+            defined: set = set()
+            for op in blk.ops:
+                for n in op.input_arg_names:
+                    if n not in defined:
+                        caps.add(n)
+                defined.update(op.output_arg_names)
+        caps = sorted(caps)
+        block.append_op(
+            type="cond",
+            inputs={"Cond": [reached], "Input": caps},
+            outputs={"Out": written},
+            attrs={"sub_block_true": tb, "sub_block_false": fb,
+                   "capture_names": caps, "out_names": written})
+        return None, params_grads
+
+
+class LookaheadOptimizer:
+    """Lookahead wrapper (reference optimizer.py:4828)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+
+    def minimize(self, loss, startup_program=None):
+        return self.inner_optimizer.minimize(loss, startup_program)
+
+
+# 2.0-style short aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+RMSProp = RMSPropOptimizer
+Adadelta = AdadeltaOptimizer
+Lamb = LambOptimizer
+Ftrl = FtrlOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer

@@ -1,0 +1,96 @@
+"""Program serialisation (reference framework/framework.proto ProgramDesc).
+
+The wire format mirrors the reference schema shape — program{blocks{vars,ops}}
+with typed attrs and Block-ref attrs stored as block indices — encoded for now
+with a versioned pickle header (a protoc-generated encoder can swap in behind
+the same serialize/deserialize API without touching callers).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .framework import Block, Operator, Program, Parameter, Variable
+
+MAGIC = b"PTPU0001"
+
+
+def program_to_dict(program: Program) -> dict:
+    blocks = []
+    for b in program.blocks:
+        vars_ = []
+        for v in b.vars.values():
+            vars_.append({
+                "name": v.name, "shape": v.shape, "dtype": v.dtype,
+                "type": v.type, "persistable": v.persistable,
+                "stop_gradient": v.stop_gradient, "is_data": v.is_data,
+                "is_parameter": isinstance(v, Parameter),
+                "trainable": getattr(v, "trainable", False),
+            })
+        ops = []
+        for op in b.ops:
+            attrs = {}
+            for k, val in op.attrs.items():
+                if isinstance(val, Block):
+                    attrs[k] = {"__block__": val.idx}
+                else:
+                    attrs[k] = val
+            ops.append({"type": op.type, "inputs": op.inputs,
+                        "outputs": op.outputs, "attrs": attrs})
+        blocks.append({"idx": b.idx, "parent_idx": b.parent_idx,
+                       "vars": vars_, "ops": ops})
+    return {"blocks": blocks, "random_seed": program.random_seed,
+            "is_test": program._is_test}
+
+
+def program_from_dict(d: dict) -> Program:
+    p = Program.__new__(Program)
+    p.random_seed = d.get("random_seed", 0)
+    p._is_test = d.get("is_test", False)
+    p._pipeline_opt = None
+    p._sharding_info = None
+    p._version = 0
+    p._analysis_cache = None
+    p.current_block_idx = 0
+    p.blocks = []
+    for bd in d["blocks"]:
+        b = Block(p, bd["idx"], bd["parent_idx"])
+        p.blocks.append(b)
+    for bd, b in zip(d["blocks"], p.blocks):
+        for vd in bd["vars"]:
+            cls = Parameter if vd.get("is_parameter") else Variable
+            if cls is Parameter:
+                v = Parameter(b, vd["name"], vd["shape"], vd["dtype"],
+                              trainable=vd.get("trainable", True))
+            else:
+                v = Variable(b, vd["name"], shape=vd["shape"],
+                             dtype=vd["dtype"], type=vd.get("type", "dense"),
+                             persistable=vd.get("persistable", False),
+                             stop_gradient=vd.get("stop_gradient", False),
+                             is_data=vd.get("is_data", False))
+            b.vars[v.name] = v
+        for od in bd["ops"]:
+            op = Operator.__new__(Operator)
+            op.block = b
+            op.type = od["type"]
+            op.inputs = {k: list(v) for k, v in od["inputs"].items()}
+            op.outputs = {k: list(v) for k, v in od["outputs"].items()}
+            op.attrs = {}
+            for k, val in od["attrs"].items():
+                if isinstance(val, dict) and "__block__" in val:
+                    op.attrs[k] = p.blocks[val["__block__"]]
+                else:
+                    op.attrs[k] = val
+            b.ops.append(op)
+    return p
+
+
+def serialize_program(program: Program, meta: dict | None = None) -> bytes:
+    payload = {"program": program_to_dict(program), "meta": meta or {}}
+    return MAGIC + pickle.dumps(payload, protocol=4)
+
+
+def deserialize_program(data: bytes):
+    if not data.startswith(MAGIC):
+        raise ValueError("not a paddle_tpu program blob")
+    payload = pickle.loads(data[len(MAGIC):])
+    return program_from_dict(payload["program"]), payload.get("meta", {})

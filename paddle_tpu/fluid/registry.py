@@ -1,0 +1,241 @@
+"""Op registry: schema + shape inference + jax compute + autograd rules.
+
+TPU-native replacement for the reference's operator registry
+(/root/reference/paddle/fluid/framework/op_registry.h:223-299 and
+ op_info.h). One registered `OpDef` bundles what the reference splits across
+OpProtoAndCheckerMaker / InferShape / GradOpDescMaker / per-device kernels:
+
+  - attrs schema w/ defaults        (OpProtoAndCheckerMaker)
+  - infer_shape(op)                 (compile-time shape inference)
+  - compute(ctx, ins, attrs)        (THE kernel — a jax function; XLA compiles
+                                     it for TPU, no per-device registry needed)
+  - grad maker                      (GradOpDescMaker equivalent)
+
+Autograd: unless an op registers a custom grad maker, a generic `<type>_grad`
+op is synthesised whose kernel is `jax.vjp` of the forward kernel. Inside one
+jitted block XLA CSE/DCE dedupes the recomputed forward, so this costs nothing
+at runtime while keeping the *graph-level* backward architecture (grad ops are
+real ops in the Program that distributed passes can rewrite — same property
+the reference gets from GradOpDescMaker, backward.py:924).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+
+_REGISTRY: dict[str, "OpDef"] = {}
+
+GRAD_SUFFIX = "@GRAD"
+EMPTY_VAR = "@EMPTY@"
+
+
+@dataclasses.dataclass
+class OpDef:
+    type: str
+    compute: Callable  # (ctx, ins: dict[str, list], attrs) -> dict[str, list]
+    infer_shape: Callable | None = None
+    attrs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # grad maker: (op, emit) -> None, where emit(type, inputs, outputs, attrs)
+    # appends a grad op. Sentinel "auto" = synthesise via vjp;
+    # None = non-differentiable (treated as stop_gradient).
+    grad: Any = "auto"
+    # fwd input slots that never receive gradient (indices, masks, seeds)
+    no_grad_slots: tuple = ()
+    # fwd *output* slots that are non-differentiable (e.g. argmax Indices)
+    no_grad_out_slots: tuple = ()
+    # whether kernel consumes randomness (gets a stable per-op rng id)
+    stochastic: bool = False
+
+    def fill_default_attrs(self, attrs: dict):
+        for k, v in self.attrs.items():
+            attrs.setdefault(k, v)
+        if self.stochastic and "_rng_id" not in attrs:
+            attrs["_rng_id"] = _next_rng_id()
+
+
+_rng_counter = [0]
+
+
+def _next_rng_id() -> int:
+    _rng_counter[0] += 1
+    return _rng_counter[0]
+
+
+def register(type: str, compute=None, *, infer_shape=None, attrs=None,
+             grad="auto", no_grad_slots=(), no_grad_out_slots=(),
+             stochastic=False):
+    """Register an op. Usable as a decorator on the compute fn."""
+    def _do(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} already registered")
+        _REGISTRY[type] = OpDef(
+            type=type, compute=fn, infer_shape=infer_shape,
+            attrs=dict(attrs or {}), grad=grad,
+            no_grad_slots=tuple(no_grad_slots),
+            no_grad_out_slots=tuple(no_grad_out_slots),
+            stochastic=stochastic)
+        return fn
+    if compute is not None:
+        return _do(compute)
+    return _do
+
+
+def lookup(type: str) -> OpDef | None:
+    op = _REGISTRY.get(type)
+    if op is None and type.endswith("_grad"):
+        # lazily synthesise auto-vjp grad kernels
+        fwd = _REGISTRY.get(type[: -len("_grad")])
+        if fwd is not None and fwd.grad == "auto":
+            op = _make_auto_grad_opdef(fwd)
+            _REGISTRY[type] = op
+    return op
+
+
+def require(type: str) -> OpDef:
+    op = lookup(type)
+    if op is None:
+        raise NotImplementedError(f"op {type!r} is not registered")
+    return op
+
+
+def registered_ops() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# auto-vjp grad synthesis
+# ---------------------------------------------------------------------------
+
+def make_default_grad_ops(op, emit):
+    """Default GradOpDescMaker: one `<type>_grad` op mirroring the fwd op.
+
+    Grad-op slots:  fwd inputs keep their slot names; for each fwd output
+    slot S a slot "S@GRAD" carries the upstream gradients; outputs are
+    "S@GRAD" for each differentiable fwd input slot S.
+    """
+    opdef = require(op.type)
+    inputs = {k: list(v) for k, v in op.inputs.items()}
+    for slot, names in op.outputs.items():
+        if slot in opdef.no_grad_out_slots:
+            continue
+        inputs[slot + GRAD_SUFFIX] = [n + GRAD_SUFFIX for n in names]
+    outputs = {}
+    for slot, names in op.inputs.items():
+        if slot in opdef.no_grad_slots:
+            continue
+        grad_names = []
+        any_live = False
+        for n in names:
+            v = op.block._var_recursive(n)
+            if v is not None and v.stop_gradient:
+                grad_names.append(EMPTY_VAR)  # pruned (stop_gradient)
+            else:
+                grad_names.append(n + GRAD_SUFFIX)
+                any_live = True
+        if any_live:
+            outputs[slot + GRAD_SUFFIX] = grad_names
+    attrs = {k: v for k, v in op.attrs.items()}
+    emit(op.type + "_grad", inputs, outputs, attrs)
+
+
+def _make_auto_grad_opdef(fwd: OpDef) -> OpDef:
+    def grad_compute(ctx, ins, attrs):
+        # split grad-op inputs back into fwd inputs vs upstream out-grads
+        fwd_ins = {k: v for k, v in ins.items() if not k.endswith(GRAD_SUFFIX)}
+        out_grads = {k[: -len(GRAD_SUFFIX)]: v
+                     for k, v in ins.items() if k.endswith(GRAD_SUFFIX)}
+
+        # differentiable leaf selection: float arrays in non-excluded slots
+        diff_keys: list[tuple[str, int]] = []
+        primals: list = []
+        for slot, vals in fwd_ins.items():
+            if slot in fwd.no_grad_slots:
+                continue
+            for i, v in enumerate(vals):
+                if v is not None and core.is_float_dtype(jnp.result_type(v)):
+                    diff_keys.append((slot, i))
+                    primals.append(v)
+
+        out_slots: list[tuple[str, int]] = []
+
+        def f(*dvals):
+            rebuilt = {k: list(v) for k, v in fwd_ins.items()}
+            for (slot, i), val in zip(diff_keys, dvals):
+                rebuilt[slot][i] = val
+            outs = fwd.compute(ctx, rebuilt, attrs)
+            out_slots.clear()
+            flat = []
+            for slot in sorted(outs):
+                for i, o in enumerate(outs[slot]):
+                    if o is None:
+                        continue  # dummy slots (e.g. reshape2's XShape)
+                    out_slots.append((slot, i))
+                    flat.append(o)
+            return tuple(flat)
+
+        flat_out, vjp_fn = jax.vjp(f, *primals)
+        cts = []
+        for (slot, i), o in zip(out_slots, flat_out):
+            g = out_grads.get(slot)
+            gv = g[i] if g is not None and i < len(g) and g[i] is not None \
+                else None
+            if gv is None:
+                gv = jnp.zeros_like(o)
+            cts.append(jnp.asarray(gv, o.dtype) if hasattr(o, "dtype") else gv)
+        in_grads = vjp_fn(tuple(cts))
+
+        result: dict[str, list] = {}
+        for slot, vals in fwd_ins.items():
+            if slot in fwd.no_grad_slots:
+                continue
+            result[slot + GRAD_SUFFIX] = [None] * len(vals)
+        for (slot, i), g in zip(diff_keys, in_grads):
+            result[slot + GRAD_SUFFIX][i] = g
+        return result
+
+    def grad_infer_shape(op):
+        # each input-grad has the shape/dtype of the corresponding fwd input
+        block = op.block
+        for slot, names in op.outputs.items():
+            src = op.inputs.get(slot[: -len(GRAD_SUFFIX)], [])
+            for name, src_name in zip(names, src):
+                sv = block._var_recursive(src_name)
+                if sv is not None:
+                    block.create_var(name=name, shape=sv.shape, dtype=sv.dtype)
+
+    return OpDef(type=fwd.type + "_grad", compute=grad_compute,
+                 infer_shape=grad_infer_shape, attrs=dict(fwd.attrs),
+                 grad=None, stochastic=False)
+
+
+# ---------------------------------------------------------------------------
+# shape-inference helpers shared by op definitions
+# ---------------------------------------------------------------------------
+
+def same_shape_as(in_slot: str, out_slot: str = "Out"):
+    """Output mirrors shape+dtype of the (first) input in `in_slot`."""
+    def _infer(op):
+        v = op.invar(in_slot)
+        if v is None:
+            return
+        for name in op.output(out_slot):
+            op.block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+    return _infer
+
+
+def elementwise_infer(op):
+    x, y = op.invar("X"), op.invar("Y")
+    shape, dtype = None, None
+    if x is not None and x.shape is not None:
+        shape, dtype = x.shape, x.dtype
+    if y is not None and y.shape is not None and (
+            shape is None or len(y.shape) > len(shape)):
+        shape = y.shape
+        dtype = dtype or y.dtype
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=shape, dtype=dtype)

@@ -1,0 +1,50 @@
+"""Weight-decay regularizers (reference python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="scale", inputs={"X": [param]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff})
+        out = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return block._var_recursive(out.name)
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sign", inputs={"X": [param]},
+                        outputs={"Out": [sign]})
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="scale", inputs={"X": [sign]},
+                        outputs={"Out": [decay]},
+                        attrs={"scale": self._coeff})
+        out = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op(type="sum", inputs={"X": [grad, decay]},
+                        outputs={"Out": [out]})
+        return block._var_recursive(out.name)
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

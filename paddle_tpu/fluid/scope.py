@@ -1,0 +1,92 @@
+"""Scope: host-side name -> device-array store.
+
+Capability parity with the reference Scope/Variable
+(/root/reference/paddle/fluid/framework/scope.h:46), redesigned: values are
+jax.Arrays (XLA device buffers) or host objects; there is no allocator to
+manage — XLA owns device memory. Parent-chain lookup is preserved for local
+scopes (used by control flow and tests).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class Scope:
+    def __init__(self, parent: "Scope | None" = None):
+        self._vars: dict[str, Any] = {}
+        self.parent = parent
+        self._kids: list[Scope] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def var(self, name: str, value=None):
+        """Create (or get) a variable slot in *this* scope."""
+        if name not in self._vars:
+            self._vars[name] = value
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s: Scope | None = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name: str) -> bool:
+        s: Scope | None = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set(self, name: str, value):
+        """Set in the scope that owns `name`, else locally."""
+        s: Scope | None = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s.parent
+        self._vars[name] = value
+
+    def erase(self, name: str):
+        self._vars.pop(name, None)
+
+    def local_var_names(self) -> list[str]:
+        return list(self._vars)
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def numpy(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(name)
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = old

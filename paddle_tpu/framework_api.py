@@ -1,0 +1,107 @@
+"""Top-level framework helpers (reference python/paddle/framework/)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["get_default_dtype", "set_default_dtype", "seed", "save", "load",
+           "set_device", "get_device", "DataParallel", "set_grad_enabled",
+           "is_grad_enabled", "summary", "flops"]
+
+_default_dtype = "float32"
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    from .fluid import core
+    _default_dtype = core.convert_dtype(d)
+
+
+def seed(s: int):
+    np.random.seed(s)
+    from .fluid import framework
+    tr = framework._dygraph_tracer()
+    if tr is not None:
+        tr.seed(int(s))
+    from .fluid.framework import default_main_program, default_startup_program
+    default_main_program().random_seed = int(s)
+    default_startup_program().random_seed = int(s)
+    return s
+
+
+def save(obj, path, protocol=4):
+    """paddle.save — state dicts / tensors / pytrees of arrays."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def conv(o):
+        if hasattr(o, "numpy"):
+            return o.numpy()
+        if isinstance(o, dict):
+            return {k: conv(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return type(o)(conv(v) for v in o)
+        return o
+    with open(path, "wb") as f:
+        pickle.dump(conv(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def set_device(device: str):
+    os.environ["PADDLE_DEVICE"] = device
+    return device
+
+
+def get_device() -> str:
+    import jax
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+from .distributed.parallel import DataParallel  # noqa: E402
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    from .fluid.dygraph.tracer import no_grad_guard
+    if mode:
+        yield
+    else:
+        with no_grad_guard():
+            yield
+
+
+def is_grad_enabled():
+    from .fluid import framework
+    tr = framework._dygraph_tracer()
+    return tr is None or tr._has_grad
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Model summary (reference hapi/model_summary.py)."""
+    rows = []
+    total = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        rows.append(f"  {name:40s} {str(tuple(p.shape)):20s} {n}")
+    txt = "\n".join(["-" * 75] + rows +
+                    ["-" * 75, f"Total params: {total}"])
+    print(txt)
+    return {"total_params": total}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

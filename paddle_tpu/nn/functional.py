@@ -1,0 +1,455 @@
+"""paddle.nn.functional (reference python/paddle/nn/functional/)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..common_ops import run_op, run_op_multi
+
+__all__ = [
+    "linear", "conv2d", "conv2d_transpose", "max_pool2d", "avg_pool2d",
+    "adaptive_avg_pool2d", "adaptive_max_pool2d", "relu", "relu6", "gelu",
+    "sigmoid", "tanh", "softmax", "log_softmax", "leaky_relu", "elu", "selu",
+    "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "softplus", "softsign",
+    "prelu", "dropout", "embedding", "layer_norm", "batch_norm",
+    "instance_norm", "group_norm", "cross_entropy", "softmax_with_cross_entropy",
+    "mse_loss", "l1_loss", "nll_loss", "kl_div", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "smooth_l1_loss", "one_hot", "pad",
+    "label_smooth", "normalize", "sigmoid_focal_loss", "square_error_cost",
+    "log_loss", "margin_ranking_loss", "unfold", "interpolate", "upsample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    out = run_op("matmul_v2", {"X": x, "Y": weight}, {})
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": -1})
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    s = [stride, stride] if isinstance(stride, int) else list(stride)
+    p = [padding, padding] if isinstance(padding, int) else list(padding)
+    d = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    algo = "EXPLICIT"
+    if isinstance(padding, str):
+        algo, p = padding.upper(), [0, 0]
+    out = run_op("conv2d", {"Input": x, "Filter": weight},
+                 {"strides": s, "paddings": p, "dilations": d,
+                  "groups": groups, "padding_algorithm": algo,
+                  "data_format": data_format}, out_slot="Output")
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    s = [stride, stride] if isinstance(stride, int) else list(stride)
+    p = [padding, padding] if isinstance(padding, int) else list(padding)
+    d = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    out = run_op("conv2d_transpose", {"Input": x, "Filter": weight},
+                 {"strides": s, "paddings": p, "dilations": d,
+                  "groups": groups, "data_format": data_format},
+                 out_slot="Output")
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
+    return out
+
+
+def _pool2d(x, pooling_type, kernel_size, stride, padding, ceil_mode,
+            exclusive=True, adaptive=False, global_pool=False):
+    k = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
+    s = k if stride is None else (
+        [stride] * 2 if isinstance(stride, int) else list(stride))
+    p = [padding] * 2 if isinstance(padding, int) else list(padding)
+    return run_op("pool2d", {"X": x},
+                  {"pooling_type": pooling_type, "ksize": k, "strides": s,
+                   "paddings": p, "global_pooling": global_pool,
+                   "ceil_mode": ceil_mode, "exclusive": exclusive,
+                   "adaptive": adaptive})
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool2d(x, "max", kernel_size, stride, padding, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool2d(x, "avg", kernel_size, stride, padding, ceil_mode,
+                   exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os_ = [output_size] * 2 if isinstance(output_size, int) \
+        else list(output_size)
+    return _pool2d(x, "avg", os_, None, 0, False, adaptive=True)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os_ = [output_size] * 2 if isinstance(output_size, int) \
+        else list(output_size)
+    return _pool2d(x, "max", os_, None, 0, False, adaptive=True)
+
+
+def _unary(op_type, **default_attrs):
+    def fn(x, name=None, **kw):
+        attrs = dict(default_attrs)
+        for k, v in kw.items():
+            attrs[k] = v
+        return run_op(op_type, {"X": x}, attrs)
+    fn.__name__ = op_type
+    return fn
+
+
+relu = _unary("relu")
+relu6 = _unary("relu6")
+sigmoid = _unary("sigmoid")
+tanh = _unary("tanh")
+silu = _unary("silu")
+mish = _unary("mish")
+softplus = _unary("softplus")
+softsign = _unary("softsign")
+tanhshrink = _unary("tanh_shrink")
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op("gelu", {"X": x}, {"approximate": approximate})
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op("leaky_relu", {"X": x}, {"alpha": negative_slope})
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op("elu", {"X": x}, {"alpha": alpha})
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op("selu", {"X": x}, {"scale": scale, "alpha": alpha})
+
+
+def swish(x, name=None):
+    return run_op("swish", {"X": x}, {"beta": 1.0})
+
+
+def hardswish(x, name=None):
+    return run_op("hard_swish", {"X": x})
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return run_op("hard_sigmoid", {"X": x},
+                  {"slope": slope, "offset": offset})
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op("hard_tanh", {"X": x}, {"t_min": min, "t_max": max})
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op("hard_shrink", {"X": x}, {"threshold": threshold})
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op("softshrink", {"X": x}, {"lambda": threshold})
+
+
+def prelu(x, weight, name=None):
+    pos = relu(x)
+    neg = run_op("elementwise_mul",
+                 {"X": run_op("relu", {"X": run_op(
+                     "scale", {"X": x}, {"scale": -1.0})}),
+                  "Y": weight}, {"axis": 1})
+    return run_op("elementwise_sub", {"X": pos, "Y": neg}, {"axis": -1})
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return run_op("softmax", {"X": x}, {"axis": int(axis)})
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return run_op("log_softmax", {"X": x}, {"axis": int(axis)})
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    res = run_op_multi("dropout", {"X": x},
+                       {"dropout_prob": float(p), "is_test": not training,
+                        "dropout_implementation": mode},
+                       {"Out": 1, "Mask": 1})
+    return res["Out"][0]
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return run_op("lookup_table_v2", {"Ids": x, "W": weight},
+                  {"padding_idx": -1 if padding_idx is None
+                   else int(padding_idx), "is_sparse": sparse})
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ns = [normalized_shape] if isinstance(normalized_shape, int) \
+        else list(normalized_shape)
+    begin = len(x.shape) - len(ns)
+    ins = {"X": x}
+    if weight is not None:
+        ins["Scale"] = weight
+    if bias is not None:
+        ins["Bias"] = bias
+    res = run_op_multi("layer_norm", ins,
+                       {"epsilon": epsilon, "begin_norm_axis": begin},
+                       {"Y": 1, "Mean": 1, "Variance": 1})
+    return res["Y"][0]
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", name=None):
+    res = run_op_multi(
+        "batch_norm",
+        {"X": x, "Scale": weight, "Bias": bias, "Mean": running_mean,
+         "Variance": running_var},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": not training,
+         "data_layout": data_format},
+        {"Y": 1, "MeanOut": 1, "VarianceOut": 1, "SavedMean": 1,
+         "SavedVariance": 1})
+    # eager: write back running stats (functional update)
+    mo, vo = res["MeanOut"][0], res["VarianceOut"][0]
+    if hasattr(running_mean, "_set_value") and mo is not None and training:
+        running_mean._set_value(mo._value)
+        running_var._set_value(vo._value)
+    return res["Y"][0]
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    ins = {"X": x}
+    if weight is not None:
+        ins["Scale"] = weight
+    if bias is not None:
+        ins["Bias"] = bias
+    res = run_op_multi("instance_norm", ins, {"epsilon": eps},
+                       {"Y": 1, "SavedMean": 1, "SavedVariance": 1})
+    return res["Y"][0]
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ins = {"X": x}
+    if weight is not None:
+        ins["Scale"] = weight
+    if bias is not None:
+        ins["Bias"] = bias
+    res = run_op_multi("group_norm", ins,
+                       {"epsilon": epsilon, "groups": num_groups},
+                       {"Y": 1, "Mean": 1, "Variance": 1})
+    return res["Y"][0]
+
+
+def one_hot(x, num_classes, name=None):
+    return run_op("one_hot_v2", {"X": x}, {"depth": int(num_classes)},
+                  stop_gradient=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    ins = {"X": label}
+    if prior_dist is not None:
+        ins["PriorDist"] = prior_dist
+    return run_op("label_smooth", ins, {"epsilon": float(epsilon)})
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if len(pad) == 4 and len(x.shape) == 4:
+        return run_op("pad2d", {"X": x},
+                      {"paddings": [int(p) for p in pad], "mode": mode,
+                       "pad_value": float(value), "data_format": data_format})
+    full = [0] * (2 * len(x.shape))
+    # paddle's pad spec is last-dim-first pairs like torch
+    nd = len(x.shape)
+    for i in range(len(pad) // 2):
+        dim = nd - 1 - i
+        full[2 * dim] = int(pad[2 * i])
+        full[2 * dim + 1] = int(pad[2 * i + 1])
+    return run_op("pad", {"X": x},
+                  {"paddings": full, "pad_value": float(value)})
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    from .. import tensor as T
+    n = run_op("p_norm", {"X": x},
+               {"porder": float(p), "axis": int(axis), "keepdim": True,
+                "epsilon": epsilon})
+    n = T.clip(n, min=epsilon)
+    return run_op("elementwise_div", {"X": x, "Y": n}, {"axis": -1})
+
+
+# -- losses ------------------------------------------------------------------
+
+def _reduce_loss(loss, reduction):
+    from . import functional as F
+    if reduction == "mean":
+        return run_op("mean", {"X": loss})
+    if reduction == "sum":
+        return run_op("reduce_sum", {"X": loss},
+                      {"dim": [0], "keep_dim": False, "reduce_all": True})
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    if use_softmax:
+        res = run_op_multi(
+            "softmax_with_cross_entropy",
+            {"Logits": input, "Label": label},
+            {"soft_label": soft_label, "ignore_index": int(ignore_index),
+             "axis": int(axis), "numeric_stable_mode": True},
+            {"Loss": 1, "Softmax": 1})
+        loss = res["Loss"][0]
+    else:
+        loss = run_op("cross_entropy", {"X": input, "Label": label},
+                      {"soft_label": soft_label,
+                       "ignore_index": int(ignore_index)}, out_slot="Y")
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    res = run_op_multi("softmax_with_cross_entropy",
+                       {"Logits": logits, "Label": label},
+                       {"soft_label": soft_label,
+                        "ignore_index": int(ignore_index), "axis": int(axis),
+                        "numeric_stable_mode": numeric_stable_mode},
+                       {"Loss": 1, "Softmax": 1})
+    if return_softmax:
+        return res["Loss"][0], res["Softmax"][0]
+    return res["Loss"][0]
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    loss = run_op("mse_loss", {"X": input, "Y": label})
+    return _reduce_loss(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    d = run_op("elementwise_sub", {"X": input, "Y": label}, {"axis": -1})
+    loss = run_op("abs", {"X": d})
+    return _reduce_loss(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    ins = {"X": input, "Label": label}
+    if weight is not None:
+        ins["Weight"] = weight
+    res = run_op_multi("nll_loss", ins,
+                       {"reduction": reduction,
+                        "ignore_index": int(ignore_index)},
+                       {"Out": 1, "Total_weight": 1})
+    return res["Out"][0]
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return run_op("kldiv_loss", {"X": input, "Target": label},
+                  {"reduction": reduction})
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    loss = run_op("bce_loss", {"X": input, "Label": label})
+    if weight is not None:
+        loss = run_op("elementwise_mul", {"X": loss, "Y": weight},
+                      {"axis": -1})
+    return _reduce_loss(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    loss = run_op("sigmoid_cross_entropy_with_logits",
+                  {"X": logit, "Label": label}, {})
+    if weight is not None:
+        loss = run_op("elementwise_mul", {"X": loss, "Y": weight},
+                      {"axis": -1})
+    return _reduce_loss(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    res = run_op_multi("huber_loss", {"X": input, "Y": label},
+                       {"delta": float(delta)}, {"Out": 1, "Residual": 1})
+    return _reduce_loss(res["Out"][0], reduction)
+
+
+def square_error_cost(input, label):
+    return run_op("squared_error_cost", {"X": input, "Y": label})
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    from .. import tensor as T
+    p = T.clip(input, min=epsilon, max=1 - epsilon)
+    one = T.ones_like(p)
+    return run_op("bce_loss", {"X": p, "Label": label})
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    from .. import tensor as T
+    p = sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = run_op("elementwise_add",
+                 {"X": run_op("elementwise_mul", {"X": p, "Y": label},
+                              {"axis": -1}),
+                  "Y": run_op("elementwise_mul",
+                              {"X": run_op("scale", {"X": p},
+                                           {"scale": -1.0, "bias": 1.0}),
+                               "Y": run_op("scale", {"X": label},
+                                           {"scale": -1.0, "bias": 1.0})},
+                              {"axis": -1})}, {"axis": -1})
+    mod = T.pow(run_op("scale", {"X": p_t}, {"scale": -1.0, "bias": 1.0}),
+                gamma)
+    loss = run_op("elementwise_mul", {"X": ce, "Y": mod}, {"axis": -1})
+    if alpha >= 0:
+        a_t = run_op("scale", {"X": label},
+                     {"scale": 2 * alpha - 1.0, "bias": 1.0 - alpha})
+        loss = run_op("elementwise_mul", {"X": loss, "Y": a_t}, {"axis": -1})
+    if normalizer is not None:
+        loss = run_op("elementwise_div", {"X": loss, "Y": normalizer},
+                      {"axis": -1})
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    from .. import tensor as T
+    d = run_op("elementwise_sub", {"X": other, "Y": input}, {"axis": -1})
+    loss = T.clip(run_op("elementwise_mul", {"X": d, "Y": label},
+                         {"axis": -1}).__add__(margin) if False else
+                  run_op("scale",
+                         {"X": run_op("elementwise_mul", {"X": d, "Y": label},
+                                      {"axis": -1})},
+                         {"scale": 1.0, "bias": float(margin)}), min=0.0)
+    return _reduce_loss(loss, reduction)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    raise NotImplementedError("unfold lands with the vision op batch")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if mode != "nearest":
+        raise NotImplementedError("only nearest interpolation in this build")
+    oh, ow = (int(size[0]), int(size[1])) if size is not None else (-1, -1)
+    return run_op("interp_nearest", {"X": x},
+                  {"out_h": oh, "out_w": ow,
+                   "scale": float(scale_factor or 0.0),
+                   "align_corners": align_corners})
+
+
+upsample = interpolate

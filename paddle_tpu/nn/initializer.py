@@ -1,0 +1,18 @@
+"""paddle.nn.initializer (reference python/paddle/nn/initializer/)."""
+from ..fluid.initializer import (
+    Constant, Normal, TruncatedNormal, Uniform, Xavier, MSRA, Bilinear,
+    NumpyArrayInitializer)
+
+XavierNormal = lambda fan_in=None, fan_out=None, name=None: Xavier(
+    uniform=False, fan_in=fan_in, fan_out=fan_out)
+XavierUniform = lambda fan_in=None, fan_out=None, name=None: Xavier(
+    uniform=True, fan_in=fan_in, fan_out=fan_out)
+KaimingNormal = lambda fan_in=None, name=None: MSRA(uniform=False,
+                                                    fan_in=fan_in)
+KaimingUniform = lambda fan_in=None, name=None: MSRA(uniform=True,
+                                                     fan_in=fan_in)
+Assign = NumpyArrayInitializer
+
+__all__ = ["Constant", "Normal", "TruncatedNormal", "Uniform", "Xavier",
+           "MSRA", "Bilinear", "XavierNormal", "XavierUniform",
+           "KaimingNormal", "KaimingUniform", "Assign"]

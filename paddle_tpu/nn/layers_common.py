@@ -1,0 +1,545 @@
+"""paddle.nn Layer classes (reference python/paddle/nn/layer/*).
+
+All classes work in both eager and static-graph modes: parameters are created
+through LayerHelper (eager Tensors in dygraph, Program Parameters in static),
+and the forward composes nn.functional ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..fluid.dygraph.layers import Layer
+from ..fluid.initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..fluid.param_attr import ParamAttr
+from . import functional as F
+
+__all__ = [
+    "Linear", "Conv2D", "Conv2DTranspose", "MaxPool2D", "AvgPool2D",
+    "AdaptiveAvgPool2D", "AdaptiveMaxPool2D", "BatchNorm", "BatchNorm1D",
+    "BatchNorm2D", "BatchNorm3D", "SyncBatchNorm", "LayerNorm", "GroupNorm",
+    "InstanceNorm2D", "Embedding", "Dropout", "Dropout2D", "Flatten", "ReLU",
+    "ReLU6", "GELU", "Sigmoid", "Tanh", "LeakyReLU", "ELU", "SELU", "Silu",
+    "Swish", "Mish", "Hardswish", "Hardsigmoid", "Hardtanh", "PReLU",
+    "Softmax", "LogSoftmax", "Softplus", "Softsign", "Sequential",
+    "LayerList", "ParameterList", "CrossEntropyLoss", "MSELoss", "L1Loss",
+    "NLLLoss", "BCELoss", "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
+    "MarginRankingLoss", "Pad2D", "Upsample", "UpsamplingNearest2D",
+    "Identity",
+]
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Linear(Layer):
+    """y = xW + b (reference python/paddle/nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._dtype = "float32"
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierInitializer())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        p = super().create_parameter(shape, attr, dtype, is_bias,
+                                     default_initializer)
+        return p
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = [kernel_size] * 2 if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        import math
+        std = math.sqrt(2.0 / (k[0] * k[1] * in_channels))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + k, attr=weight_attr,
+            default_initializer=NormalInitializer(0.0, std))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        k = [kernel_size] * 2 if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups] + k, attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, dilation=self._dilation,
+                                  groups=self._groups)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool2d(x, self._k, self._s, self._p, self._ceil)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil, self._excl = ceil_mode, exclusive
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self._k, self._s, self._p, self._ceil,
+                            self._excl)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self._os)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self._os)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = "NCHW" if data_format in ("NCHW", "NCL", "NCDHW") \
+            else "NHWC"
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+        mean = self.create_parameter(
+            [num_features], attr=ParamAttr(trainable=False),
+            default_initializer=ConstantInitializer(0.0))
+        variance = self.create_parameter(
+            [num_features], attr=ParamAttr(trainable=False),
+            default_initializer=ConstantInitializer(1.0))
+        # running stats are buffers, not trainable params
+        self.register_buffer("_mean", mean)
+        self.register_buffer("_variance", variance)
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self._momentum, epsilon=self._epsilon,
+                            data_format=self._data_format)
+
+
+class BatchNorm(_BatchNormBase):
+    """1.x-style BatchNorm layer (num_channels first arg)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", **kwargs):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout)
+        self._act = act
+
+    def forward(self, x):
+        y = super().forward(x)
+        if self._act:
+            from ..common_ops import run_op
+            y = run_op(self._act, {"X": y})
+        return y
+
+
+BatchNorm1D = _BatchNormBase
+BatchNorm2D = _BatchNormBase
+BatchNorm3D = _BatchNormBase
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica batch norm. Under pjit data parallelism the batch axis is
+    sharded on the mesh and XLA computes global statistics when the reduction
+    is marked — here we rely on executor-level mesh context (the psum happens
+    inside the sharded computation, replacing the reference's
+    sync_batch_norm ncclAllReduce at sync_batch_norm_op.cu.h:190)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        ns = [normalized_shape] if isinstance(normalized_shape, int) \
+            else list(normalized_shape)
+        self._normalized_shape = ns
+        self._epsilon = epsilon
+        n = int(np.prod(ns))
+        self.weight = self.create_parameter(
+            [n], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0)) \
+            if weight_attr is not False else None
+        self.bias = self.create_parameter([n], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_channels], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self._groups, self._epsilon, self.weight,
+                            self.bias)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._padding_idx = padding_idx
+        self._sparse = sparse
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=NormalInitializer(0.0, 1.0))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self._padding_idx, self._sparse)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+Dropout2D = Dropout
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start, self._stop = start_axis, stop_axis
+
+    def forward(self, x):
+        from .. import tensor as T
+        return T.flatten(x, self._start, self._stop)
+
+
+def _act_layer(name, fn, *fields):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args, self._kwargs = args, kwargs
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+GELU = _act_layer("GELU", F.gelu)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu)
+ELU = _act_layer("ELU", F.elu)
+SELU = _act_layer("SELU", F.selu)
+Silu = _act_layer("Silu", F.silu)
+Swish = _act_layer("Swish", F.swish)
+Mish = _act_layer("Mish", F.mish)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh)
+Softplus = _act_layer("Softplus", F.softplus)
+Softsign = _act_layer("Softsign", F.softsign)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self._axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=ConstantInitializer(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, l in layers[0]:
+                self.add_sublayer(name, l)
+        else:
+            for i, l in enumerate(layers):
+                if isinstance(l, tuple):
+                    self.add_sublayer(l[0], l[1])
+                else:
+                    self.add_sublayer(str(i), l)
+
+    def forward(self, x):
+        for l in self._sub_layers.values():
+            x = l(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, l in enumerate(sublayers or []):
+            self.add_sublayer(str(i), l)
+
+    def append(self, l):
+        self.add_sublayer(str(len(self._sub_layers)), l)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx if idx >= 0
+                                    else len(self._sub_layers) + idx)]
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def __len__(self):
+        return len(self._parameters)
+
+
+# -- loss layers -------------------------------------------------------------
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, use_softmax=True, name=None):
+        super().__init__()
+        self._cfg = dict(weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, soft_label=soft_label,
+                         axis=axis, use_softmax=use_softmax)
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, **self._cfg)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self._reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self._reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._w, self._ii, self._red = weight, ignore_index, reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, self._w, self._ii, self._red)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w, self._red = weight, reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, self._w, self._red)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None,
+                 name=None):
+        super().__init__()
+        self._w, self._red, self._pw = weight, reduction, pos_weight
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(logit, label, self._w,
+                                                  self._red, self._pw)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._red = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self._red)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self._red, self._delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self._red, self._delta)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self._m, self._red = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self._m, self._red)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._p = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self._mode, self._value, self._df = mode, value, data_format
+
+    def forward(self, x):
+        return F.pad(x, self._p, self._mode, self._value, self._df)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._size, self._sf, self._mode = size, scale_factor, mode
+
+    def forward(self, x):
+        return F.interpolate(x, self._size, self._sf, self._mode)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "nearest")

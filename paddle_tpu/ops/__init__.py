@@ -1,0 +1,11 @@
+"""paddle_tpu.ops — Pallas TPU kernels for the fusion-critical set.
+
+Replaces the reference's hand-written CUDA fused kernels
+(operators/fused/*, math/bert_encoder_functor.cu) with Mosaic/Pallas kernels:
+flash attention, layer_norm, softmax-xent. Kernels register as alternative
+compute impls for existing op types; the registry falls back to the jnp
+reference implementation when Pallas is unavailable (CPU tests).
+"""
+from . import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,66 @@
+"""Fused scaled-dot-product attention.
+
+Reference equivalent: operators/fused/multihead_matmul_op +
+math/bert_encoder_functor.cu. Round-1 provides the XLA-fused reference path
+(jnp, fully fused by XLA into MXU-friendly form); the Pallas blockwise
+(flash) kernel slots in behind the same `fused_attention` op type in the
+transformer round.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..fluid.registry import register
+from ..fluid.ops.common import x
+
+__all__ = ["scaled_dot_product_attention"]
+
+
+def sdpa_reference(q, k, v, mask=None, scale=None, causal=False,
+                   dropout_p=0.0, rng_key=None):
+    """q,k,v: (B, H, S, D). mask: broadcastable to (B, H, S, S)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhsd,bhtd->bhst", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((s, t), dtype=bool))
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and rng_key is not None:
+        keep = jax.random.bernoulli(rng_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhst,bhtd->bhsd", probs.astype(v.dtype), v)
+
+
+@register("fused_attention", stochastic=True,
+          attrs={"causal": False, "dropout_p": 0.0, "scale": 0.0},
+          no_grad_slots=("Mask",))
+def _fused_attention(ctx, ins, attrs):
+    q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
+    mask = x(ins, "Mask")
+    scale = attrs.get("scale") or None
+    key = ctx.rng(attrs) if attrs.get("dropout_p", 0.0) > 0 and \
+        not ctx.is_test else None
+    o = sdpa_reference(q, k, v, mask, scale, attrs.get("causal", False),
+                       attrs.get("dropout_p", 0.0) if key is not None else 0.0,
+                       key)
+    return {"Out": [o]}
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    from ..common_ops import run_op
+    ins = {"Q": query, "K": key, "V": value}
+    if attn_mask is not None:
+        ins["Mask"] = attn_mask
+    return run_op("fused_attention", ins,
+                  {"causal": is_causal,
+                   "dropout_p": float(dropout_p) if training else 0.0})

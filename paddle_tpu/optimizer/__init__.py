@@ -1,0 +1,460 @@
+"""paddle.optimizer — 2.0 optimizers (reference python/paddle/optimizer/).
+
+Dual-mode: in dygraph `step()` runs the SAME registered optimizer-op kernels
+eagerly over (param, grad, accumulators); in static mode they delegate to the
+fluid optimizer machinery (append ops to the Program).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import lr as lr  # noqa: F401
+from .lr import LRScheduler
+from .. import fluid
+from ..fluid import optimizer as fopt
+from ..fluid import registry
+from ..fluid.framework import in_dygraph_mode
+from ..fluid.dygraph.varbase import Tensor
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
+           "Adamax", "RMSProp", "Adadelta", "Lamb", "lr"]
+
+
+class Optimizer:
+    _op_type = None
+    _static_cls = None
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **op_attrs):
+        self._learning_rate = learning_rate
+        self._parameters = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._op_attrs = op_attrs
+        self._accum: dict[str, dict[str, object]] = {}
+        self._static = None
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+        if self._static is not None:
+            self._static.set_lr(value)
+
+    # -- static-mode delegation ---------------------------------------------
+    def _static_optimizer(self):
+        if self._static is None:
+            reg = None
+            if isinstance(self._weight_decay, (int, float)):
+                from ..fluid.regularizer import L2Decay
+                reg = L2Decay(float(self._weight_decay))
+            elif self._weight_decay is not None:
+                reg = self._weight_decay
+            lr_val = self.get_lr() if isinstance(
+                self._learning_rate, LRScheduler) else self._learning_rate
+            self._static = self._make_static(lr_val, reg)
+            if isinstance(self._learning_rate, LRScheduler):
+                self._wire_scheduler_to_scope(self._learning_rate,
+                                              self._static)
+        return self._static
+
+    @staticmethod
+    def _wire_scheduler_to_scope(sched: LRScheduler, static_opt):
+        """In static mode the LR lives in a scope var; hook scheduler.step()
+        so each host-side step writes the new value into that var."""
+        if getattr(sched, "_scope_wired", False):
+            return
+        orig_step = sched.step
+
+        def step(*a, **kw):
+            orig_step(*a, **kw)
+            if static_opt._lr_var is not None:
+                static_opt.set_lr(sched.last_lr)
+        sched.step = step
+        sched._scope_wired = True
+
+    def _make_static(self, lr_val, reg):
+        return self._static_cls(learning_rate=lr_val, regularization=reg,
+                                grad_clip=self._grad_clip,
+                                **self._static_kwargs())
+
+    def _static_kwargs(self):
+        return {}
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        if in_dygraph_mode():
+            self.step()
+            return None, None
+        return self._static_optimizer().minimize(
+            loss, startup_program, parameters or self._parameters,
+            no_grad_set)
+
+    # -- dygraph step --------------------------------------------------------
+    def _accumulators_for(self, p: Tensor) -> dict:
+        raise NotImplementedError
+
+    def _op_inputs(self, p, g, acc, lr):
+        raise NotImplementedError
+
+    def _apply_outputs(self, p, acc, outs):
+        raise NotImplementedError
+
+    def step(self):
+        import jax.numpy as jnp
+        if self._parameters is None:
+            raise ValueError("pass parameters= to the optimizer in dygraph")
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if p.trainable and p.grad is not None]
+        if self._grad_clip is not None:
+            # eager clip works on Tensors
+            pgs = [(p, g) for p, g in params_grads]
+            params_grads = self._grad_clip(pgs)
+        lr = jnp.asarray([self.get_lr()], dtype=jnp.float32)
+        opdef = registry.require(self._op_type)
+        wd = self._weight_decay
+        for p, g in params_grads:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+            if wd is not None and not isinstance(self, AdamW) and \
+                    isinstance(wd, (int, float)):
+                gval = gval + float(wd) * p._value
+            acc = self._accumulators_for(p)
+            ins = self._op_inputs(p, gval, acc, lr)
+            outs = opdef.compute(None, ins, dict(self._op_attrs))
+            self._apply_outputs(p, acc, outs)
+
+    def clear_grad(self):
+        for p in (self._parameters or []):
+            if isinstance(p, Tensor):
+                p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for pname, accs in self._accum.items():
+            for aname, val in accs.items():
+                sd[f"{pname}_{aname}"] = np.asarray(val)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        if self._static is not None:
+            sd.update(self._static.state_dict())
+        return sd
+
+    def set_state_dict(self, sd):
+        import jax.numpy as jnp
+        for pname, accs in self._accum.items():
+            for aname in list(accs):
+                k = f"{pname}_{aname}"
+                if k in sd:
+                    accs[aname] = jnp.asarray(sd[k])
+        if "LR_Scheduler" in sd and isinstance(self._learning_rate,
+                                               LRScheduler):
+            self._learning_rate.set_state_dict(sd["LR_Scheduler"])
+        if self._static is not None:
+            self._static.set_state_dict(
+                {k: v for k, v in sd.items() if k != "LR_Scheduler"})
+
+    load_state_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    _op_type = "sgd"
+    _static_cls = fopt.SGDOptimizer
+
+    def _accumulators_for(self, p):
+        return {}
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g], "LearningRate": [lr]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+
+
+class Momentum(Optimizer):
+    _op_type = "momentum"
+    _static_cls = fopt.MomentumOptimizer
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, mu=momentum, use_nesterov=use_nesterov)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _static_kwargs(self):
+        return {"momentum": self._momentum,
+                "use_nesterov": self._use_nesterov}
+
+    def _make_static(self, lr_val, reg):
+        return fopt.MomentumOptimizer(lr_val, self._momentum,
+                                      self._use_nesterov,
+                                      regularization=reg,
+                                      grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "velocity" not in a:
+            a["velocity"] = jnp.zeros_like(p._value)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g],
+                "Velocity": [acc["velocity"]], "LearningRate": [lr]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["velocity"] = outs["VelocityOut"][0]
+
+
+class Adam(Optimizer):
+    _op_type = "adam"
+    _static_cls = fopt.AdamOptimizer
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _make_static(self, lr_val, reg):
+        return self._static_cls(lr_val, self._beta1, self._beta2,
+                                self._epsilon, regularization=reg,
+                                grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "moment1" not in a:
+            a["moment1"] = jnp.zeros(p.shape, jnp.float32)
+            a["moment2"] = jnp.zeros(p.shape, jnp.float32)
+            a["beta1_pow"] = jnp.ones((1,), jnp.float32)
+            a["beta2_pow"] = jnp.ones((1,), jnp.float32)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g], "LearningRate": [lr],
+                "Moment1": [acc["moment1"]], "Moment2": [acc["moment2"]],
+                "Beta1Pow": [acc["beta1_pow"]],
+                "Beta2Pow": [acc["beta2_pow"]]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["moment1"] = outs["Moment1Out"][0]
+        acc["moment2"] = outs["Moment2Out"][0]
+        acc["beta1_pow"] = outs["Beta1PowOut"][0]
+        acc["beta2_pow"] = outs["Beta2PowOut"][0]
+
+
+class AdamW(Adam):
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) \
+            else 0.01
+        self._apply_decay_fn = apply_decay_param_fun
+        self._op_attrs.update(coeff=self._coeff)
+
+    def _op_inputs(self, p, g, acc, lr):
+        ins = super()._op_inputs(p, g, acc, lr)
+        with_decay = self._apply_decay_fn is None or \
+            self._apply_decay_fn(p.name)
+        self._op_attrs["with_decay"] = bool(with_decay)
+        return ins
+
+    def _make_static(self, lr_val, reg):
+        # static AdamW = adam + decoupled decay via regularizer-free coeff
+        class _StaticAdamW(fopt.AdamOptimizer):
+            def __init__(s, *a, coeff=0.0, **kw):
+                super().__init__(*a, **kw)
+                s._coeff = coeff
+
+            def _append_optimize_op(s, block, pg):
+                p, g = pg
+                return block.append_op(
+                    type="adamw", inputs=s._adam_inputs(p, g),
+                    outputs=s._adam_outputs(p),
+                    attrs={"beta1": s._beta1, "beta2": s._beta2,
+                           "epsilon": s._epsilon, "coeff": s._coeff})
+        return _StaticAdamW(lr_val, self._beta1, self._beta2, self._epsilon,
+                            grad_clip=self._grad_clip, coeff=self._coeff)
+
+
+class Adagrad(Optimizer):
+    _op_type = "adagrad"
+    _static_cls = fopt.AdagradOptimizer
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, epsilon=epsilon)
+        self._epsilon = epsilon
+
+    def _make_static(self, lr_val, reg):
+        return fopt.AdagradOptimizer(lr_val, self._epsilon,
+                                     regularization=reg,
+                                     grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "moment" not in a:
+            a["moment"] = jnp.zeros(p.shape, jnp.float32)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g], "Moment": [acc["moment"]],
+                "LearningRate": [lr]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["moment"] = outs["MomentOut"][0]
+
+
+class Adamax(Optimizer):
+    _op_type = "adamax"
+    _static_cls = fopt.AdamaxOptimizer
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, beta1=beta1, beta2=beta2, epsilon=epsilon)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _make_static(self, lr_val, reg):
+        return fopt.AdamaxOptimizer(lr_val, self._beta1, self._beta2,
+                                    self._epsilon, regularization=reg,
+                                    grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "moment" not in a:
+            a["moment"] = jnp.zeros(p.shape, jnp.float32)
+            a["inf_norm"] = jnp.zeros(p.shape, jnp.float32)
+            a["beta1_pow"] = jnp.full((1,), self._beta1, jnp.float32)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g], "LearningRate": [lr],
+                "Moment": [acc["moment"]], "InfNorm": [acc["inf_norm"]],
+                "Beta1Pow": [acc["beta1_pow"]]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["moment"] = outs["MomentOut"][0]
+        acc["inf_norm"] = outs["InfNormOut"][0]
+        acc["beta1_pow"] = acc["beta1_pow"] * self._beta1
+
+
+class RMSProp(Optimizer):
+    _op_type = "rmsprop"
+    _static_cls = fopt.RMSPropOptimizer
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, decay=rho, epsilon=epsilon, momentum=momentum,
+                         centered=centered)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _make_static(self, lr_val, reg):
+        return fopt.RMSPropOptimizer(lr_val, self._rho, self._epsilon,
+                                     self._momentum, self._centered,
+                                     regularization=reg,
+                                     grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "mean_square" not in a:
+            a["mean_square"] = jnp.zeros(p.shape, jnp.float32)
+            a["moment"] = jnp.zeros(p.shape, jnp.float32)
+            a["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g], "LearningRate": [lr],
+                "MeanSquare": [acc["mean_square"]], "Moment": [acc["moment"]],
+                "MeanGrad": [acc["mean_grad"]]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["mean_square"] = outs["MeanSquareOut"][0]
+        acc["moment"] = outs["MomentOut"][0]
+        if "MeanGradOut" in outs:
+            acc["mean_grad"] = outs["MeanGradOut"][0]
+
+
+class Adadelta(Optimizer):
+    _op_type = "adadelta"
+    _static_cls = fopt.AdadeltaOptimizer
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, rho=rho, epsilon=epsilon)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _make_static(self, lr_val, reg):
+        return fopt.AdadeltaOptimizer(lr_val, self._epsilon, self._rho,
+                                      regularization=reg,
+                                      grad_clip=self._grad_clip)
+
+    def _accumulators_for(self, p):
+        import jax.numpy as jnp
+        a = self._accum.setdefault(p.name, {})
+        if "avg_sq_grad" not in a:
+            a["avg_sq_grad"] = jnp.zeros(p.shape, jnp.float32)
+            a["avg_sq_upd"] = jnp.zeros(p.shape, jnp.float32)
+        return a
+
+    def _op_inputs(self, p, g, acc, lr):
+        return {"Param": [p._value], "Grad": [g],
+                "AvgSquaredGrad": [acc["avg_sq_grad"]],
+                "AvgSquaredUpdate": [acc["avg_sq_upd"]]}
+
+    def _apply_outputs(self, p, acc, outs):
+        p._set_value(outs["ParamOut"][0])
+        acc["avg_sq_grad"] = outs["AvgSquaredGradOut"][0]
+        acc["avg_sq_upd"] = outs["AvgSquaredUpdateOut"][0]
+
+
+class Lamb(Adam):
+    _op_type = "lamb"
+    _static_cls = fopt.LambOptimizer
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._op_attrs.update(weight_decay=lamb_weight_decay)
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _make_static(self, lr_val, reg):
+        return fopt.LambOptimizer(
+            lr_val, self._lamb_wd, self._beta1, self._beta2, self._epsilon,
+            exclude_from_weight_decay_fn=self._exclude_fn,
+            grad_clip=self._grad_clip)

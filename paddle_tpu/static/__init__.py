@@ -1,0 +1,64 @@
+"""paddle.static namespace (reference python/paddle/static/)."""
+from __future__ import annotations
+
+from ..fluid import layers as _layers
+from ..fluid.executor import Executor, global_scope, scope_guard
+from ..fluid.framework import (Program, Variable, default_main_program,
+                               default_startup_program, program_guard,
+                               name_scope, device_guard)
+from ..fluid.backward import append_backward, gradients
+from ..fluid.param_attr import ParamAttr
+from ..fluid.io import (save, load, save_inference_model,
+                        load_inference_model)
+from ..fluid.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import nn
+
+__all__ = [
+    "data", "InputSpec", "Executor", "global_scope", "scope_guard",
+    "Program", "Variable", "default_main_program", "default_startup_program",
+    "program_guard", "name_scope", "device_guard", "append_backward",
+    "gradients", "ParamAttr", "save", "load", "save_inference_model",
+    "load_inference_model", "CompiledProgram", "BuildStrategy",
+    "ExecutionStrategy", "nn", "accuracy", "auc",
+]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return _layers.data(name, shape, dtype, lod_level)
+
+
+class InputSpec:
+    """Shape/dtype spec for jit.to_static inputs
+    (reference python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype!r})"
+
+
+accuracy = _layers.accuracy
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..fluid.layer_helper import LayerHelper
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        shape=[num_thresholds + 1], dtype="int64", persistable=True,
+        value=0.0)
+    stat_neg = helper.create_global_variable(
+        shape=[num_thresholds + 1], dtype="int64", persistable=True,
+        value=0.0)
+    auc_out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label], "StatPos": [stat_pos],
+                "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos],
+                 "StatNegOut": [stat_neg]},
+        attrs={"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, None, [stat_pos, stat_neg]

@@ -1,0 +1,6 @@
+"""paddle.static.nn — static-graph layer functions (reference python/paddle/static/nn/)."""
+from ..fluid.layers.nn import (fc, conv2d, pool2d, batch_norm, layer_norm,
+                               group_norm, instance_norm, embedding)
+
+__all__ = ["fc", "conv2d", "pool2d", "batch_norm", "layer_norm",
+           "group_norm", "instance_norm", "embedding"]

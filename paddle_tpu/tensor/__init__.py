@@ -1,0 +1,15 @@
+"""paddle.tensor namespace (reference python/paddle/tensor/)."""
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+__all__ = (list(creation.__all__) + list(linalg.__all__) +
+           list(logic.__all__) + list(manipulation.__all__) +
+           list(math.__all__) + list(random.__all__) +
+           list(search.__all__) + list(stat.__all__))

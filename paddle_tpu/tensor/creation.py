@@ -1,0 +1,138 @@
+"""Tensor creation APIs (reference python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..common_ops import run_op
+from ..fluid import core
+from ..fluid.framework import in_dygraph_mode
+from ..fluid.dygraph.varbase import Tensor, to_tensor_value
+
+__all__ = ["to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+           "full_like", "arange", "eye", "linspace", "empty", "empty_like",
+           "tril", "triu", "diag", "meshgrid", "assign", "clone"]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data._value if dtype is None
+                   else data._value.astype(core.convert_dtype(dtype)),
+                   stop_gradient=stop_gradient)
+        return t
+    return Tensor(to_tensor_value(data, dtype), stop_gradient=stop_gradient)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    dtype = core.convert_dtype(dtype) if dtype else "float32"
+    return run_op("fill_constant", {},
+                  {"shape": [int(s) for s in shape], "value": float(fill_value),
+                   "dtype": dtype}, out_dtype=dtype, stop_gradient=True)
+
+
+def zeros(shape, dtype=None, name=None):
+    return full(shape, 0.0, dtype)
+
+
+def ones(shape, dtype=None, name=None):
+    return full(shape, 1.0, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return run_op("fill_any_like", {"X": x},
+                  {"value": 0.0, "dtype": core.convert_dtype(dtype)
+                   if dtype else -1}, stop_gradient=True)
+
+
+def ones_like(x, dtype=None, name=None):
+    return run_op("fill_any_like", {"X": x},
+                  {"value": 1.0, "dtype": core.convert_dtype(dtype)
+                   if dtype else -1}, stop_gradient=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return run_op("fill_any_like", {"X": x},
+                  {"value": float(fill_value),
+                   "dtype": core.convert_dtype(dtype) if dtype else -1},
+                  stop_gradient=True)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    dtype = core.convert_dtype(dtype) if dtype else (
+        "int64" if all(isinstance(v, int) for v in (start, end, step))
+        else "float32")
+    if in_dygraph_mode():
+        import jax.numpy as jnp
+        return Tensor(jnp.arange(start, end, step,
+                                 dtype=np.dtype(dtype)), stop_gradient=True)
+    from ..fluid import layers
+    return layers.range(start, end, step, dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return run_op("eye", {},
+                  {"num_rows": int(num_rows),
+                   "num_columns": int(num_columns or -1),
+                   "dtype": core.convert_dtype(dtype) if dtype else "float32"},
+                  stop_gradient=True)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    import jax.numpy as jnp
+    dtype = core.convert_dtype(dtype) if dtype else "float32"
+    if in_dygraph_mode():
+        return Tensor(jnp.linspace(start, stop, int(num),
+                                   dtype=np.dtype(dtype)), stop_gradient=True)
+    from ..fluid import layers
+    s = layers.fill_constant([1], dtype, float(start))
+    e = layers.fill_constant([1], dtype, float(stop))
+    n = layers.fill_constant([1], "int32", int(num))
+    return run_op("linspace", {"Start": s, "Stop": e, "Num": n},
+                  {"dtype": dtype}, out_dtype=dtype)
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def tril(x, diagonal=0, name=None):
+    return run_op("tril_triu", {"X": x},
+                  {"diagonal": int(diagonal), "lower": True})
+
+
+def triu(x, diagonal=0, name=None):
+    return run_op("tril_triu", {"X": x},
+                  {"diagonal": int(diagonal), "lower": False})
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return run_op("diag_v2", {"X": x},
+                  {"offset": int(offset),
+                   "padding_value": float(padding_value)})
+
+
+def meshgrid(*args, **kwargs):
+    from ..common_ops import run_op_multi
+    xs = list(args[0]) if len(args) == 1 and \
+        isinstance(args[0], (list, tuple)) else list(args)
+    res = run_op_multi("meshgrid", {"X": xs}, {}, {"Out": len(xs)})
+    return res["Out"]
+
+
+def assign(x, output=None):
+    if isinstance(x, (np.ndarray, int, float, list, tuple)):
+        arr = np.asarray(x)
+        if in_dygraph_mode():
+            return to_tensor(arr)
+        from ..fluid import layers
+        return layers.assign(arr, output)
+    return run_op("assign", {"X": x})
+
+
+def clone(x, name=None):
+    return run_op("assign", {"X": x})

@@ -1,0 +1,57 @@
+"""Linear-algebra APIs (reference python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op
+from . import math as m
+
+__all__ = ["matmul", "norm", "dist", "t", "cross", "cholesky", "bmm",
+           "histogram", "dot"]
+
+matmul = m.matmul
+bmm = m.bmm
+dot = m.dot
+t = m.t
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    if p == "fro" and axis is None:
+        return run_op("frobenius_norm", {"X": x})
+    if axis is None:
+        return run_op("p_norm", {"X": x},
+                      {"porder": float(p), "asvector": True})
+    return run_op("p_norm", {"X": x},
+                  {"porder": float(p), "axis": int(axis), "keepdim": keepdim})
+
+
+def dist(x, y, p=2, name=None):
+    return norm(m.subtract(x, y), p=p)
+
+
+def cross(x, y, axis=None, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    from ..fluid.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        return Tensor(jnp.cross(x._value, y._value,
+                                axis=axis if axis is not None else -1),
+                      stop_gradient=x.stop_gradient and y.stop_gradient)
+    raise NotImplementedError
+
+
+def cholesky(x, upper=False, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    from ..fluid.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        c = jnp.linalg.cholesky(x._value)
+        return Tensor(jnp.swapaxes(c, -1, -2) if upper else c,
+                      stop_gradient=x.stop_gradient)
+    raise NotImplementedError
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    h, _ = jnp.histogram(input._value.reshape(-1), bins=bins,
+                         range=None if min == max == 0 else (min, max))
+    return Tensor(h.astype(jnp.int64), stop_gradient=True)

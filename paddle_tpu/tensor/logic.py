@@ -1,0 +1,53 @@
+"""Logic / comparison APIs (reference python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op
+
+__all__ = ["equal", "not_equal", "less_than", "less_equal", "greater_than",
+           "greater_equal", "logical_and", "logical_or", "logical_not",
+           "logical_xor", "equal_all", "allclose", "is_empty"]
+
+
+def _cmp(op):
+    def fn(x, y, name=None):
+        return run_op(op, {"X": x, "Y": y}, out_dtype="bool",
+                      stop_gradient=True)
+    fn.__name__ = op
+    return fn
+
+
+equal = _cmp("equal")
+not_equal = _cmp("not_equal")
+less_than = _cmp("less_than")
+less_equal = _cmp("less_equal")
+greater_than = _cmp("greater_than")
+greater_equal = _cmp("greater_equal")
+logical_and = _cmp("logical_and")
+logical_or = _cmp("logical_or")
+logical_xor = _cmp("logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return run_op("logical_not", {"X": x}, out_dtype="bool",
+                  stop_gradient=True)
+
+
+def equal_all(x, y, name=None):
+    from . import math as m
+    return m.all(equal(x, y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    from ..fluid.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol,
+                                   equal_nan=equal_nan), stop_gradient=True)
+    raise NotImplementedError
+
+
+def is_empty(x, name=None):
+    import numpy as np
+    from ..fluid.dygraph.varbase import Tensor
+    return Tensor(np.asarray(int(np.prod(x.shape)) == 0), stop_gradient=True)

@@ -1,0 +1,180 @@
+"""Manipulation APIs (reference python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op, run_op_multi
+
+__all__ = [
+    "reshape", "transpose", "concat", "split", "stack", "unstack", "squeeze",
+    "unsqueeze", "flatten", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "slice", "strided_slice", "expand", "expand_as",
+    "tile", "flip", "roll", "cast", "chunk", "unbind", "index_select",
+    "index_sample", "masked_fill", "where", "broadcast_to", "unique",
+]
+
+
+def reshape(x, shape, name=None):
+    return run_op("reshape2", {"X": x}, {"shape": [int(s) for s in shape]},
+                  extra_outs=("XShape",))
+
+
+def transpose(x, perm, name=None):
+    return run_op("transpose2", {"X": x}, {"axis": [int(p) for p in perm]},
+                  extra_outs=("XShape",))
+
+
+def concat(x, axis=0, name=None):
+    return run_op("concat", {"X": list(x)}, {"axis": int(axis)})
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(num_or_sections, int):
+        n, sections = num_or_sections, []
+    else:
+        n = len(num_or_sections)
+        sections = [int(s) for s in num_or_sections]
+    res = run_op_multi("split", {"X": x},
+                       {"axis": int(axis), "num": 0 if sections else n,
+                        "sections": sections}, {"Out": n})
+    return res["Out"]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def stack(x, axis=0, name=None):
+    return run_op("stack", {"X": list(x)}, {"axis": int(axis)}, out_slot="Y")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    res = run_op_multi("unstack", {"X": x}, {"axis": int(axis), "num": n},
+                       {"Y": n})
+    return res["Y"]
+
+
+def unbind(input, axis=0):
+    n = input.shape[axis]
+    res = run_op_multi("unbind", {"X": input}, {"axis": int(axis)},
+                       {"Out": n})
+    return res["Out"]
+
+
+def squeeze(x, axis=None, name=None):
+    axes = [] if axis is None else (
+        [int(axis)] if isinstance(axis, int) else [int(a) for a in axis])
+    return run_op("squeeze2", {"X": x}, {"axes": axes},
+                  extra_outs=("XShape",))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = [int(axis)] if isinstance(axis, int) else [int(a) for a in axis]
+    return run_op("unsqueeze2", {"X": x}, {"axes": axes},
+                  extra_outs=("XShape",))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return run_op("flatten_contiguous_range", {"X": x},
+                  {"start_axis": int(start_axis), "stop_axis": int(stop_axis)},
+                  extra_outs=("XShape",))
+
+
+def gather(x, index, axis=None, name=None):
+    return run_op("gather", {"X": x, "Index": index},
+                  {"axis": int(axis) if axis is not None else 0})
+
+
+def gather_nd(x, index, name=None):
+    return run_op("gather_nd", {"X": x, "Index": index})
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return run_op("scatter", {"X": x, "Ids": index, "Updates": updates},
+                  {"overwrite": overwrite})
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return run_op("scatter_nd_add",
+                  {"X": x, "Index": index, "Updates": updates})
+
+
+def slice(input, axes, starts, ends):
+    return run_op("slice", {"Input": input},
+                  {"axes": [int(a) for a in axes],
+                   "starts": [int(s) for s in starts],
+                   "ends": [int(e) for e in ends],
+                   "decrease_axis": [], "infer_flags": [1] * len(axes)})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return run_op("strided_slice", {"Input": x},
+                  {"axes": [int(a) for a in axes],
+                   "starts": [int(s) for s in starts],
+                   "ends": [int(e) for e in ends],
+                   "strides": [int(s) for s in strides]})
+
+
+def expand(x, shape, name=None):
+    return run_op("expand_v2", {"X": x}, {"shape": [int(s) for s in shape]})
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return run_op("expand_as_v2", {"X": x, "target_tensor": y})
+
+
+def tile(x, repeat_times, name=None):
+    return run_op("tile", {"X": x},
+                  {"repeat_times": [int(r) for r in repeat_times]})
+
+
+def flip(x, axis, name=None):
+    axes = [int(axis)] if isinstance(axis, int) else [int(a) for a in axis]
+    return run_op("flip", {"X": x}, {"axis": axes})
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = [int(shifts)] if isinstance(shifts, int) else [int(s) for s in shifts]
+    ax = [] if axis is None else (
+        [int(axis)] if isinstance(axis, int) else [int(a) for a in axis])
+    return run_op("roll", {"X": x}, {"shifts": sh, "axis": ax})
+
+
+def cast(x, dtype):
+    from ..fluid import core
+    return run_op("cast", {"X": x},
+                  {"in_dtype": x.dtype, "out_dtype": core.convert_dtype(dtype)},
+                  out_dtype=core.convert_dtype(dtype))
+
+
+def index_select(x, index, axis=0, name=None):
+    return run_op("index_select", {"X": x, "Index": index},
+                  {"dim": int(axis)})
+
+
+def index_sample(x, index):
+    return run_op("index_sample", {"X": x, "Index": index})
+
+
+def masked_fill(x, mask, value, name=None):
+    return run_op("masked_fill", {"X": x, "Mask": mask},
+                  {"value": float(value)})
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None or y is None:
+        raise NotImplementedError(
+            "where(cond) nonzero-style is dynamic-shape; not supported on TPU")
+    return run_op("where", {"Condition": condition, "X": x, "Y": y})
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    res = run_op_multi("unique", {"X": x}, {"dtype": dtype},
+                       {"Out": 1, "Index": 1})
+    outs = [res["Out"][0]]
+    if return_inverse:
+        outs.append(res["Index"][0])
+    return outs[0] if len(outs) == 1 else tuple(outs)

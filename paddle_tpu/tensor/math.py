@@ -1,0 +1,249 @@
+"""Math APIs (reference python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "matmul", "mm", "bmm", "dot", "t", "addmm", "maximum", "minimum",
+    "sum", "mean", "max", "min", "prod", "abs", "exp", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "square", "sign", "ceil", "floor",
+    "round", "reciprocal", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "erf", "clip", "scale", "cumsum", "kron",
+    "sigmoid", "increment", "stanh", "multiplex", "logsumexp", "isfinite",
+    "isnan", "isinf", "trace", "all", "any",
+]
+
+
+def _ew(op, x, y, name=None):
+    return run_op(op, {"X": x, "Y": y}, {"axis": -1})
+
+
+def add(x, y, name=None):
+    return _ew("elementwise_add", x, y)
+
+
+def subtract(x, y, name=None):
+    return _ew("elementwise_sub", x, y)
+
+
+def multiply(x, y, name=None):
+    return _ew("elementwise_mul", x, y)
+
+
+def divide(x, y, name=None):
+    return _ew("elementwise_div", x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _ew("elementwise_floordiv", x, y)
+
+
+def mod(x, y, name=None):
+    return _ew("elementwise_mod", x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+def pow(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return run_op("pow", {"X": x}, {"factor": float(y)})
+    return _ew("elementwise_pow", x, y)
+
+
+def maximum(x, y, name=None):
+    return _ew("elementwise_max", x, y)
+
+
+def minimum(x, y, name=None):
+    return _ew("elementwise_min", x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return run_op("matmul_v2", {"X": x, "Y": y},
+                  {"trans_x": transpose_x, "trans_y": transpose_y})
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return run_op("bmm", {"X": x, "Y": y})
+
+
+def dot(x, y, name=None):
+    return run_op("dot", {"X": x, "Y": y})
+
+
+def t(input, name=None):
+    ndim = len(input.shape)
+    if ndim < 2:
+        return input
+    return run_op("transpose2", {"X": input}, {"axis": [1, 0]},
+                  extra_outs=("XShape",))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op("addmm", {"Input": input, "X": x, "Y": y},
+                  {"Alpha": float(alpha), "Beta": float(beta)})
+
+
+def _reduce(op_type, x, axis=None, keepdim=False):
+    if axis is None:
+        attrs = {"dim": [0], "keep_dim": keepdim, "reduce_all": True}
+    else:
+        d = axis if isinstance(axis, (list, tuple)) else [axis]
+        attrs = {"dim": [int(a) for a in d], "keep_dim": keepdim,
+                 "reduce_all": False}
+    return run_op(op_type, {"X": x}, attrs)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    r = _reduce("reduce_sum", x, axis, keepdim)
+    if dtype is not None:
+        r = r.astype(dtype) if hasattr(r, "astype") else r
+    return r
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_mean", x, axis, keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_max", x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_min", x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return _reduce("reduce_prod", x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_all", x, axis, keepdim)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _reduce("reduce_any", x, axis, keepdim)
+
+
+def _unary(op_type):
+    def fn(x, name=None):
+        return run_op(op_type, {"X": x})
+    fn.__name__ = op_type
+    return fn
+
+
+abs = _unary("abs")
+exp = _unary("exp")
+log = _unary("log")
+log2 = _unary("log2")
+log10 = _unary("log10")
+log1p = _unary("log1p")
+sqrt = _unary("sqrt")
+rsqrt = _unary("rsqrt")
+square = _unary("square")
+sign = _unary("sign")
+ceil = _unary("ceil")
+floor = _unary("floor")
+round = _unary("round")
+reciprocal = _unary("reciprocal")
+sin = _unary("sin")
+cos = _unary("cos")
+tan = _unary("tan")
+asin = _unary("asin")
+acos = _unary("acos")
+atan = _unary("atan")
+sinh = _unary("sinh")
+cosh = _unary("cosh")
+tanh = _unary("tanh")
+erf = _unary("erf")
+sigmoid = _unary("sigmoid")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op("stanh", {"X": x}, {"scale_a": scale_a, "scale_b": scale_b})
+
+
+def clip(x, min=None, max=None, name=None):
+    return run_op("clip", {"X": x},
+                  {"min": float(min) if min is not None else float("-inf"),
+                   "max": float(max) if max is not None else float("inf")})
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    return run_op("scale", {"X": x},
+                  {"scale": float(scale), "bias": float(bias),
+                   "bias_after_scale": bias_after_scale})
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return run_op("cumsum", {"X": x},
+                  {"axis": int(axis) if axis is not None else -1,
+                   "flatten": axis is None})
+
+
+def kron(x, y, name=None):
+    return run_op("kron", {"X": x, "Y": y})
+
+
+def increment(x, value=1.0, name=None):
+    return run_op("increment", {"X": x}, {"step": float(value)})
+
+
+def multiplex(inputs, index, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    stacked = run_op("stack", {"X": list(inputs)}, {"axis": 0},
+                     out_slot="Y")
+    return run_op("index_sample_stack_pick", {"X": stacked},
+                  {}) if False else _multiplex_impl(inputs, index)
+
+
+def _multiplex_impl(inputs, index):
+    from ..fluid.framework import in_dygraph_mode
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    if in_dygraph_mode():
+        idx = index._value.reshape(-1).astype("int32")
+        rows = jnp.stack([t._value for t in inputs])  # (k, n, d)
+        picked = rows[idx, jnp.arange(rows.shape[1])]
+        return Tensor(picked, stop_gradient=all(
+            t.stop_gradient for t in inputs))
+    raise NotImplementedError("multiplex static mode: use gather compose")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    m = max(x, axis=axis, keepdim=True)
+    e = exp(subtract(x, m))
+    s = sum(e, axis=axis, keepdim=keepdim)
+    r = log(s)
+    m2 = m if keepdim else _reduce("reduce_max", x, axis, keepdim)
+    return add(r, m2)
+
+
+def isfinite(x, name=None):
+    return run_op("isfinite_v2", {"X": x}, stop_gradient=True)
+
+
+def isnan(x, name=None):
+    return run_op("isnan_v2", {"X": x}, stop_gradient=True)
+
+
+def isinf(x, name=None):
+    return run_op("isinf_v2", {"X": x}, stop_gradient=True)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    import jax.numpy as jnp
+    from ..fluid.framework import in_dygraph_mode
+    from ..fluid.dygraph.varbase import Tensor
+    if in_dygraph_mode():
+        return Tensor(jnp.trace(x._value, offset, axis1, axis2),
+                      stop_gradient=x.stop_gradient)
+    raise NotImplementedError

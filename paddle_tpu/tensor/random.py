@@ -1,0 +1,57 @@
+"""Random APIs (reference python/paddle/tensor/random.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op
+
+__all__ = ["normal", "uniform", "randn", "rand", "randint", "randperm",
+           "bernoulli", "multinomial", "standard_normal"]
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return run_op("gaussian_random", {},
+                  {"shape": [int(s) for s in (shape or [1])],
+                   "mean": float(mean), "std": float(std),
+                   "dtype": "float32"}, stop_gradient=True)
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return run_op("gaussian_random", {},
+                  {"shape": [int(s) for s in shape], "mean": 0.0, "std": 1.0,
+                   "dtype": dtype}, stop_gradient=True)
+
+
+randn = standard_normal
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    return run_op("uniform_random", {},
+                  {"shape": [int(s) for s in shape], "min": float(min),
+                   "max": float(max), "seed": seed, "dtype": dtype},
+                  stop_gradient=True)
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return run_op("randint", {},
+                  {"shape": [int(s) for s in shape], "low": int(low),
+                   "high": int(high), "dtype": dtype}, stop_gradient=True)
+
+
+def randperm(n, dtype="int64", name=None):
+    return run_op("randperm", {}, {"n": int(n), "dtype": dtype},
+                  stop_gradient=True)
+
+
+def bernoulli(x, name=None):
+    return run_op("bernoulli", {"X": x}, stop_gradient=True)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return run_op("multinomial", {"X": x},
+                  {"num_samples": int(num_samples),
+                   "replacement": replacement}, stop_gradient=True)

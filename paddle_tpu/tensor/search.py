@@ -1,0 +1,56 @@
+"""Search / sort APIs (reference python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+from ..common_ops import run_op, run_op_multi
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "where",
+           "index_select", "nonzero", "masked_select"]
+
+from .manipulation import index_select, where  # noqa: F401
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return run_op("arg_max", {"X": x},
+                  {"axis": int(axis) if axis is not None else -1,
+                   "keepdims": keepdim, "flatten": axis is None,
+                   "dtype": dtype}, out_dtype=dtype, stop_gradient=True)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return run_op("arg_min", {"X": x},
+                  {"axis": int(axis) if axis is not None else -1,
+                   "keepdims": keepdim, "flatten": axis is None,
+                   "dtype": dtype}, out_dtype=dtype, stop_gradient=True)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    res = run_op_multi("argsort", {"X": x},
+                       {"axis": int(axis), "descending": descending},
+                       {"Out": 1, "Indices": 1})
+    return res["Indices"][0]
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    res = run_op_multi("argsort", {"X": x},
+                       {"axis": int(axis), "descending": descending},
+                       {"Out": 1, "Indices": 1})
+    return res["Out"][0]
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    res = run_op_multi("top_k_v2", {"X": x},
+                       {"k": int(k), "axis": int(axis)
+                        if axis is not None else -1,
+                        "largest": largest, "sorted": sorted},
+                       {"Out": 1, "Indices": "int64"})
+    return res["Out"][0], res["Indices"][0]
+
+
+def nonzero(x, as_tuple=False):
+    raise NotImplementedError(
+        "nonzero produces dynamic shapes; use masks on TPU")
+
+
+def masked_select(x, mask, name=None):
+    raise NotImplementedError(
+        "masked_select produces dynamic shapes; use where(mask, x, 0) on TPU")

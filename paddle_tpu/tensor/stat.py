@@ -1,0 +1,46 @@
+"""Statistics APIs (reference python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+from . import math as m
+from ..common_ops import run_op
+
+__all__ = ["mean", "std", "var", "numel", "median"]
+
+mean = m.mean
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    mu = m.mean(x, axis=axis, keepdim=True)
+    sq = m.square(m.subtract(x, mu))
+    r = m.mean(sq, axis=axis, keepdim=keepdim)
+    if unbiased:
+        import numpy as np
+        shape = x.shape
+        if axis is None:
+            n = int(np.prod(shape))
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            n = int(np.prod([shape[a] for a in axes]))
+        if n > 1:
+            r = m.scale(r, scale=n / (n - 1))
+    return r
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return m.sqrt(var(x, axis, unbiased, keepdim))
+
+
+def numel(x, name=None):
+    import numpy as np
+    from .creation import to_tensor
+    return to_tensor(np.asarray(int(np.prod(x.shape)), dtype="int64"))
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    import jax.numpy as jnp
+    from ..fluid.dygraph.varbase import Tensor
+    from ..fluid.framework import in_dygraph_mode
+    if in_dygraph_mode():
+        return Tensor(jnp.median(x._value, axis=axis, keepdims=keepdim),
+                      stop_gradient=True)
+    raise NotImplementedError

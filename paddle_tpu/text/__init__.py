@@ -1,0 +1,58 @@
+"""paddle.text (reference python/paddle/text/): NLP datasets.
+Zero-egress: synthetic corpora with realistic shapes."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "WMT14", "UCIHousing"]
+
+
+class Imdb(Dataset):
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        rng = np.random.RandomState(7 if mode == "train" else 8)
+        self.n = 256 if mode == "train" else 64
+        self.seq_len = 128
+        self.vocab = 5000
+        self.docs = rng.randint(1, self.vocab, (self.n, self.seq_len)) \
+            .astype("int64")
+        self.labels = rng.randint(0, 2, self.n).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.array([self.labels[idx]], dtype="int64")
+
+    def __len__(self):
+        return self.n
+
+    def word_idx(self):
+        return {f"w{i}": i for i in range(self.vocab)}
+
+
+class WMT14(Dataset):
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        rng = np.random.RandomState(11)
+        self.n = 128
+        self.src = rng.randint(1, dict_size, (self.n, 32)).astype("int64")
+        self.tgt = rng.randint(1, dict_size, (self.n, 32)).astype("int64")
+
+    def __getitem__(self, idx):
+        return self.src[idx], self.tgt[idx], self.tgt[idx]
+
+    def __len__(self):
+        return self.n
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(3 if mode == "train" else 4)
+        self.n = 404 if mode == "train" else 102
+        self.x = rng.randn(self.n, 13).astype("float32")
+        w = rng.randn(13, 1).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.randn(self.n, 1)).astype("float32")
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return self.n

@@ -1,0 +1,25 @@
+"""paddle.utils (reference python/paddle/utils/)."""
+from . import profiler
+from .profiler import Profiler
+
+__all__ = ["profiler", "Profiler", "try_import", "unique_name"]
+
+from ..fluid import unique_name
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"cannot import {module_name}")
+
+
+def run_check():
+    """paddle.utils.run_check — verify the install can run a training step."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), dtype="float32"))
+    y = paddle.matmul(x, x)
+    assert np.allclose(y.numpy(), 2 * np.ones((2, 2)))
+    print("paddle_tpu is installed successfully!")

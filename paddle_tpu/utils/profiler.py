@@ -1,0 +1,94 @@
+"""Profiler (reference platform/profiler.h + python/paddle/fluid/profiler.py).
+
+TPU-native: jax.profiler (XPlane) traces device + host; op-phase markers come
+from the executor's jax.named_scope per op (replacing RecordEvent RAII at
+framework/operator.cc:984). View with TensorBoard or Perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+__all__ = ["Profiler", "profiler", "start_profiler", "stop_profiler",
+           "RecordEvent"]
+
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option="Default",
+                   trace_dir="/tmp/paddle_tpu_trace"):
+    global _trace_dir
+    _trace_dir = trace_dir
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+    return _trace_dir
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None,
+             tracer_option="Default"):
+    start_profiler(state, tracer_option,
+                   profile_path or "/tmp/paddle_tpu_trace")
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """Host event marker (reference platform/profiler.h:126)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = jax.profiler.TraceAnnotation(self.name)
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    begin = __enter__
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+class Profiler:
+    """2.0-style paddle.profiler.Profiler."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 trace_dir="/tmp/paddle_tpu_trace"):
+        self.trace_dir = trace_dir
+        self._running = False
+
+    def start(self):
+        start_profiler(trace_dir=self.trace_dir)
+        self._running = True
+
+    def stop(self):
+        if self._running:
+            stop_profiler()
+            self._running = False
+
+    def step(self):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def summary(self, **kw):
+        return f"trace written to {self.trace_dir} (view with TensorBoard)"

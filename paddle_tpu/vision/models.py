@@ -1,0 +1,9 @@
+"""paddle.vision.models — re-export of the model zoo."""
+from ..models import LeNet
+
+__all__ = ["LeNet"]
+
+
+def __getattr__(name):
+    from .. import models as _m
+    return getattr(_m, name)

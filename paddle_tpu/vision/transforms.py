@@ -1,0 +1,107 @@
+"""Vision transforms (reference python/paddle/vision/transforms/) — numpy
+implementations of the common train-pipeline transforms."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+           "RandomHorizontalFlip", "ToTensor", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype="float32")
+        self.std = np.asarray(std, dtype="float32")
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype="float32")
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype="float32") / 255.0
+        if arr.ndim == 3 and self.data_format == "CHW" and \
+                arr.shape[-1] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        oh, ow = self.size
+        ih, iw = img.shape[h_axis], img.shape[h_axis + 1]
+        yi = (np.arange(oh) * ih / oh).astype(int)
+        xi = (np.arange(ow) * iw / ow).astype(int)
+        if chw:
+            return img[:, yi][:, :, xi]
+        return img[yi][:, xi]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        th, tw = self.size
+        h, w = img.shape[h_axis], img.shape[h_axis + 1]
+        i, j = (h - th) // 2, (w - tw) // 2
+        if chw:
+            return img[:, i:i + th, j:j + tw]
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(CenterCrop):
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        th, tw = self.size
+        h, w = img.shape[h_axis], img.shape[h_axis + 1]
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        if chw:
+            return img[:, i:i + th, j:j + tw]
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return np.asarray(img)

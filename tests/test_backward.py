@@ -1,0 +1,118 @@
+"""append_backward correctness: analytic graph grads vs finite differences —
+the OpTest strategy of the reference (op_test.py:57 get_numeric_gradient)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import Executor, framework, layers
+
+
+def numeric_grad(run_loss, x0, eps=1e-3):
+    g = np.zeros_like(x0)
+    flat = x0.reshape(-1)
+    for i in range(flat.size):
+        xp = flat.copy(); xp[i] += eps
+        xm = flat.copy(); xm[i] -= eps
+        g.reshape(-1)[i] = (run_loss(xp.reshape(x0.shape)) -
+                            run_loss(xm.reshape(x0.shape))) / (2 * eps)
+    return g
+
+
+@pytest.mark.parametrize("op_build", [
+    lambda x: ("relu", None),
+    lambda x: ("tanh", None),
+    lambda x: ("sigmoid", None),
+    lambda x: ("square", None),
+])
+def test_unary_grads(fresh_programs, op_build):
+    main, startup, scope = fresh_programs
+    op_type, _ = op_build(None)
+    x = layers.data("x", [4, 5], "float32", stop_gradient=False)
+    from paddle_tpu.fluid.layer_helper import LayerHelper
+    helper = LayerHelper(op_type)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type=op_type, inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    loss = layers.mean(out)
+    from paddle_tpu.fluid.backward import append_backward
+    append_backward(loss)
+    exe = Executor()
+    x0 = np.random.randn(4, 5).astype("float32") + 0.1
+
+    def run_loss(xv):
+        lv, = exe.run(main, feed={"x": xv.astype("float32")},
+                      fetch_list=[loss])
+        return float(lv)
+
+    g, = exe.run(main, feed={"x": x0}, fetch_list=["x@GRAD"])
+    ng = numeric_grad(run_loss, x0)
+    np.testing.assert_allclose(g, ng, rtol=1e-2, atol=1e-3)
+
+
+def test_matmul_grad(fresh_programs):
+    main, startup, scope = fresh_programs
+    a = layers.data("a", [3, 4], "float32", stop_gradient=False)
+    b = layers.data("b", [4, 2], "float32", stop_gradient=False)
+    c = layers.matmul(a, b)
+    loss = layers.mean(c)
+    from paddle_tpu.fluid.backward import append_backward
+    append_backward(loss)
+    exe = Executor()
+    a0 = np.random.randn(3, 4).astype("float32")
+    b0 = np.random.randn(4, 2).astype("float32")
+    ga, gb = exe.run(main, feed={"a": a0, "b": b0},
+                     fetch_list=["a@GRAD", "b@GRAD"])
+    # analytic: dL/dA = (1/N) @ B^T broadcast
+    n = 6.0
+    np.testing.assert_allclose(ga, np.ones((3, 2)) / n @ b0.T, rtol=1e-5)
+    np.testing.assert_allclose(gb, a0.T @ (np.ones((3, 2)) / n), rtol=1e-5)
+
+
+def test_fanout_accumulation(fresh_programs):
+    """x used twice -> grads must sum (reference _addup_repetitive_outputs_)."""
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [2, 3], "float32", stop_gradient=False)
+    y1 = layers.elementwise_mul(x, x)       # x^2
+    y2 = layers.scale(x, scale=3.0)         # 3x
+    s = layers.elementwise_add(y1, y2)
+    loss = layers.reduce_sum(s)
+    from paddle_tpu.fluid.backward import append_backward
+    append_backward(loss)
+    exe = Executor()
+    x0 = np.random.randn(2, 3).astype("float32")
+    g, = exe.run(main, feed={"x": x0}, fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(g, 2 * x0 + 3.0, rtol=1e-5)
+
+
+def test_stop_gradient_blocks_flow(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [2, 2], "float32", stop_gradient=False)
+    y = layers.data("y", [2, 2], "float32")  # stop_gradient=True default
+    z = layers.elementwise_mul(x, y)
+    loss = layers.reduce_sum(z)
+    from paddle_tpu.fluid.backward import append_backward
+    append_backward(loss)
+    names = {n for op in main.global_block().ops
+             for n in op.output_arg_names}
+    assert "x@GRAD" in names
+    assert "y@GRAD" not in names
+
+
+def test_softmax_xent_grad(fresh_programs):
+    main, startup, scope = fresh_programs
+    logits = layers.data("logits", [4, 7], "float32", stop_gradient=False)
+    label = layers.data("label", [4, 1], "int64")
+    loss_v = layers.softmax_with_cross_entropy(logits, label)
+    loss = layers.mean(loss_v)
+    from paddle_tpu.fluid.backward import append_backward
+    append_backward(loss)
+    exe = Executor()
+    l0 = np.random.randn(4, 7).astype("float32")
+    lab = np.random.randint(0, 7, (4, 1)).astype("int64")
+    g, = exe.run(main, feed={"logits": l0, "label": lab},
+                 fetch_list=["logits@GRAD"])
+    # analytic: (softmax - onehot)/N
+    sm = np.exp(l0 - l0.max(-1, keepdims=True))
+    sm /= sm.sum(-1, keepdims=True)
+    onehot = np.eye(7)[lab[:, 0]]
+    np.testing.assert_allclose(g, (sm - onehot) / 4.0, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,102 @@
+"""Dygraph (eager) mode: tape autograd, nn layers, optimizer step
+(reference test_imperative_basic.py / test_imperative_mnist.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_eager_basic_ops():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+    y = x + 1.0
+    z = paddle.matmul(y, y)
+    assert z.shape == (2, 2)
+    np.testing.assert_allclose(
+        z.numpy(), (x.numpy() + 1) @ (x.numpy() + 1), rtol=1e-6)
+
+
+def test_eager_backward():
+    x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+    x.stop_gradient = False
+    y = paddle.sum(paddle.multiply(x, x))
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor(np.array([2.0], "float32"))
+    x.stop_gradient = False
+    y = paddle.multiply(x, x)
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)
+
+
+def test_linear_layer_training():
+    np.random.seed(0)
+    model = paddle.nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    w_true = np.random.randn(4, 1).astype("float32")
+    losses = []
+    for _ in range(60):
+        xb = np.random.randn(16, 4).astype("float32")
+        yb = xb @ w_true
+        pred = model(paddle.to_tensor(xb))
+        loss = paddle.nn.functional.mse_loss(pred, paddle.to_tensor(yb))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.1, losses[::10]
+
+
+def test_sequential_mnist_eager():
+    np.random.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Flatten(),
+        paddle.nn.Linear(784, 64),
+        paddle.nn.ReLU(),
+        paddle.nn.Linear(64, 10))
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    protos = rng.randn(10, 1, 28, 28).astype("float32")
+    losses = []
+    for _ in range(25):
+        lab = rng.randint(0, 10, 32).astype("int64")
+        img = protos[lab] + 0.3 * rng.randn(32, 1, 28, 28).astype("float32")
+        logits = model(paddle.to_tensor(img))
+        loss = paddle.nn.functional.cross_entropy(
+            logits, paddle.to_tensor(lab[:, None]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses[::5]
+
+
+def test_state_dict_roundtrip():
+    m1 = paddle.nn.Linear(3, 2)
+    m2 = paddle.nn.Linear(3, 2)
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.to_tensor(np.random.randn(2, 3).astype("float32"))
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_no_grad():
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    x.stop_gradient = False
+    with paddle.no_grad():
+        y = paddle.multiply(x, x)
+    assert y.stop_gradient
+
+
+def test_dropout_train_eval():
+    m = paddle.nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), "float32"))
+    m.train()
+    y_train = m(x)
+    zeros = float((y_train.numpy() == 0).mean())
+    assert 0.3 < zeros < 0.7
+    m.eval()
+    y_eval = m(x)
+    np.testing.assert_allclose(y_eval.numpy(), x.numpy())

@@ -1,0 +1,76 @@
+"""Executor end-to-end: startup init, train step, param update, fetch.
+
+Covers the reference call stack §3.1 (exe.run over a Program) on the
+one-jitted-computation executor.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import Executor, framework, layers, optimizer
+
+
+def test_linear_regression_converges(fresh_programs):
+    main, startup, scope = fresh_programs
+    np.random.seed(1)
+    x = layers.data("x", [-1, 13], "float32")
+    y = layers.data("y", [-1, 1], "float32")
+    pred = layers.fc(x, 1)
+    loss = layers.mean(
+        layers.elementwise_mul(
+            layers.elementwise_sub(pred, y),
+            layers.elementwise_sub(pred, y)))
+    sgd = optimizer.SGD(learning_rate=0.01)
+    sgd.minimize(loss)
+
+    exe = Executor()
+    exe.run(startup)
+
+    w_true = np.random.randn(13, 1).astype("float32")
+    losses = []
+    for i in range(50):
+        xb = np.random.randn(32, 13).astype("float32")
+        yb = xb @ w_true
+        lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+
+def test_fetch_and_scope_state(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    h = layers.fc(x, 4, act="relu")
+    exe = Executor()
+    exe.run(startup)
+    out, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                   fetch_list=[h])
+    assert out.shape == (2, 4)
+    # params live in scope
+    p = main.all_parameters()[0]
+    assert scope.find_var(p.name) is not None
+
+
+def test_uninitialized_error(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    h = layers.fc(x, 4)
+    exe = Executor()
+    try:
+        exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[h])
+        assert False, "should raise for missing startup run"
+    except RuntimeError as e:
+        assert "startup" in str(e)
+
+
+def test_compile_cache_reuse(fresh_programs):
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    h = layers.fc(x, 4)
+    exe = Executor()
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), "float32")}, fetch_list=[h])
+    n = len(exe._cache)
+    exe.run(main, feed={"x": np.zeros((2, 4), "float32")}, fetch_list=[h])
+    assert len(exe._cache) == n  # same signature -> cached
+    exe.run(main, feed={"x": np.zeros((3, 4), "float32")}, fetch_list=[h])
+    assert len(exe._cache) == n + 1  # new batch size -> new entry

@@ -1,0 +1,56 @@
+"""Program IR construction tests (reference tests: test_program.py,
+test_operator_desc.py, test_variable.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import framework, layers
+
+
+def test_program_build(fresh_programs):
+    main, startup, _ = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    y = layers.fc(x, 8)
+    assert y.shape == (-1, 8)
+    op_types = [op.type for op in main.global_block().ops]
+    assert "mul" in op_types and "elementwise_add" in op_types
+    # parameter lives in global block, init op in startup
+    params = main.all_parameters()
+    assert len(params) == 2  # weight + bias
+    assert len(startup.global_block().ops) == 2
+
+
+def test_program_clone_for_test(fresh_programs):
+    main, startup, _ = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    d = layers.dropout(x, 0.5)
+    test_p = main.clone(for_test=True)
+    drop_ops = [op for op in test_p.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops[0].attr("is_test") is True
+    # original untouched
+    assert main.global_block().ops[-1].attr("is_test") is False
+
+
+def test_shape_inference(fresh_programs):
+    main, startup, _ = fresh_programs
+    x = layers.data("x", [8, 3, 32, 32], "float32")
+    c = layers.conv2d(x, 16, 3, padding=1)
+    assert c.shape == (8, 16, 32, 32)
+    p = layers.pool2d(c, 2, "max", 2)
+    assert p.shape == (8, 16, 16, 16)
+    f = layers.flatten(p, axis=1)
+    assert f.shape == (8, 16 * 16 * 16)
+
+
+def test_serialization_roundtrip(fresh_programs):
+    from paddle_tpu.fluid import proto
+    main, startup, _ = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    y = layers.fc(x, 8, act="relu")
+    blob = proto.serialize_program(main, {"feed": ["x"]})
+    p2, meta = proto.deserialize_program(blob)
+    assert meta["feed"] == ["x"]
+    assert [op.type for op in p2.global_block().ops] == \
+        [op.type for op in main.global_block().ops]
+    assert len(p2.all_parameters()) == len(main.all_parameters())

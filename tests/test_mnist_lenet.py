@@ -1,0 +1,39 @@
+"""BASELINE config 1: MNIST LeNet static-graph training end-to-end
+(reference book test fluid/tests/book/test_recognize_digits.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import Executor, framework, optimizer, unique_name
+from paddle_tpu.fluid.scope import Scope, scope_guard
+from paddle_tpu.models import build_lenet_program
+
+
+def test_lenet_static_train():
+    paddle.enable_static()
+    try:
+        with unique_name.guard():
+            main, startup, feeds, fetches = build_lenet_program()
+            with framework.program_guard(main, startup):
+                opt = optimizer.Adam(learning_rate=1e-3)
+                opt.minimize(fetches["loss"])
+        scope = Scope()
+        with scope_guard(scope):
+            exe = Executor()
+            exe.run(startup)
+            rng = np.random.RandomState(0)
+            # class-separable synthetic digits
+            protos = rng.randn(10, 1, 28, 28).astype("float32")
+            losses, accs = [], []
+            for step in range(30):
+                lab = rng.randint(0, 10, 64).astype("int64")
+                img = protos[lab] + 0.3 * rng.randn(64, 1, 28, 28) \
+                    .astype("float32")
+                lv, av = exe.run(
+                    main, feed={"img": img, "label": lab[:, None]},
+                    fetch_list=[fetches["loss"], fetches["acc"]])
+                losses.append(float(lv))
+                accs.append(float(av))
+            assert losses[-1] < losses[0] * 0.5, losses[::5]
+            assert accs[-1] > 0.7, accs[::5]
+    finally:
+        paddle.disable_static()
