@@ -116,6 +116,10 @@ class Executor:
             fetch_list: Sequence | None = None, scope: Scope | None = None,
             return_numpy: bool = True, use_program_cache: bool = True):
         program = program if program is not None else default_main_program()
+        # CompiledProgram.with_data_parallel → batch-axis sharding over the
+        # mesh (replaces reference ParallelExecutor, parallel_executor.cc:443)
+        if hasattr(program, "_program"):  # CompiledProgram wrapper
+            program = program._program
         feed = dict(feed or {})
         scope = scope or global_scope()
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
@@ -169,9 +173,13 @@ class Executor:
         upd_in_vals = [scope.find_var(n) for n in upd_in_names]
         ro_vals = [scope.find_var(n) for n in ro_names]
 
+        mesh = self._mesh_for(program)
+        if mesh is not None:
+            feed_vals = [self._shard_batch(v, mesh) for v in feed_vals]
+
         fn = self._compile(program, skey, feed_names, feed_vals, ro_names,
                            ro_vals, upd_names, upd_in_names, upd_in_vals,
-                           fetch_names)
+                           fetch_names, mesh)
 
         self._run_counter += 1
         seed = np.uint32(
@@ -188,11 +196,40 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    # -- data-parallel sharding --------------------------------------------
+    def _mesh_for(self, program):
+        """Mesh when the program is marked data-parallel. Grad allreduce is
+        implicit: batch-sharded inputs make XLA insert the psum in the
+        sharded backward (replaces details/all_reduce_op_handle.cc)."""
+        info = getattr(program, "_sharding_info", None)
+        if not info:
+            return None
+        import jax
+        if len(jax.devices()) <= 1:
+            return None
+        from ..distributed.mesh import default_mesh
+        return default_mesh()
+
+    @staticmethod
+    def _val_sharding(val, mesh):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ndev = mesh.shape["dp"]
+        if getattr(val, "ndim", 0) >= 1 and val.shape[0] % ndev == 0:
+            return NamedSharding(mesh, P("dp"))
+        return NamedSharding(mesh, P())
+
+    @classmethod
+    def _shard_batch(cls, val, mesh):
+        import jax
+        return jax.device_put(val, cls._val_sharding(val, mesh))
+
     # -- compilation -------------------------------------------------------
     def _compile(self, program, skey, feed_names, feed_vals, ro_names,
-                 ro_vals, upd_names, upd_in_names, upd_in_vals, fetch_names):
+                 ro_vals, upd_names, upd_in_names, upd_in_vals, fetch_names,
+                 mesh=None):
         sig = (
             skey,
+            None if mesh is None else tuple(mesh.shape.items()),
             tuple(ro_names), tuple(upd_names), tuple(upd_in_names),
             tuple(fetch_names),
             tuple((n, v.shape, str(jnp.result_type(v)))
@@ -222,7 +259,23 @@ class Executor:
 
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")  # cpu donation warnings
-            fn = jax.jit(step, donate_argnums=(0,))
+            if mesh is None:
+                fn = jax.jit(step, donate_argnums=(0,))
+            else:
+                # params/state replicated; fetches+updates replicated; the
+                # batch stays sharded inside, grads psum automatically
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                repl = NamedSharding(mesh, P())
+                fn = jax.jit(
+                    step, donate_argnums=(0,),
+                    in_shardings=(
+                        tuple(repl for _ in upd_in_names),
+                        tuple(repl for _ in ro_names),
+                        tuple(self._val_sharding(v, mesh)
+                              for v in feed_vals),
+                        None),
+                    out_shardings=(tuple(repl for _ in fetch_names),
+                                   tuple(repl for _ in upd_names)))
         if len(self._cache) >= core.get_flags(
                 "FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]:
             self._cache.clear()

@@ -209,6 +209,10 @@ class Operator:
         opdef = registry.lookup(type)
         if opdef is not None:
             opdef.fill_default_attrs(self.attrs)
+            if opdef.stochastic and "_rng_id" not in self.attrs:
+                prog = block.program
+                prog._rng_counter = getattr(prog, "_rng_counter", 0) + 1
+                self.attrs["_rng_id"] = prog._rng_counter
             if opdef.infer_shape is not None:
                 opdef.infer_shape(self)
 

@@ -54,16 +54,10 @@ class OpDef:
     def fill_default_attrs(self, attrs: dict):
         for k, v in self.attrs.items():
             attrs.setdefault(k, v)
-        if self.stochastic and "_rng_id" not in attrs:
-            attrs["_rng_id"] = _next_rng_id()
-
-
-_rng_counter = [0]
-
-
-def _next_rng_id() -> int:
-    _rng_counter[0] += 1
-    return _rng_counter[0]
+        # NOTE: `_rng_id` for stochastic ops is assigned by the caller
+        # (Operator.__init__ uses a per-Program counter so identically built
+        # programs are bit-identical under the same random_seed; the eager
+        # Tracer uses its per-op call counter).
 
 
 def register(type: str, compute=None, *, infer_shape=None, attrs=None,

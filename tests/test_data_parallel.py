@@ -1,0 +1,76 @@
+"""Data parallelism over the virtual 8-device mesh (reference
+CompiledProgram.with_data_parallel / ParallelExecutor, SURVEY §3.2).
+
+DP here is a sharding annotation on the one jitted computation; grad psum is
+inserted by XLA's sharded autodiff."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import Executor, framework, layers, optimizer
+from paddle_tpu.fluid.compiler import CompiledProgram
+
+
+def _build(seed):
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = startup.random_seed = seed
+    with framework.program_guard(main, startup):
+        x = layers.data("x", [-1, 8], "float32")
+        y = layers.data("y", [-1, 1], "float32")
+        h = layers.fc(x, 16, act="relu")
+        pred = layers.fc(h, 1)
+        d = layers.elementwise_sub(pred, y)
+        loss = layers.mean(layers.elementwise_mul(d, d))
+        optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, parallel, steps=20):
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    rng = np.random.RandomState(3)
+    w_true = rng.randn(8, 1).astype("float32")
+    prog = CompiledProgram(main).with_data_parallel(loss.name) \
+        if parallel else main
+    losses = []
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.randn(64, 8).astype("float32")
+            yb = xb @ w_true
+            lv, = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(lv[0]))
+    return losses
+
+
+def test_dp_trains_and_matches_single_device(fresh_programs):
+    import jax
+    assert len(jax.devices()) == 8
+    with framework.program_guard(framework.Program(), framework.Program()):
+        pass
+    from paddle_tpu.fluid import unique_name
+    with unique_name.guard():
+        m1, s1, l1 = _build(seed=7)
+    with unique_name.guard():
+        m2, s2, l2 = _build(seed=7)
+    single = _train(m1, s1, l1, parallel=False)
+    multi = _train(m2, s2, l2, parallel=True)
+    assert multi[-1] < multi[0] * 0.2
+    # same seed + same data -> numerically equivalent up to reduction order
+    np.testing.assert_allclose(single, multi, rtol=2e-3, atol=1e-4)
+
+
+def test_dp_feed_actually_sharded(fresh_programs):
+    import jax
+    main, startup, loss = _build(seed=1)
+    prog = CompiledProgram(main).with_data_parallel(loss.name)
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xb = rng.randn(64, 8).astype("float32")
+        yb = rng.randn(64, 1).astype("float32")
+        exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        # compiled entry exists for the dp mesh signature
+        assert any(s[1] is not None for s in exe._cache)
