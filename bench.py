@@ -1,15 +1,18 @@
 #!/usr/bin/env python
 """Benchmark driver entry: one JSON line to stdout.
 
-Round-1 metric: BASELINE config 1 (fluid MNIST LeNet, static ProgramDesc,
-single chip) — examples/sec through the full Executor train step (feed,
-jitted forward+backward+adam, fetch). The reference publishes no numbers
-(BASELINE.md), so vs_baseline is the ratio against the first measured value
-recorded here once hardware numbers exist.
+Headline metric (BASELINE config 3): BERT-base pretrain samples/sec/chip —
+full MLM+NSP train step (fwd+bwd+AdamW) as ONE jitted XLA computation, bf16
+autocast on the MXU. The reference publishes no in-repo numbers
+(BASELINE.md), so vs_baseline is the ratio against the north-star A100-MFU
+proxy once recorded; 1.0 until then.
+
+Select other configs with BENCH_CONFIG=lenet|bert_base|bert_tiny.
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -40,21 +43,62 @@ def bench_lenet(batch=256, steps=30, warmup=5):
                     fetch_list=[fetches["loss"]])
         import jax
         t0 = time.perf_counter()
+        out = None
         for _ in range(steps):
             out = exe.run(main, feed={"img": img, "label": lab},
                           fetch_list=[fetches["loss"]], return_numpy=False)
         jax.block_until_ready(out)
         dt = time.perf_counter() - t0
     paddle.disable_static()
-    return batch * steps / dt
+    return ("mnist_lenet_static_train_examples_per_sec",
+            batch * steps / dt, "examples/sec")
+
+
+def bench_bert(cfg_name="base", batch=16, seq=128, steps=12, warmup=3):
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.functional import make_train_step
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    cfg = BertConfig.base() if cfg_name == "base" else BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    model.train()
+
+    def loss_fn(m, ids, mlm, nsp):
+        logits, nsp_logits = m(ids)
+        return m.loss(logits, nsp_logits, mlm, nsp)
+
+    step = make_train_step(model, loss_fn, optimizer="adamw", lr=1e-4,
+                           amp_level="O1")
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (batch, seq)).astype("int64")
+    mlm = np.full((batch, seq), -100, "int64")
+    mlm[:, ::7] = ids[:, ::7]
+    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
+    for _ in range(warmup):
+        loss = step(ids, mlm, nsp)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, mlm, nsp)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return (f"bert_{cfg_name}_pretrain_samples_per_sec_per_chip",
+            batch * steps / dt, "samples/sec/chip")
 
 
 def main():
-    eps = bench_lenet()
+    which = os.environ.get("BENCH_CONFIG", "bert_base")
+    if which == "lenet":
+        metric, value, unit = bench_lenet()
+    elif which == "bert_tiny":
+        metric, value, unit = bench_bert("tiny", batch=8, seq=64)
+    else:
+        metric, value, unit = bench_bert("base")
     print(json.dumps({
-        "metric": "mnist_lenet_static_train_examples_per_sec",
-        "value": round(eps, 1),
-        "unit": "examples/sec",
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
         "vs_baseline": 1.0,
     }))
 

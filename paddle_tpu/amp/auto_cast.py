@@ -15,7 +15,7 @@ from ..fluid import framework
 # ops that benefit from bf16 on the MXU (reference fp16_lists.py white list)
 white_list = {
     "matmul", "matmul_v2", "mul", "bmm", "conv2d", "depthwise_conv2d",
-    "fc", "addmm",
+    "fc", "addmm", "fused_attention",
 }
 # numerically sensitive ops kept in fp32 (reference black list)
 black_list = {

@@ -102,9 +102,9 @@ class Tracer:
             in_tensors[slot] = [t for t in lst]
 
         if self._amp_level:
-            from ...amp import auto_cast as amp_mod
-            in_tensors = amp_mod._autocast_inputs(op_type, in_tensors,
-                                                  self._amp_level)
+            from ...amp.auto_cast import _autocast_inputs
+            in_tensors = _autocast_inputs(op_type, in_tensors,
+                                          self._amp_level)
 
         ins_vals = {slot: [None if t is None else t._value for t in lst]
                     for slot, lst in in_tensors.items()}

@@ -36,8 +36,10 @@ class Tensor:
 
     def __init__(self, value, name=None, stop_gradient=True,
                  persistable=False, trainable=None):
+        # accept concrete jax arrays AND tracers (functionalized training
+        # runs the eager model under a jax trace)
         self._value = value if isinstance(value, (jnp.ndarray, jax.Array)) \
-            else to_tensor_value(value)
+            or hasattr(value, "aval") else to_tensor_value(value)
         self.name = name or unique_name.generate("eager_tmp")
         self.stop_gradient = stop_gradient
         self.persistable = persistable
@@ -57,7 +59,7 @@ class Tensor:
 
     def _set_value(self, v):
         self._value = v if isinstance(v, (jnp.ndarray, jax.Array)) \
-            else jnp.asarray(v)
+            or hasattr(v, "aval") else jnp.asarray(v)
 
     set_value = _set_value
 

@@ -1,0 +1,195 @@
+"""Functionalize a dygraph model into ONE pure jitted XLA train step.
+
+This is the TPU-native answer to the reference's dygraph-to-static
+ProgramTranslator (dygraph_to_static/program_translator.py:250): instead of
+AST-rewriting Python, we exploit that every eager op kernel is a jax function
+— running the model under a jax trace yields the whole step as one
+computation, with jax.value_and_grad for autodiff and the registered
+optimizer-op kernels for the update. Donation makes params/opt-state updates
+in-place on device.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..fluid import framework, registry
+from ..fluid.dygraph.varbase import Tensor
+
+__all__ = ["TrainStep", "make_train_step"]
+
+
+class TrainStep:
+    """Compiled training step: step(batch...) -> loss (host float array).
+
+    Holds params + optimizer state as device arrays; `write_back()` syncs
+    them into the model's eager tensors (for state_dict / eval)."""
+
+    def __init__(self, model, loss_fn: Callable, optimizer: str = "adamw",
+                 lr=1e-4, weight_decay: float = 0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, grad_clip_norm: float | None = None,
+                 donate: bool = True, mesh=None, batch_spec=None,
+                 remat: bool = False, amp_level: str | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.loss_fn = loss_fn
+        self.params = [p for p in model.parameters() if p.trainable]
+        self.buffers = [b for _, b in model.named_buffers()
+                        if isinstance(b, Tensor)]
+        self._lr = lr
+        self._opt_kind = optimizer
+        self._clip = grad_clip_norm
+        self._mesh = mesh
+        self._hyper = dict(beta1=beta1, beta2=beta2, epsilon=epsilon,
+                           coeff=weight_decay)
+        self.param_vals = [p._value for p in self.params]
+        self.buffer_vals = [b._value for b in self.buffers]
+        self.opt_state = self._init_opt_state()
+        self._step_count = 0
+
+        opt_type = {"adam": "adam", "adamw": "adamw", "sgd": "sgd",
+                    "momentum": "momentum", "lamb": "lamb"}[optimizer]
+        opdef = registry.require(opt_type)
+        hyper = dict(self._hyper)
+        clip = self._clip
+
+        tracer = framework._dygraph_tracer()
+        params = self.params
+        buffers = self.buffers
+
+        def step(param_vals, opt_state, buffer_vals, seed, lr, *batch):
+            # bind traced values into the eager params and run the model —
+            # every op kernel is jnp, so this traces into one computation
+            def forward(vals):
+                for p, v in zip(params, vals):
+                    p._set_value(v)
+                for b, v in zip(buffers, buffer_vals):
+                    b._set_value(v)
+                tracer._base_key_cache = jax.random.PRNGKey(seed)
+                from ..fluid.dygraph.tracer import no_grad_guard
+                import contextlib
+                amp_cm = contextlib.nullcontext()
+                if amp_level:
+                    from ..amp.auto_cast import amp_guard
+                    amp_cm = amp_guard(True, level=amp_level)
+                with no_grad_guard(), amp_cm:  # no tape: jax differentiates
+                    loss = loss_fn(model, *[Tensor(b, stop_gradient=True)
+                                            for b in batch])
+                # batch-norm style running stats were updated in-place on
+                # the eager buffer tensors during the trace
+                new_buf = [jax.lax.stop_gradient(b._value) for b in buffers]
+                return loss._value.reshape(()), new_buf
+
+            (loss, new_buf), grads = jax.value_and_grad(
+                forward, has_aux=True)(list(param_vals))
+            if clip:
+                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in grads))
+                scale = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+                grads = [g * scale for g in grads]
+            lr_arr = jnp.asarray([lr], jnp.float32)
+            new_vals, new_state = [], []
+            for v, g, st in zip(param_vals, grads, opt_state):
+                ins = {"Param": [v], "Grad": [g], "LearningRate": [lr_arr]}
+                ins.update({k: [x] for k, x in st.items()})
+                outs = opdef.compute(None, ins, dict(hyper))
+                new_vals.append(outs["ParamOut"][0])
+                new_state.append(self._next_state(st, outs))
+            return loss, new_vals, new_state, new_buf
+
+        donate_args = (0, 1, 2) if donate else ()
+        if mesh is None:
+            self._jit_step = jax.jit(step, donate_argnums=donate_args)
+        else:
+            # data-parallel: batch axis sharded over mesh axis "dp"; params,
+            # optimizer state and buffers replicated. XLA's sharded autodiff
+            # inserts the grad psum over ICI (replaces the reference's
+            # fused-allreduce op handles).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(mesh, P())
+            batch_sh = NamedSharding(mesh, P("dp"))
+            self._batch_sharding = batch_sh
+
+            # shardings come from the committed inputs: state is device_put
+            # replicated here, batches are device_put batch-sharded per call
+            self._jit_step = jax.jit(step, donate_argnums=donate_args)
+            self.param_vals = [jax.device_put(v, repl)
+                               for v in self.param_vals]
+            self.opt_state = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, repl), self.opt_state)
+            self.buffer_vals = [jax.device_put(v, repl)
+                                for v in self.buffer_vals]
+
+    # -- optimizer state ----------------------------------------------------
+    def _init_opt_state(self):
+        import jax.numpy as jnp
+        st = []
+        for p in self.params:
+            v = p._value
+            if self._opt_kind in ("adam", "adamw", "lamb"):
+                st.append({"Moment1": jnp.zeros(v.shape, jnp.float32),
+                           "Moment2": jnp.zeros(v.shape, jnp.float32),
+                           "Beta1Pow": jnp.ones((1,), jnp.float32),
+                           "Beta2Pow": jnp.ones((1,), jnp.float32)})
+            elif self._opt_kind == "momentum":
+                st.append({"Velocity": jnp.zeros(v.shape, jnp.float32)})
+            else:
+                st.append({})
+        return st
+
+    @staticmethod
+    def _next_state(st, outs):
+        new = {}
+        if "Moment1" in st:
+            new = {"Moment1": outs["Moment1Out"][0],
+                   "Moment2": outs["Moment2Out"][0],
+                   "Beta1Pow": outs["Beta1PowOut"][0],
+                   "Beta2Pow": outs["Beta2PowOut"][0]}
+        elif "Velocity" in st:
+            new = {"Velocity": outs["VelocityOut"][0]}
+        return new
+
+    # -- execution -----------------------------------------------------------
+    def __call__(self, *batch, seed: int | None = None):
+        import jax.numpy as jnp
+        tracer = framework._dygraph_tracer()
+        saved = [p._value for p in self.params]
+        saved_key = tracer._base_key_cache if tracer else None
+        self._step_count += 1
+        seed = self._step_count if seed is None else seed
+        lr = self._lr() if callable(self._lr) else float(self._lr)
+        saved_buf = [b._value for b in self.buffers]
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        if self._mesh is not None:
+            import jax
+            batch_vals = [jax.device_put(b, self._batch_sharding)
+                          for b in batch_vals]
+        try:
+            loss, self.param_vals, self.opt_state, self.buffer_vals = \
+                self._jit_step(
+                    self.param_vals, self.opt_state, self.buffer_vals,
+                    np.uint32(seed), lr, *batch_vals)
+        finally:
+            for p, v in zip(self.params, saved):
+                p._set_value(v)
+            for b, v in zip(self.buffers, saved_buf):
+                b._set_value(v)
+            if tracer:
+                tracer._base_key_cache = saved_key
+                tracer.reset_tape()
+        return loss
+
+    def write_back(self):
+        """Sync trained values into the model's eager parameters."""
+        for p, v in zip(self.params, self.param_vals):
+            p._set_value(v)
+        for b, v in zip(self.buffers, self.buffer_vals):
+            b._set_value(v)
+
+
+def make_train_step(model, loss_fn, **kwargs) -> TrainStep:
+    """loss_fn(model, *batch_tensors) -> scalar-ish Tensor."""
+    return TrainStep(model, loss_fn, **kwargs)

@@ -3,9 +3,15 @@ from . import functional
 from . import initializer
 from .layers_common import *  # noqa: F401,F403
 from .layers_common import __all__ as _common_all
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, TransformerDecoderLayer,
+                          TransformerDecoder, Transformer)
 from ..fluid.dygraph.layers import Layer
 from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
                           ClipGradByGlobalNorm)
 
 __all__ = ["Layer", "functional", "initializer", "ClipGradByValue",
-           "ClipGradByNorm", "ClipGradByGlobalNorm"] + list(_common_all)
+           "ClipGradByNorm", "ClipGradByGlobalNorm", "MultiHeadAttention",
+           "TransformerEncoderLayer", "TransformerEncoder",
+           "TransformerDecoderLayer", "TransformerDecoder",
+           "Transformer"] + list(_common_all)
