@@ -30,6 +30,9 @@ class OptimizerWithMixedPrecision:
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._use_pure_bf16 = use_pure_bf16
+        self._scale_var = None
+        self._good_var = None
+        self._bad_var = None
 
     def __getattr__(self, k):
         return getattr(self._optimizer, k)
@@ -38,22 +41,43 @@ class OptimizerWithMixedPrecision:
                  no_grad_set=None):
         program = loss.block.program
         program._amp_policy = "bf16" if self._use_pure_bf16 else "fp16"
-        params_grads = self._optimizer.backward(
-            loss, startup_program, parameter_list, no_grad_set)
         if not self._use_pure_bf16 and self._use_dynamic:
+            # Scale the LOSS before backward (reference decorator.py:218
+            # OptimizerWithMixedPrecision.backward scales then appends
+            # backward); check_finite_and_unscale later divides the grads by
+            # the same scale var, restoring true magnitudes.
+            scale = self._ensure_scaling_vars()
+            scaled_loss = layers.elementwise_mul(loss, scale)
+            params_grads = self._optimizer.backward(
+                scaled_loss, startup_program, parameter_list, no_grad_set)
             params_grads = self._scale_and_check(params_grads)
+        else:
+            params_grads = self._optimizer.backward(
+                loss, startup_program, parameter_list, no_grad_set)
         ops = self._optimizer.apply_gradients(params_grads)
         return ops, params_grads
 
-    def _scale_and_check(self, params_grads):
+    def _ensure_scaling_vars(self):
+        from ..fluid.framework import default_main_program
+        # re-create when minimize() is called under a DIFFERENT main program:
+        # cached Variables belong to their program; a fresh program has no
+        # such vars and its startup program never initialises them
+        if (self._scale_var is not None and
+                self._scale_var.block.program is default_main_program()):
+            return self._scale_var
         helper = LayerHelper("amp_scaling")
-        scale = helper.create_global_variable(
+        self._scale_var = helper.create_global_variable(
             shape=[1], dtype="float32", persistable=True,
             value=self._init_loss_scaling)
-        good = helper.create_global_variable(
+        self._good_var = helper.create_global_variable(
             shape=[1], dtype="int32", persistable=True, value=0.0)
-        bad = helper.create_global_variable(
+        self._bad_var = helper.create_global_variable(
             shape=[1], dtype="int32", persistable=True, value=0.0)
+        return self._scale_var
+
+    def _scale_and_check(self, params_grads):
+        helper = LayerHelper("amp_scaling")
+        scale, good, bad = self._scale_var, self._good_var, self._bad_var
         grads = [g for _, g in params_grads]
         found = helper.create_variable_for_type_inference("bool", True)
         unscaled = [helper.create_variable_for_type_inference(g.dtype)

@@ -13,6 +13,7 @@ kernels (jax.vjp of forward) and sum-accumulating fan-in gradients.
 from __future__ import annotations
 
 import dataclasses
+import weakref
 from typing import Any
 
 import jax
@@ -45,10 +46,23 @@ class _EagerCtx:
 @dataclasses.dataclass
 class TapeEntry:
     op_type: str
-    inputs: dict      # slot -> list[Tensor | None]
-    outputs: dict     # slot -> list[Tensor | None]
+    inputs: dict      # slot -> list[Tensor | None]  (strong refs)
+    outputs: dict     # slot -> list[weakref.ref[Tensor] | None]
     attrs: dict
     rng_id: int
+
+    def live_outputs(self) -> bool:
+        """Whether any output tensor is still alive. Output refs are weak so
+        that forwards whose results are dropped (e.g. an eval loop without
+        no_grad) don't pin activations forever — the reference's refcounted
+        autograd graph frees those nodes the same way; dead entries are
+        pruned from the tape periodically."""
+        return any(r is not None and r() is not None
+                   for lst in self.outputs.values() for r in lst)
+
+    def output_tensors(self) -> dict:
+        return {slot: [None if r is None else r() for r in lst]
+                for slot, lst in self.outputs.items()}
 
 
 class Tracer:
@@ -62,6 +76,7 @@ class Tracer:
         self._base_key_cache = None
         self._op_counter = 0
         self._tape: list[TapeEntry] = []
+        self._tape_prune_at = 1024
         self._has_grad = True
         self._amp_level = 0  # set by amp_guard
         self._amp_lists = None
@@ -128,17 +143,31 @@ class Tracer:
             out_tensors[slot] = outs
 
         if requires_grad:
-            entry = TapeEntry(op_type, in_tensors, out_tensors, attrs,
+            out_refs = {slot: [None if t is None else weakref.ref(t)
+                               for t in lst]
+                        for slot, lst in out_tensors.items()}
+            entry = TapeEntry(op_type, in_tensors, out_refs, attrs,
                               attrs.get("_rng_id", 0))
             for lst in out_tensors.values():
                 for t in lst:
                     if t is not None:
                         t._producer = entry
             self._tape.append(entry)
+            if len(self._tape) >= self._tape_prune_at:
+                self._prune_tape()
         return out_tensors
+
+    def _prune_tape(self):
+        """Drop entries whose outputs were all garbage-collected — they can
+        never receive an upstream gradient. Live chains survive: a live
+        tensor pins its producer entry's inputs (strong refs), which pin
+        THEIR producers transitively."""
+        self._tape = [e for e in self._tape if e.live_outputs()]
+        self._tape_prune_at = max(1024, 2 * len(self._tape))
 
     def reset_tape(self):
         self._tape.clear()
+        self._tape_prune_at = 1024
 
 
 _global_tracer: Tracer | None = None
@@ -196,9 +225,10 @@ def run_backward(loss: Tensor, grad_tensor=None, retain_graph=False,
     ctx = _EagerCtx(tr._base_key, is_test=not tr.train_mode)
 
     for entry in reversed(tr._tape):
+        outputs = entry.output_tensors()
         out_has_grad = any(
             t is not None and id(t) in grads
-            for lst in entry.outputs.values() for t in lst)
+            for lst in outputs.values() for t in lst)
         if not out_has_grad:
             continue
         opdef = registry.require(entry.op_type)
@@ -207,7 +237,7 @@ def run_backward(loss: Tensor, grad_tensor=None, retain_graph=False,
         g_ins: dict[str, list] = {}
         for slot, lst in entry.inputs.items():
             g_ins[slot] = [None if t is None else t._value for t in lst]
-        for slot, lst in entry.outputs.items():
+        for slot, lst in outputs.items():
             if slot in opdef.no_grad_out_slots:
                 continue
             g_ins[slot + GRAD_SUFFIX] = [

@@ -287,8 +287,12 @@ class Block:
     # -- vars --------------------------------------------------------------
     def create_var(self, name=None, **kwargs) -> Variable:
         name = name or unique_name.generate("tmp")
-        if name in self.vars:
-            v = self.vars[name]
+        # resolve through the parent chain: a sub-block op whose output names
+        # an ANCESTOR var writes through to it (reference cond/while sub-block
+        # semantics) — it must NOT shadow-create a block-local copy, else the
+        # write never surfaces to the parent scope
+        v = self._var_recursive(name)
+        if v is not None:
             # refine metadata (shape inference updates placeholder vars)
             if v.shape is None and kwargs.get("shape") is not None:
                 v.shape = tuple(int(s) for s in kwargs["shape"])

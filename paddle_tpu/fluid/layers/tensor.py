@@ -13,7 +13,9 @@ __all__ = [
     "squeeze", "unsqueeze", "expand", "gather", "scatter", "slice", "shape",
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "argmax",
     "argmin", "topk", "flatten", "mean", "mul", "elementwise_add",
-    "elementwise_sub", "elementwise_mul", "elementwise_div", "scale", "clip",
+    "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow",
+    "elementwise_mod", "elementwise_floordiv", "scale", "clip",
     "cross_entropy", "softmax_with_cross_entropy", "accuracy", "range",
     "increment", "equal", "less_than", "greater_than", "where", "cond",
 ]
@@ -302,6 +304,7 @@ elementwise_max = _elementwise("elementwise_max")
 elementwise_min = _elementwise("elementwise_min")
 elementwise_pow = _elementwise("elementwise_pow")
 elementwise_mod = _elementwise("elementwise_mod")
+elementwise_floordiv = _elementwise("elementwise_floordiv")
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):

@@ -153,15 +153,19 @@ def _lamb(ctx, ins, attrs):
     g = g.astype(jnp.float32)
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * jnp.square(g)
-    mhat = m1n / (1 - b1p.reshape(()))
-    vhat = m2n / (1 - b2p.reshape(()))
+    # bias-correct with the POST-update pows (like the adam kernel above):
+    # pow accumulators start at 1.0, so correcting with the pre-update value
+    # would divide by zero on the first step
+    b1pn, b2pn = b1p * b1, b2p * b2
+    mhat = m1n / (1 - b1pn.reshape(()))
+    vhat = m2n / (1 - b2pn.reshape(()))
     r = mhat / (jnp.sqrt(vhat) + eps) + wd * p.astype(jnp.float32)
     p_norm = jnp.linalg.norm(p.astype(jnp.float32))
     r_norm = jnp.linalg.norm(r)
     trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
     p_new = p - (lr * trust * r).astype(p.dtype)
     return {"ParamOut": [p_new], "Moment1Out": [m1n], "Moment2Out": [m2n],
-            "Beta1PowOut": [b1p * b1], "Beta2PowOut": [b2p * b2]}
+            "Beta1PowOut": [b1pn], "Beta2PowOut": [b2pn]}
 
 
 @register("ftrl", grad=None, attrs={"l1": 0.0, "l2": 0.0, "lr_power": -0.5})

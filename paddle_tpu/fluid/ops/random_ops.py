@@ -104,9 +104,17 @@ def _multinomial(ctx, ins, attrs):
     v = x(ins)
     logits = jnp.log(jnp.clip(v, 1e-20, None))
     n = attrs["num_samples"]
-    return out(jax.random.categorical(
-        ctx.rng(attrs), logits, axis=-1,
-        shape=(n,) + logits.shape[:-1]).T.astype(jnp.int64))
+    if attrs.get("replacement", False):
+        return out(jax.random.categorical(
+            ctx.rng(attrs), logits, axis=-1,
+            shape=(n,) + logits.shape[:-1]).T.astype(jnp.int64))
+    # without replacement (reference multinomial_op semantics): Gumbel
+    # top-k — argsort of logits + iid Gumbel noise yields a sample of k
+    # distinct categories with the right distribution
+    gumbel = jax.random.gumbel(ctx.rng(attrs), logits.shape,
+                               dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + gumbel, n)
+    return out(idx.astype(jnp.int64))
 
 
 @register("sampling_id", grad=None, stochastic=True,

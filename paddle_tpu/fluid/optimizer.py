@@ -35,6 +35,7 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._name = name
         self._accumulators: dict[str, dict[str, Variable]] = {}
+        self._eager_state: dict[str, dict] = {}  # dygraph accumulator arrays
         self._lr_var = None
         self.type = getattr(self, "type", "sgd")
 
@@ -148,15 +149,59 @@ class Optimizer:
         return None, params_grads
 
     def _dygraph_apply(self, params_grads):
-        from .dygraph.tracer import eager_run_op
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         for p, g in params_grads:
             self._eager_update(p, g)
 
+    # -- dygraph step: one kernel story for both modes ----------------------
+    def _eager_acc_specs(self):
+        """(in_slot, out_slot_or_None, fill_value, is_scalar) for the eager
+        accumulator state this optimizer's kernel consumes."""
+        return ()
+
+    def _eager_attrs(self) -> dict:
+        return {}
+
+    def _eager_attrs_for(self, p) -> dict:
+        return self._eager_attrs()
+
+    def _eager_finish(self, state: dict):
+        pass
+
+    def _current_lr_value(self):
+        lr = self._learning_rate
+        return lr() if callable(lr) else float(lr)
+
     def _eager_update(self, p, g):
-        raise NotImplementedError(
-            f"{type(self).__name__} has no dygraph update")
+        """Run this optimizer's registered op KERNEL eagerly over
+        (param, grad, accumulators) — the reference dygraph path likewise
+        dispatches the same per-op kernel via core.ops.<type>
+        (pybind/op_function_generator.cc)."""
+        import jax.numpy as jnp
+        from . import registry as _registry
+        opdef = _registry.require(self.type)
+        specs = self._eager_acc_specs()
+        state = self._eager_state.setdefault(p.name, {})
+        for in_slot, _o, fill, scalar in specs:
+            if in_slot not in state:
+                shape = (1,) if scalar else p._value.shape
+                state[in_slot] = jnp.full(shape, float(fill), jnp.float32)
+        gval = g._value if hasattr(g, "_value") else jnp.asarray(g)
+        ins = {"Param": [p._value], "Grad": [gval],
+               "LearningRate": [jnp.asarray([self._current_lr_value()],
+                                            jnp.float32)]}
+        for in_slot, _o, _f, _s in specs:
+            ins[in_slot] = [state[in_slot]]
+        attrs: dict = {}
+        opdef.fill_default_attrs(attrs)
+        attrs.update(self._eager_attrs_for(p))
+        outs = opdef.compute(None, ins, attrs)
+        p._set_value(outs["ParamOut"][0])
+        for in_slot, out_slot, _f, _s in specs:
+            if out_slot is not None:
+                state[in_slot] = outs[out_slot][0]
+        self._eager_finish(state)
 
     # subclass hooks
     def _create_accumulators(self, block, parameters):
@@ -199,13 +244,6 @@ class SGDOptimizer(Optimizer):
                     "LearningRate": [self._lr_var]},
             outputs={"ParamOut": [p.name]})
 
-    def _eager_update(self, p, g):
-        lr = self._current_lr_value()
-        p._set_value(p.value() - lr * np.asarray(g.value()))
-
-    def _current_lr_value(self):
-        lr = self._learning_rate
-        return lr() if callable(lr) else float(lr)
 
 
 class MomentumOptimizer(Optimizer):
@@ -231,6 +269,12 @@ class MomentumOptimizer(Optimizer):
             outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov})
 
+    def _eager_acc_specs(self):
+        return (("Velocity", "VelocityOut", 0.0, False),)
+
+    def _eager_attrs(self):
+        return {"mu": self._momentum, "use_nesterov": self._use_nesterov}
+
 
 class AdagradOptimizer(Optimizer):
     type = "adagrad"
@@ -254,6 +298,12 @@ class AdagradOptimizer(Optimizer):
                     "LearningRate": [self._lr_var]},
             outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
             attrs={"epsilon": self._epsilon})
+
+    def _eager_acc_specs(self):
+        return (("Moment", "MomentOut", self._initial, False),)
+
+    def _eager_attrs(self):
+        return {"epsilon": self._epsilon}
 
 
 class AdamOptimizer(Optimizer):
@@ -296,6 +346,16 @@ class AdamOptimizer(Optimizer):
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon})
 
+    def _eager_acc_specs(self):
+        return (("Moment1", "Moment1Out", 0.0, False),
+                ("Moment2", "Moment2Out", 0.0, False),
+                ("Beta1Pow", "Beta1PowOut", 1.0, True),
+                ("Beta2Pow", "Beta2PowOut", 1.0, True))
+
+    def _eager_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
 
 class AdamaxOptimizer(Optimizer):
     type = "adamax"
@@ -334,6 +394,18 @@ class AdamaxOptimizer(Optimizer):
                             outputs={"Out": [b1p.name]},
                             attrs={"scale": self._beta1})
 
+    def _eager_acc_specs(self):
+        return (("Moment", "MomentOut", 0.0, False),
+                ("InfNorm", "InfNormOut", 0.0, False),
+                ("Beta1Pow", None, self._beta1, True))
+
+    def _eager_attrs(self):
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon}
+
+    def _eager_finish(self, state):
+        state["Beta1Pow"] = state["Beta1Pow"] * self._beta1
+
 
 class RMSPropOptimizer(Optimizer):
     type = "rmsprop"
@@ -365,6 +437,15 @@ class RMSPropOptimizer(Optimizer):
             attrs={"decay": self._rho, "epsilon": self._epsilon,
                    "momentum": self._momentum, "centered": self._centered})
 
+    def _eager_acc_specs(self):
+        return (("MeanSquare", "MeanSquareOut", 0.0, False),
+                ("Moment", "MomentOut", 0.0, False),
+                ("MeanGrad", "MeanGradOut", 0.0, False))
+
+    def _eager_attrs(self):
+        return {"decay": self._rho, "epsilon": self._epsilon,
+                "momentum": self._momentum, "centered": self._centered}
+
 
 class AdadeltaOptimizer(Optimizer):
     type = "adadelta"
@@ -390,6 +471,13 @@ class AdadeltaOptimizer(Optimizer):
                      "AvgSquaredUpdateOut": [asu.name]},
             attrs={"rho": self._rho, "epsilon": self._epsilon})
 
+    def _eager_acc_specs(self):
+        return (("AvgSquaredGrad", "AvgSquaredGradOut", 0.0, False),
+                ("AvgSquaredUpdate", "AvgSquaredUpdateOut", 0.0, False))
+
+    def _eager_attrs(self):
+        return {"rho": self._rho, "epsilon": self._epsilon}
+
 
 class LambOptimizer(AdamOptimizer):
     type = "lamb"
@@ -410,6 +498,12 @@ class LambOptimizer(AdamOptimizer):
             outputs=self._adam_outputs(p),
             attrs={"beta1": self._beta1, "beta2": self._beta2,
                    "epsilon": self._epsilon, "weight_decay": wd})
+
+    def _eager_attrs_for(self, p):
+        wd = 0.0 if (self._exclude_fn and self._exclude_fn(p)) \
+            else self._weight_decay
+        return {"beta1": self._beta1, "beta2": self._beta2,
+                "epsilon": self._epsilon, "weight_decay": wd}
 
 
 class FtrlOptimizer(Optimizer):
@@ -438,6 +532,13 @@ class FtrlOptimizer(Optimizer):
             attrs={"l1": self._l1, "l2": self._l2,
                    "lr_power": self._lr_power})
 
+    def _eager_acc_specs(self):
+        return (("SquaredAccumulator", "SquaredAccumOut", 0.0, False),
+                ("LinearAccumulator", "LinearAccumOut", 0.0, False))
+
+    def _eager_attrs(self):
+        return {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power}
+
 
 class DecayedAdagradOptimizer(Optimizer):
     type = "decayed_adagrad"
@@ -459,6 +560,12 @@ class DecayedAdagradOptimizer(Optimizer):
                     "LearningRate": [self._lr_var]},
             outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
             attrs={"decay": self._decay, "epsilon": self._epsilon})
+
+    def _eager_acc_specs(self):
+        return (("Moment", "MomentOut", 0.0, False),)
+
+    def _eager_attrs(self):
+        return {"decay": self._decay, "epsilon": self._epsilon}
 
 
 class ExponentialMovingAverage:
@@ -593,23 +700,32 @@ class GradientMergeOptimizer(Optimizer):
             tb.append_op(type="scale", inputs={"X": [acc]},
                          outputs={"Out": [acc.name]}, attrs={"scale": 0.0})
         program._rollback()
-        written = sorted({n for op in tb.ops for n in op.output_arg_names})
+        # Only surface writes that live in the PARENT scope (params, accum
+        # buffers, optimizer state — all created as global/persistable vars).
+        # Branch-local temporaries (e.g. scale tmp outputs created inside the
+        # true branch) stay internal: the false branch could never
+        # identity-assign them (they don't exist outside the branch).
+        written = sorted({n for op in tb.ops for n in op.output_arg_names
+                          if n not in tb.vars})
 
-        # false branch: identity-assign every var the true branch writes so
-        # both branches produce the same outputs for lax.cond
+        # false branch: identity-assign every parent-scope var the true
+        # branch writes so both branches produce the same outputs for
+        # lax.cond
         fb = program._create_block()
         for n in written:
             fb.append_op(type="assign", inputs={"X": [n]},
                          outputs={"Out": [n]})
         program._rollback()
 
-        # captures: names read before being defined within each branch
+        # captures: names read before being defined within each branch,
+        # excluding branch-local vars (which by construction are defined
+        # inside the branch before use)
         caps = set()
         for blk in (tb, fb):
             defined: set = set()
             for op in blk.ops:
                 for n in op.input_arg_names:
-                    if n not in defined:
+                    if n not in defined and n not in blk.vars:
                         caps.add(n)
                 defined.update(op.output_arg_names)
         caps = sorted(caps)
