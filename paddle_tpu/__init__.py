@@ -27,6 +27,10 @@ from .fluid.framework import in_dygraph_mode
 # 2.0-style namespaces
 from . import tensor
 from .tensor import *  # noqa: F401,F403
+# tensor functions double as Tensor/Variable METHODS (reference
+# monkey_patch_varbase / monkey_patch_variable)
+from .fluid.dygraph.math_op_patch import monkey_patch_tensor_methods
+monkey_patch_tensor_methods()
 from . import nn
 from . import static
 from . import optimizer

@@ -64,3 +64,24 @@ def monkey_patch_math():
     T.__gt__ = _binary("greater_than")
     T.__ge__ = _binary("greater_equal")
     T.__hash__ = lambda self: id(self)
+
+
+def monkey_patch_tensor_methods():
+    """Attach every paddle.tensor function whose first argument is a tensor
+    as a METHOD on both the eager Tensor and the static Variable — the
+    reference does the same via monkey_patch_varbase/monkey_patch_variable
+    (dygraph/varbase_patch_methods.py, fluid/layers/math_op_patch.py), so
+    `x.squeeze(...)`, `x.sum(...)`, `x.reshape(...)` work in both modes.
+    Deferred import: the tensor namespace itself imports dygraph."""
+    from ... import tensor as tensor_ns
+    from ..framework import Variable
+    mods = (tensor_ns.linalg, tensor_ns.logic, tensor_ns.manipulation,
+            tensor_ns.math, tensor_ns.search, tensor_ns.stat)
+    for mod in mods:
+        for name in mod.__all__:
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            for cls in (Tensor, Variable):
+                if not hasattr(cls, name):
+                    setattr(cls, name, fn)

@@ -254,6 +254,19 @@ def _layer_norm(ctx, ins, attrs):
     v = x(ins)
     scale, bias = x(ins, "Scale"), x(ins, "Bias")
     ax = attrs.get("begin_norm_axis", 1)
+
+    # Pallas fused single-pass kernel on TPU (paddle_tpu/ops/pallas_layer_norm)
+    from ...ops.pallas_layer_norm import can_use_fused_ln, fused_layer_norm
+    rows = int(np.prod(v.shape[:ax])) if v.ndim > ax else 1
+    cols = int(np.prod(v.shape[ax:]))
+    if can_use_fused_ln(rows, cols, scale is not None, bias is not None):
+        y2, mean, rstd = fused_layer_norm(
+            v.reshape(rows, cols), scale.reshape(cols), bias.reshape(cols),
+            attrs["epsilon"])
+        var = 1.0 / jnp.square(rstd) - attrs["epsilon"]
+        return {"Y": [y2.reshape(v.shape)], "Mean": [mean],
+                "Variance": [var]}
+
     axes = tuple(range(ax, v.ndim))
     fp = v.astype(jnp.float32)
     mean = jnp.mean(fp, axis=axes, keepdims=True)

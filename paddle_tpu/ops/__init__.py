@@ -7,5 +7,7 @@ compute impls for existing op types; the registry falls back to the jnp
 reference implementation when Pallas is unavailable (CPU tests).
 """
 from . import flash_attention  # noqa: F401
+from . import pallas_attention  # noqa: F401
+from . import pallas_layer_norm  # noqa: F401
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "pallas_attention", "pallas_layer_norm"]

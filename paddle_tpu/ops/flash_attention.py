@@ -46,11 +46,24 @@ def _fused_attention(ctx, ins, attrs):
     q, k, v = x(ins, "Q"), x(ins, "K"), x(ins, "V")
     mask = x(ins, "Mask")
     scale = attrs.get("scale") or None
-    key = ctx.rng(attrs) if attrs.get("dropout_p", 0.0) > 0 and \
-        not ctx.is_test else None
-    o = sdpa_reference(q, k, v, mask, scale, attrs.get("causal", False),
-                       attrs.get("dropout_p", 0.0) if key is not None else 0.0,
-                       key)
+    causal = attrs.get("causal", False)
+    dropout_p = attrs.get("dropout_p", 0.0) if not ctx.is_test else 0.0
+
+    from .pallas_attention import can_use_flash, flash_attention
+    if can_use_flash(q, k, v, mask, dropout_p):
+        seed = 0
+        if dropout_p > 0.0:
+            # fold the step key into a 32-bit seed for the in-kernel hash rng
+            key = ctx.rng(attrs)
+            kd = key if jnp.issubdtype(key.dtype, jnp.integer) \
+                else jax.random.key_data(key)
+            seed = kd.ravel()[-1].astype(jnp.int32)
+        o = flash_attention(q, k, v, mask, scale, causal, dropout_p, seed)
+        return {"Out": [o]}
+
+    key = ctx.rng(attrs) if dropout_p > 0 else None
+    o = sdpa_reference(q, k, v, mask, scale, causal,
+                       dropout_p if key is not None else 0.0, key)
     return {"Out": [o]}
 
 

@@ -1,0 +1,435 @@
+"""Pallas TPU flash (blockwise) attention — fwd + bwd kernels.
+
+TPU-native replacement for the reference's fused attention CUDA tier
+(/root/reference/paddle/fluid/operators/fused/multihead_matmul_op.cc:1,
+/root/reference/paddle/fluid/operators/math/bert_encoder_functor.cu:1).
+Design: online-softmax blockwise attention (flash attention) so the S×T
+score matrix never materialises in HBM — Q blocks stream over K/V blocks
+held in VMEM, accumulating in f32 on the MXU. Backward recomputes P from
+the saved logsumexp (no S×T residual), with split dQ and dK/dV kernels.
+
+Dropout runs INSIDE the kernel via a counter-based hash (murmur3
+finaliser) of each score's global (batch·head, row, col) id, so forward
+and backward regenerate the identical keep mask without ever materialising
+it — and independently of block-size choices.
+
+Numerical contract: matches `sdpa_reference` (jnp) to bf16 tolerance;
+exercised by tests/test_pallas_kernels.py in interpret mode on CPU and by
+the bench on real TPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fine on CPU hosts too (needed for interpret mode)
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["flash_attention", "can_use_flash", "on_tpu"]
+
+_NEG_INF = -1e30
+
+
+def on_tpu() -> bool:
+    try:
+        plat = jax.devices()[0].platform
+    except Exception:  # pragma: no cover
+        return False
+    return plat in ("tpu", "axon")
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def _auto_block(n):
+    """Largest MXU-friendly block dividing n (bigger blocks amortise the
+    per-iteration overhead; 512×512 f32 scores are still VMEM-cheap)."""
+    for b in (512, 256, 128, 64):
+        if n % b == 0:
+            return b
+    return None
+
+
+def can_use_flash(q, k, v, mask, dropout_p=0.0, block_q=None,
+                  block_k=None) -> bool:
+    """Gate for the Pallas path: TPU (or interpret-mode tests), block-aligned
+    sequence lengths, and a padding-style mask (B,1,1,T) or none."""
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        return False
+    s, d = q.shape[2], q.shape[3]
+    t = k.shape[2]
+    block_q = block_q or _auto_block(s)
+    block_k = block_k or _auto_block(t)
+    if block_q is None or block_k is None:
+        return False
+    if s % block_q or t % block_k or d % 8 or d > 256:
+        return False
+    if mask is not None:
+        # only padding-style masks: (B,1,1,T) matching q's batch and k's
+        # length exactly (broadcastable variants fall back to sdpa)
+        if (mask.ndim != 4 or mask.shape[1] != 1 or mask.shape[2] != 1 or
+                mask.shape[0] != q.shape[0] or mask.shape[3] != t):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# kernels. Layouts: q/k/v/do (BH, S|T, D); lse/delta (BH, S, 128)
+# lane-broadcast f32; mask (B, 8, T) sublane-broadcast additive; seed
+# (1,) int32 in SMEM. The 128/8 broadcasts satisfy TPU min-tile rules
+# (same trick as the stock jax flash kernel's l/m residuals).
+# ---------------------------------------------------------------------------
+
+def _keep_mask(seed_ref, bh, rows, cols, t, dropout_p):
+    """Deterministic per-element keep mask: murmur3-finalise a counter
+    built from the global element id. Works identically on TPU and in
+    interpret mode (no pltpu.prng dependency), and identically between
+    forward and backward whatever the block partitioning."""
+    salt = (jnp.uint32(bh) * jnp.uint32(0x9e3779b9) +
+            jnp.uint32(seed_ref[0]))
+    x = salt ^ ((rows * t + cols).astype(jnp.uint32) *
+                jnp.uint32(0x85ebca6b))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85ebca6b)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xc2b2ae35)
+    x = x ^ (x >> 16)
+    thr = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return x >= thr
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                scale, causal, block_k, dropout_p):
+    bh, iq = pl.program_id(0), pl.program_id(1)
+    q = q_ref[0]                                        # (Bq, D) native dtype
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+    hi = jnp.minimum(jax.lax.div((iq + 1) * bq + block_k - 1, block_k), nk) \
+        if causal else nk
+
+    def body(j, carry):
+        acc, m_i, l_i = carry
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if mask_ref is not None:
+            s = s + mask_ref[0, 0:1, pl.ds(j * block_k, block_k)] \
+                .astype(jnp.float32)
+        rows = iq * bq + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        if causal:
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_i - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=-1)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, bh, rows, cols, t, dropout_p)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        acc = acc * alpha[:, None] + jnp.dot(
+            p.astype(vblk.dtype), vblk, preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    acc, m_i, l_i = jax.lax.fori_loop(
+        0, hi, body, (jnp.zeros((bq, d), jnp.float32),
+                      jnp.full((bq,), _NEG_INF, jnp.float32),
+                      jnp.zeros((bq,), jnp.float32)))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lane-broadcast to 128 (TPU min tile; same layout as the stock jax
+    # flash kernel's l/m residuals)
+    lse_ref[0] = jax.lax.broadcast_in_dim(
+        m_i + jnp.log(l_safe), (bq, 128), (0,))
+
+
+def _recompute_p(q, kblk, scale, mask_blk, lse_col, causal, rows, cols):
+    s = jax.lax.dot_general(q, kblk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if mask_blk is not None:
+        s = s + mask_blk
+    if causal:
+        s = jnp.where(rows >= cols, s, _NEG_INF)
+    return jnp.exp(s - lse_col)
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   mask_ref, dq_ref, *, scale, causal, block_k, dropout_p):
+    bh, iq = pl.program_id(0), pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse_col = lse_ref[0][:, 0:1]
+    delta_col = delta_ref[0][:, 0:1]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+    hi = jnp.minimum(jax.lax.div((iq + 1) * bq + block_k - 1, block_k), nk) \
+        if causal else nk
+
+    def body(j, dq):
+        kblk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        mask_blk = None
+        if mask_ref is not None:
+            mask_blk = mask_ref[0, 0:1, pl.ds(j * block_k, block_k)] \
+                .astype(jnp.float32)
+        rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        cols = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        p = _recompute_p(q, kblk, scale, mask_blk, lse_col, causal, rows,
+                         cols)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, bh, rows, cols, t, dropout_p)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        ds = (p * (dp - delta_col) * scale).astype(kblk.dtype)
+        return dq + jnp.dot(ds, kblk, preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    mask_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                    dropout_p):
+    bh, jk = pl.program_id(0), pl.program_id(1)
+    nk = pl.num_programs(1)
+    kblk = k_ref[0]                                     # (Bk, D) native
+    vblk = v_ref[0]
+    bk, d = kblk.shape
+    s_len = q_ref.shape[1]
+    s_len_t = nk * bk  # kv length (hash uses row*T+col global ids)
+    nq = s_len // block_q
+    mask_blk = mask_ref[0, 0:1, :].astype(jnp.float32) \
+        if mask_ref is not None else None
+    lo = jax.lax.div(jk * bk, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_col = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        delta_col = delta_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, bk), 0)
+        cols = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+        p = _recompute_p(q, kblk, scale, mask_blk, lse_col, causal, rows,
+                         cols)
+        dp = jax.lax.dot_general(do, vblk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            keep = _keep_mask(seed_ref, bh, rows, cols, s_len_t, dropout_p)
+            pd = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        else:
+            pd = p
+        dv = dv + jax.lax.dot_general(pd.astype(do.dtype), do,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_col) * scale).astype(q.dtype)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lo, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper
+# ---------------------------------------------------------------------------
+
+def _smem_seed_spec():
+    if pltpu is not None:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(memory_space=pl.ANY)  # pragma: no cover
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, mask2, seed_arr, scale, causal, block_q, block_k,
+           dropout_p):
+    o, _ = _flash_fwd_impl(q3, k3, v3, mask2, seed_arr, scale, causal,
+                           block_q, block_k, dropout_p)
+    return o
+
+
+def _flash_fwd_impl(q3, k3, v3, mask2, seed_arr, scale, causal, block_q,
+                    block_k, dropout_p):
+    """q3,k3,v3: (BH, S, D); mask2: (B, 8, T) additive or None."""
+    bh, s, d = q3.shape
+    t = k3.shape[1]
+    heads = bh // mask2.shape[0] if mask2 is not None else 1
+    in_specs = [
+        _smem_seed_spec(),
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [seed_arr, q3, k3, v3]
+    if mask2 is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 8, t), lambda b, i: (b // heads, 0, 0)))
+        args.append(mask2)
+
+        def kfn(seed_ref, q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref):
+            _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, m_ref, o_ref, lse_ref,
+                        scale=scale, causal=causal, block_k=block_k,
+                        dropout_p=dropout_p)
+    else:
+        def kfn(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref):
+            _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                        scale=scale, causal=causal, block_k=block_k,
+                        dropout_p=dropout_p)
+
+    o, lse = pl.pallas_call(
+        kfn, grid=(bh, s // block_q), in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, s, 128), jnp.float32),
+        ],
+        interpret=_interpret())(*args)
+    return o, lse
+
+
+def _flash_fwd(q3, k3, v3, mask2, seed_arr, scale, causal, block_q,
+               block_k, dropout_p):
+    o, lse = _flash_fwd_impl(q3, k3, v3, mask2, seed_arr, scale, causal,
+                             block_q, block_k, dropout_p)
+    return o, (q3, k3, v3, mask2, seed_arr, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, dropout_p, res, g):
+    q3, k3, v3, mask2, seed_arr, o, lse = res
+    bh, s, d = q3.shape
+    t = k3.shape[1]
+    heads = bh // mask2.shape[0] if mask2 is not None else 1
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True), (bh, s, 128))
+
+    dq_in = [
+        _smem_seed_spec(),
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),         # k
+        pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),         # v
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
+        pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, 128), lambda b, i: (b, i, 0)),  # delta
+    ]
+    dq_args = [seed_arr, q3, k3, v3, g, lse, delta]
+    if mask2 is not None:
+        dq_in.append(
+            pl.BlockSpec((1, 8, t), lambda b, i: (b // heads, 0, 0)))
+        dq_args.append(mask2)
+
+        def dq_kfn(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   m_ref, dq_ref):
+            _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, m_ref, dq_ref, scale=scale,
+                           causal=causal, block_k=block_k,
+                           dropout_p=dropout_p)
+    else:
+        def dq_kfn(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref):
+            _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, None, dq_ref, scale=scale,
+                           causal=causal, block_k=block_k,
+                           dropout_p=dropout_p)
+
+    dq = pl.pallas_call(
+        dq_kfn, grid=(bh, s // block_q), in_specs=dq_in,
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, s, d), q3.dtype)],
+        interpret=_interpret())(*dq_args)[0]
+
+    kv_in = [
+        _smem_seed_spec(),
+        pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),         # q full
+        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k block
+        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v block
+        pl.BlockSpec((1, s, d), lambda b, j: (b, 0, 0)),         # do full
+        pl.BlockSpec((1, s, 128), lambda b, j: (b, 0, 0)),       # lse
+        pl.BlockSpec((1, s, 128), lambda b, j: (b, 0, 0)),       # delta
+    ]
+    kv_args = [seed_arr, q3, k3, v3, g, lse, delta]
+    if mask2 is not None:
+        kv_in.append(
+            pl.BlockSpec((1, 8, block_k), lambda b, j: (b // heads, 0, j)))
+        kv_args.append(mask2)
+
+        def dkv_kfn(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    m_ref, dk_ref, dv_ref):
+            _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, m_ref, dk_ref, dv_ref, scale=scale,
+                            causal=causal, block_q=block_q,
+                            dropout_p=dropout_p)
+    else:
+        def dkv_kfn(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref):
+            _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, None, dk_ref, dv_ref, scale=scale,
+                            causal=causal, block_q=block_q,
+                            dropout_p=dropout_p)
+
+    dk, dv = pl.pallas_call(
+        dkv_kfn, grid=(bh, t // block_k), in_specs=kv_in,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        interpret=_interpret())(*kv_args)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, mask=None, scale=None, causal=False,
+                    dropout_p=0.0, dropout_seed=0, block_q=None,
+                    block_k=None):
+    """q,k,v: (B,H,S,D); mask: additive (B,1,1,T) or None. Returns (B,H,S,D).
+
+    The Pallas path; call `can_use_flash` first. On non-TPU hosts the same
+    kernels run in interpreter mode (slow — tests only).
+    """
+    b, h, s, d = q.shape
+    t = k.shape[2]
+    block_q = block_q or _auto_block(s)
+    block_k = block_k or _auto_block(t)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q3 = q.reshape(b * h, s, d)
+    k3 = k.reshape(b * h, t, d)
+    v3 = v.reshape(b * h, t, d)
+    mask2 = None
+    if mask is not None:
+        mask2 = jnp.broadcast_to(mask.reshape(b, 1, t), (b, 8, t))
+    seed_arr = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    o = _flash(q3, k3, v3, mask2, seed_arr, float(scale), bool(causal),
+               int(block_q), int(block_k), float(dropout_p))
+    return o.reshape(b, h, s, d)

@@ -1,0 +1,147 @@
+"""Pallas TPU fused layer_norm — single-pass fwd + fused-dx bwd kernels.
+
+TPU-native replacement for the reference's layer_norm CUDA kernels
+(/root/reference/paddle/fluid/operators/layer_norm_op.cu:1 and the fused
+skip-layernorm tier in framework/ir/skip_layernorm_fuse_pass.cc). One VMEM
+pass computes mean/rstd and the normalised+affine output per row block; the
+backward fuses the three dx reduction terms into one kernel. dscale/dbias
+are thin cross-row reductions left to XLA (they fuse into surrounding ops).
+
+Layouts: x/y (R, C); scale/bias (1, C); mean/rstd residuals (R, 128)
+lane-broadcast f32 (TPU min-tile trick, same as the flash kernel's lse).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_attention import on_tpu
+
+__all__ = ["fused_layer_norm", "can_use_fused_ln"]
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def can_use_fused_ln(rows: int, cols: int, has_scale: bool,
+                     has_bias: bool) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    if not (has_scale and has_bias):
+        return False
+    if cols % 128 or cols > 16384:
+        return False
+    return _pick_block(rows) is not None
+
+
+def _pick_block(rows: int):
+    for br in (256, 128, 64, 32, 16, 8):
+        if rows % br == 0:
+            return br
+    return None
+
+
+def _fwd_kernel(x_ref, scale_ref, bias_ref, y_ref, mean_ref, rstd_ref, *,
+                eps):
+    xv = x_ref[:].astype(jnp.float32)                    # (Br, C)
+    mean = jnp.mean(xv, axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(xv - mean), axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (xv - mean) * rstd
+    y = xhat * scale_ref[0].astype(jnp.float32)[None, :] + \
+        bias_ref[0].astype(jnp.float32)[None, :]
+    y_ref[:] = y.astype(y_ref.dtype)
+    br = xv.shape[0]
+    mean_ref[:] = jax.lax.broadcast_in_dim(mean[:, 0], (br, 128), (0,))
+    rstd_ref[:] = jax.lax.broadcast_in_dim(rstd[:, 0], (br, 128), (0,))
+
+
+def _bwd_dx_kernel(x_ref, scale_ref, mean_ref, rstd_ref, dy_ref, dx_ref):
+    xv = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    mean = mean_ref[:][:, 0:1]
+    rstd = rstd_ref[:][:, 0:1]
+    xhat = (xv - mean) * rstd
+    a = dy * scale_ref[0].astype(jnp.float32)[None, :]
+    c1 = jnp.mean(a, axis=1, keepdims=True)
+    c2 = jnp.mean(a * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (a - c1 - xhat * c2)).astype(dx_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x2d, scale, bias, eps):
+    """x2d: (R, C); scale/bias: (C,). Returns (y, mean, rstd) with mean/rstd
+    shaped (R,) f32. Statistics outputs are non-differentiable (reference
+    layer_norm Mean/Variance outputs carry no gradient)."""
+    y, mean, rstd = _ln_fwd_impl(x2d, scale, bias, eps)
+    return y, mean, rstd
+
+
+def _ln_fwd_impl(x2d, scale, bias, eps):
+    r, c = x2d.shape
+    br = _pick_block(r)
+    y, mean_b, rstd_b = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(r // br,),
+        in_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, c), x2d.dtype),
+            jax.ShapeDtypeStruct((r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((r, 128), jnp.float32),
+        ],
+        interpret=_interpret())(x2d, scale.reshape(1, c), bias.reshape(1, c))
+    return y, mean_b[:, 0], rstd_b[:, 0]
+
+
+def _ln_fwd(x2d, scale, bias, eps):
+    y, mean, rstd = _ln_fwd_impl(x2d, scale, bias, eps)
+    return (y, mean, rstd), (x2d, scale, mean, rstd)
+
+
+def _ln_bwd(eps, res, cots):
+    dy, _dmean, _drstd = cots  # stats are non-differentiable outputs
+    x2d, scale, mean, rstd = res
+    r, c = x2d.shape
+    br = _pick_block(r)
+    mean_b = jnp.broadcast_to(mean[:, None], (r, 128))
+    rstd_b = jnp.broadcast_to(rstd[:, None], (r, 128))
+    dx = pl.pallas_call(
+        _bwd_dx_kernel,
+        grid=(r // br,),
+        in_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((br, c), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((r, c), x2d.dtype)],
+        interpret=_interpret())(x2d, scale.reshape(1, c), mean_b, rstd_b,
+                                dy)[0]
+    # dscale/dbias: thin cross-row reductions — XLA fuses these fine
+    xf = x2d.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean[:, None]) * rstd[:, None]
+    dscale = jnp.sum(dyf * xhat, axis=0).astype(scale.dtype)
+    dbias = jnp.sum(dyf, axis=0).astype(scale.dtype)
+    return dx, dscale, dbias
+
+
+fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)

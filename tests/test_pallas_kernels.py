@@ -1,0 +1,145 @@
+"""Pallas flash-attention + fused layer_norm kernels, run in interpret mode
+on the CPU mesh and compared against the jnp reference implementations
+(VERDICT r1 item 1: kernels must match fwd+grad)."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_attention import sdpa_reference
+from paddle_tpu.ops.pallas_attention import can_use_flash, flash_attention
+from paddle_tpu.ops.pallas_layer_norm import can_use_fused_ln, fused_layer_norm
+
+
+@pytest.fixture(autouse=True)
+def _interpret_env():
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    yield
+    os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+
+
+def _qkv(B=2, H=3, S=128, D=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    mask = jnp.asarray(
+        np.where(rng.rand(B, 1, 1, S) > 0.2, 0.0, -1e30).astype("float32"))
+    return mk(), mk(), mk(), mask
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_flash_forward_matches_reference(causal, with_mask):
+    q, k, v, mask = _qkv()
+    m = mask if with_mask else None
+    assert can_use_flash(q, k, v, m, 0.0, 64, 64)
+    o1 = flash_attention(q, k, v, m, causal=causal, block_q=64, block_k=64)
+    o2 = sdpa_reference(q, k, v, m, causal=causal)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=2e-5)
+
+
+def test_flash_grads_match_reference():
+    q, k, v, mask = _qkv()
+
+    def f_pallas(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask, causal=True,
+                                       block_q=64, block_k=64) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(sdpa_reference(q, k, v, mask, causal=True) ** 2)
+
+    g1 = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        rel = float(jnp.max(jnp.abs(a - b))) / \
+            (float(jnp.max(jnp.abs(b))) + 1e-9)
+        assert rel < 1e-4
+
+
+def test_flash_bf16_tolerance():
+    q, k, v, _ = _qkv()
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    o1 = flash_attention(qb, kb, vb, block_q=64, block_k=64)
+    o2 = sdpa_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(o1, "float32"), np.asarray(o2),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_flash_dropout_statistics_and_determinism():
+    q, k, v, _ = _qkv(B=1, H=2)
+    outs = [flash_attention(q, k, v, dropout_p=0.3, dropout_seed=s,
+                            block_q=64, block_k=64) for s in range(16)]
+    base = flash_attention(q, k, v, block_q=64, block_k=64)
+    err = float(jnp.max(jnp.abs(jnp.mean(jnp.stack(outs), 0) - base)))
+    assert err < 0.3  # statistical: E[dropout out] = base out
+    o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7,
+                         block_q=64, block_k=64)
+    o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7,
+                         block_q=64, block_k=64)
+    assert float(jnp.max(jnp.abs(o1 - o2))) == 0.0
+    # dropped entries really change the output
+    assert float(jnp.max(jnp.abs(o1 - base))) > 1e-3
+
+
+def test_fused_layer_norm_matches_reference():
+    rng = np.random.RandomState(0)
+    R, C = 64, 256
+    x = jnp.asarray(rng.randn(R, C).astype("float32"))
+    sc = jnp.asarray(rng.randn(C).astype("float32"))
+    b = jnp.asarray(rng.randn(C).astype("float32"))
+    assert can_use_fused_ln(R, C, True, True)
+
+    def ref(x, sc, b, eps=1e-5):
+        m = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - m) * jax.lax.rsqrt(var + eps) * sc + b
+
+    y, mean, rstd = fused_layer_norm(x, sc, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref(x, sc, b)),
+                               atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(jnp.mean(x, -1)),
+                               rtol=1e-5)
+    g1 = jax.grad(lambda *a: jnp.sum(fused_layer_norm(*a, 1e-5)[0] ** 2),
+                  argnums=(0, 1, 2))(x, sc, b)
+    g2 = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                  argnums=(0, 1, 2))(x, sc, b)
+    for a, b_ in zip(g1, g2):
+        rel = float(jnp.max(jnp.abs(a - b_))) / \
+            (float(jnp.max(jnp.abs(b_))) + 1e-9)
+        assert rel < 1e-5
+
+
+def test_layer_norm_op_routes_through_pallas():
+    """The registered layer_norm op picks the Pallas path when legal and
+    still matches the plain-jnp path bit-for-bit-ish."""
+    x = np.random.RandomState(0).randn(16, 256).astype("float32")
+    t = paddle.to_tensor(x)
+    w = paddle.to_tensor(np.ones(256, "float32"))
+    b = paddle.to_tensor(np.zeros(256, "float32"))
+    y1 = paddle.nn.functional.layer_norm(t, 256, weight=w, bias=b)
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+    try:
+        y2 = paddle.nn.functional.layer_norm(t, 256, weight=w, bias=b)
+    finally:
+        os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy(), atol=1e-5)
+
+
+def test_fused_attention_op_routes_through_pallas():
+    from paddle_tpu.ops.flash_attention import scaled_dot_product_attention
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(2, 2, 128, 32).astype("float32"))
+    k = paddle.to_tensor(rng.randn(2, 2, 128, 32).astype("float32"))
+    v = paddle.to_tensor(rng.randn(2, 2, 128, 32).astype("float32"))
+    o1 = scaled_dot_product_attention(q, k, v, is_causal=True)
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+    try:
+        o2 = scaled_dot_product_attention(q, k, v, is_causal=True)
+    finally:
+        os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+    np.testing.assert_allclose(o1.numpy(), o2.numpy(), atol=2e-5)
