@@ -49,13 +49,39 @@ def _differentiable_ancestors(block, loss_name: str, no_grad: set[str]):
     return need
 
 
+def _plan_recompute_segments(fwd_ops, checkpoints):
+    """Index ranges [(start, end)] of forward ops to recompute, delimited by
+    checkpoint vars (reference _append_backward_ops_with_checkpoints_,
+    backward.py:629). Ops after the last checkpoint stay un-recomputed —
+    their activations are immediately consumed by the first grad ops."""
+    names = [c.name if isinstance(c, Variable) else str(c)
+             for c in checkpoints]
+    idxs = set()
+    for name in names:
+        prod = [i for i, op in enumerate(fwd_ops)
+                if name in op.output_arg_names]
+        if prod:
+            idxs.add(max(prod))
+    segments = []
+    start = 0
+    for i in sorted(idxs):
+        if i >= start:
+            segments.append((start, i))
+            start = i + 1
+    return segments
+
+
 def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                     callbacks=None, checkpoints=None):
     """Append grad ops for `loss`; returns [(param, grad_var)].
 
-    `checkpoints` (recompute/activation-checkpointing) is accepted for parity
-    with backward.py:629; on TPU rematerialisation is handled by
-    `jax.checkpoint` at the layer level (see paddle_tpu.distributed.recompute).
+    With `checkpoints`, forward segments between checkpoint vars are
+    RE-EMITTED into the backward region (fresh @RC names, inputs routed
+    through `recompute_barrier` so XLA CSE cannot merge them with the
+    original forward) and each segment's grad ops consume the recomputed
+    activations — true rematerialisation at the Program level, mirroring the
+    reference's checkpoint-aware backward (backward.py:629). Layer-level
+    remat for the functional path lives in paddle_tpu.distributed.recompute.
     """
     block = loss.block
     program = block.program
@@ -104,15 +130,18 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
             block.append_op(type="sum", inputs={"X": [orig, rn]},
                             outputs={"Out": [orig]})
 
-    for op in reversed(block.ops[: loss_idx + 1]):
-        if not any(n in need for n in op.output_arg_names):
-            continue
-        opdef = registry.lookup(op.type)
+    def emit_grads_for(orig_op, grad_src_op):
+        """Emit grad ops for `orig_op` (need/registry gating on its original
+        names) reading forward values from `grad_src_op` (== orig_op, or its
+        @RC re-emission)."""
+        if not any(n in need for n in orig_op.output_arg_names):
+            return
+        opdef = registry.lookup(orig_op.type)
         if opdef is None or opdef.grad is None:
-            continue
+            return
         # zero-fill upstream grads that nothing produced (reference
         # fill_zeros_like insertion)
-        for slot, names in op.outputs.items():
+        for slot, names in grad_src_op.outputs.items():
             if slot in opdef.no_grad_out_slots:
                 continue
             for n in names:
@@ -122,9 +151,81 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
                                     inputs={"X": [n]}, outputs={"Out": [gn]})
                     written[gn] = 1
         if opdef.grad == "auto":
-            registry.make_default_grad_ops(op, emit)
+            registry.make_default_grad_ops(grad_src_op, emit)
         else:
-            opdef.grad(op, emit)
+            opdef.grad(grad_src_op, emit)
+
+    fwd_ops = list(block.ops[: loss_idx + 1])
+    segments = _plan_recompute_segments(fwd_ops, checkpoints) \
+        if checkpoints else []
+    seg_by_end = {e: (s, e) for s, e in segments}
+
+    def emit_recompute_segment(seg):
+        s, e = seg
+        seg_ops = fwd_ops[s:e + 1]
+        produced = {n for op in seg_ops for n in op.output_arg_names}
+        # vars the rest of the graph reads directly (checkpoints and any
+        # other segment-crossing vars) — these stay live, grads arrive under
+        # their canonical names
+        outside = {n for op in fwd_ops[e + 1:] for n in op.input_arg_names
+                   if n in produced}
+        rc = {n: f"{n}@RC{s}" for n in produced}
+        externals = {n for op in seg_ops for n in op.input_arg_names
+                     if n not in produced}
+        bmap = {}
+        for n in sorted(externals):
+            v = block._var_recursive(n)
+            if v is not None and v.persistable:
+                continue  # params stay direct reads (always live anyway)
+            bn = f"{n}@RCB{s}"
+            block.append_op(type="recompute_barrier", inputs={"X": [n]},
+                            outputs={"Out": [bn]})
+            bmap[n] = bn
+        in_map = {**rc, **bmap}
+        rc_ops = []
+        for op in seg_ops:
+            rc_ops.append(block.append_op(
+                type=op.type,
+                inputs={slot: [in_map.get(n, n) for n in names]
+                        for slot, names in op.inputs.items()},
+                outputs={slot: [rc.get(n, n) for n in names]
+                         for slot, names in op.outputs.items()},
+                attrs=dict(op.attrs)))  # same _rng_id → identical randomness
+        # boundary grads: anything downstream (grad ops of later segments,
+        # or the loss seed itself when the checkpointed var IS the loss)
+        # accumulated onto the canonical n@GRAD — seed the @RC-named grad
+        # from it for every produced var with a written canonical grad
+        for n in sorted(produced):
+            gn, rgn = grad_var_name(n), grad_var_name(rc[n])
+            if gn in written and rgn not in written:
+                block.append_op(type="assign", inputs={"X": [gn]},
+                                outputs={"Out": [rgn]})
+                written[rgn] = 1
+        for op, rc_op in reversed(list(zip(seg_ops, rc_ops))):
+            emit_grads_for(op, rc_op)
+        # grads that flowed to barriered externals redirect to canonical
+        for n, bn in sorted(bmap.items()):
+            bgn = grad_var_name(bn)
+            if bgn not in written:
+                continue
+            gn = grad_var_name(n)
+            if gn in written:
+                block.append_op(type="sum", inputs={"X": [gn, bgn]},
+                                outputs={"Out": [gn]})
+            else:
+                block.append_op(type="assign", inputs={"X": [bgn]},
+                                outputs={"Out": [gn]})
+                written[gn] = 1
+
+    i = loss_idx
+    while i >= 0:
+        seg = seg_by_end.get(i)
+        if seg is not None:
+            emit_recompute_segment(seg)
+            i = seg[0] - 1
+            continue
+        emit_grads_for(fwd_ops[i], fwd_ops[i])
+        i -= 1
 
     # collect (param, grad) pairs
     if parameter_list is not None:

@@ -43,6 +43,16 @@ def _assert(ctx, ins, attrs):
     return {}
 
 
+@register("recompute_barrier", grad=None)
+def _recompute_barrier(ctx, ins, attrs):
+    """Identity guarded by an XLA optimization barrier. Recomputed forward
+    segments (append_backward checkpoints) read their inputs through this so
+    common-subexpression elimination cannot merge the recomputation back
+    into the original forward — which would keep the original activations
+    live and undo the rematerialisation (the whole point of recompute)."""
+    return out(jax.lax.optimization_barrier(x(ins)))
+
+
 @register("select_input", grad=None)
 def _select_input(ctx, ins, attrs):
     mask = x(ins, "Mask").reshape(()).astype(jnp.int32)

@@ -627,9 +627,13 @@ class ExponentialMovingAverage:
 
 
 class RecomputeOptimizer(Optimizer):
-    """Activation recompute wrapper (reference optimizer.py:4518). On TPU
-    rematerialisation is expressed with jax.checkpoint policies applied at
-    executor trace time over the checkpoint-delimited segments."""
+    """Activation recompute wrapper (reference optimizer.py:4518).
+
+    Set checkpoints with `_set_checkpoints([...vars...])`; backward then
+    re-emits the forward segments between checkpoints into the backward
+    region behind `recompute_barrier` ops (see append_backward), so the
+    original segment activations die after forward and are rematerialised
+    for the grad ops — true program-level recompute, not an XLA-CSE hope."""
 
     def __init__(self, optimizer):
         self._optimizer = optimizer
@@ -641,12 +645,23 @@ class RecomputeOptimizer(Optimizer):
     def __getattr__(self, item):
         return getattr(self._optimizer, item)
 
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if self._checkpoints is None:
+            raise ValueError(
+                "RecomputeOptimizer: call _set_checkpoints([...]) with the "
+                "segment-boundary variables before minimize()")
+        parameter_list = parameter_list or getattr(
+            self._optimizer, "_parameter_list", None)
+        return append_backward(loss, parameter_list, no_grad_set, callbacks,
+                               checkpoints=self._checkpoints)
+
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        # checkpoints guide XLA remat; graph-level backward is unchanged
-        # (grad ops recompute forward via vjp, XLA CSE decides sharing).
-        return self._optimizer.minimize(loss, startup_program,
-                                        parameter_list, no_grad_set)
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self._optimizer.apply_gradients(params_grads)
+        return optimize_ops, params_grads
 
 
 class GradientMergeOptimizer(Optimizer):

@@ -36,6 +36,14 @@ class TrainStep:
 
         self.model = model
         self.loss_fn = loss_fn
+        if remat:
+            # wrap transformer layers so their activations rematerialise in
+            # backward (jax.checkpoint; reference RecomputeOptimizer
+            # optimizer.py:4518 / backward.py:629)
+            from ..distributed.recompute import wrap_layer_recompute
+            self.remat_layers = wrap_layer_recompute(model)
+        else:
+            self.remat_layers = 0
         self.params = [p for p in model.parameters() if p.trainable]
         self.buffers = [b for _, b in model.named_buffers()
                         if isinstance(b, Tensor)]

@@ -1,0 +1,212 @@
+"""Recompute/activation checkpointing (VERDICT r1 item 3): the static
+checkpoint-aware backward, the RecomputeOptimizer wrapper, and the
+functional-path jax.checkpoint wiring must be REAL — structurally visible
+and numerically identical to the plain path."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.fluid import layers
+
+
+def _build_mlp(x_np, lr=0.1, recompute=False):
+    """3-layer MLP; returns (scope, main, loss, fetch fn) trained one step."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    main, startup = framework.Program(), framework.Program()
+    main.random_seed = 7
+    scope = Scope()
+    with framework.program_guard(main, startup), scope_guard(scope), \
+            unique_name.guard():
+        x = layers.data("x", list(x_np.shape), "float32")
+        h1 = layers.fc(x, 16, act="relu")
+        h2 = layers.fc(h1, 16, act="relu")
+        h3 = layers.fc(h2, 16, act="relu")
+        loss = layers.mean(layers.fc(h3, 1))
+        inner = fluid.optimizer.SGDOptimizer(learning_rate=lr)
+        if recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(inner)
+            opt._set_checkpoints([h1, h2])
+            opt.minimize(loss)
+        else:
+            inner.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        lv, = exe.run(main, feed={"x": x_np}, fetch_list=[loss])
+        params = {p.name: np.asarray(scope.find_var(p.name))
+                  for p in main.all_parameters()}
+    return float(lv[0]), params, main
+
+
+def test_recompute_optimizer_matches_plain_backward(fresh_programs):
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(8, 16).astype("float32")
+    # numpy rngs must match: both builds use the same startup random_seed
+    np.random.seed(3)
+    l1, p1, main_plain = _build_mlp(x_np, recompute=False)
+    np.random.seed(3)
+    l2, p2, main_rc = _build_mlp(x_np, recompute=True)
+    assert abs(l1 - l2) < 1e-6
+    for name in p1:
+        np.testing.assert_allclose(p1[name], p2[name], rtol=1e-5,
+                                   err_msg=name)
+
+
+def test_recompute_program_structure(fresh_programs):
+    """The recompute program must actually contain re-emitted forward ops
+    and barrier ops — RecomputeOptimizer may not be a no-op delegate."""
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(4, 16).astype("float32")
+    np.random.seed(3)
+    _, _, main = _build_mlp(x_np, recompute=True)
+    types = [op.type for op in main.global_block().ops]
+    assert "recompute_barrier" in types
+    rc_outputs = [n for op in main.global_block().ops
+                  for n in op.output_arg_names if "@RC" in n]
+    assert rc_outputs, "no re-emitted forward ops found"
+
+
+def test_recompute_with_dropout_consistency(fresh_programs):
+    """Stochastic ops re-emitted in the backward region keep the same
+    _rng_id, so the recomputed dropout mask matches the forward mask and
+    gradients equal the plain (non-recompute) path under the same seed."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(8, 16).astype("float32")
+
+    def build(recompute):
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = 11
+        scope = Scope()
+        np.random.seed(4)
+        with framework.program_guard(main, startup), scope_guard(scope), \
+                unique_name.guard():
+            x = layers.data("x", [8, 16], "float32")
+            w = layers.create_parameter([16, 16], "float32", name="rc_w")
+            h = layers.dropout(layers.mul(x, w), 0.5)
+            ck = layers.relu(h)
+            loss = layers.mean(layers.mul(ck, w))
+            inner = fluid.optimizer.SGDOptimizer(learning_rate=0.0)
+            if recompute:
+                opt = fluid.optimizer.RecomputeOptimizer(inner)
+                opt._set_checkpoints([ck])
+                _, params_grads = opt.minimize(loss)
+            else:
+                _, params_grads = inner.minimize(loss)
+            exe = fluid.Executor()
+            exe.run(startup)
+            gname = params_grads[0][1].name
+            lv, gv = exe.run(main, feed={"x": x_np},
+                             fetch_list=[loss, gname])
+        return float(lv[0]), np.asarray(gv)
+
+    l_plain, g_plain = build(False)
+    l_rc, g_rc = build(True)
+    # identical program seed + run counter → identical dropout draw; the
+    # recomputed mask must reproduce it or grads diverge
+    assert abs(l_plain - l_rc) < 1e-6
+    np.testing.assert_allclose(g_rc, g_plain, rtol=1e-5)
+    assert np.isfinite(g_rc).all()
+
+
+def test_train_step_remat_flag():
+    """TrainStep(remat=True) must change the traced computation: the jaxpr
+    contains the checkpoint/remat primitive and losses still match the
+    non-remat step."""
+    import jax
+    from paddle_tpu.jit.functional import make_train_step
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    def build(remat):
+        np.random.seed(0)
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        model.train()
+
+        def loss_fn(m, ids, mlm, nsp):
+            logits, nsp_logits = m(ids)
+            return m.loss(logits, nsp_logits, mlm, nsp)
+
+        return make_train_step(model, loss_fn, optimizer="adamw", lr=1e-3,
+                               remat=remat)
+
+    from paddle_tpu.fluid import framework
+
+    step_plain = build(False)
+    step_remat = build(True)
+    assert step_remat.remat_layers > 0
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, 1024, (2, 32)).astype("int64")
+    mlm = np.full((2, 32), -100, "int64")
+    mlm[:, ::5] = ids[:, ::5]
+    nsp = rng.randint(0, 2, (2, 1)).astype("int64")
+
+    # dropout rng ids come from the global tracer op counter at trace time;
+    # reset before each trace so both steps draw identical masks
+    framework._dygraph_tracer()._op_counter = 0
+    l1 = [float(step_plain(ids, mlm, nsp, seed=5)) for _ in range(3)]
+    framework._dygraph_tracer()._op_counter = 0
+    l2 = [float(step_remat(ids, mlm, nsp, seed=5)) for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+    # structural proof: remat primitive present in the traced step
+    import jax.numpy as jnp
+    jaxpr = jax.make_jaxpr(
+        lambda pv, st, bv, s, lr: step_remat._jit_step.__wrapped__(
+            pv, st, bv, s, lr, jnp.asarray(ids), jnp.asarray(mlm),
+            jnp.asarray(nsp)))(
+        step_remat.param_vals, step_remat.opt_state,
+        step_remat.buffer_vals, np.uint32(1), 1e-3)
+    def all_prims(jpr, acc):
+        for eqn in jpr.eqns:
+            acc.add(str(eqn.primitive))
+            for v in eqn.params.values():
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None:
+                    all_prims(inner, acc)
+        return acc
+
+    prims = all_prims(jaxpr.jaxpr, set())
+    assert any("remat" in p or "checkpoint" in p for p in prims), prims
+
+
+def test_recompute_checkpoint_without_downstream_consumer(fresh_programs):
+    """A checkpoint var with no later forward consumer (e.g. the loss
+    itself) must still seed the recomputed segment's gradient — regression
+    for silently-zero param grads."""
+    from paddle_tpu.fluid import framework, unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    main, startup = framework.Program(), framework.Program()
+    scope = Scope()
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(4, 8).astype("float32")
+    with framework.program_guard(main, startup), scope_guard(scope), \
+            unique_name.guard():
+        x = layers.data("x", [4, 8], "float32")
+        h = layers.fc(x, 8, act="relu")
+        loss = layers.mean(layers.fc(h, 1))
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGDOptimizer(learning_rate=0.0))
+        opt._set_checkpoints([h, loss])
+        _, params_grads = opt.minimize(loss)
+        exe = fluid.Executor()
+        exe.run(startup)
+        fetch = [g.name for _, g in params_grads]
+        grads = exe.run(main, feed={"x": x_np}, fetch_list=fetch)
+        assert any(np.abs(g).max() > 0 for g in grads), \
+            "all recompute grads are zero"
+
+
+def test_recompute_function_eager_passthrough():
+    """In plain eager mode recompute() is a documented pass-through that
+    keeps gradients flowing."""
+    from paddle_tpu.distributed.recompute import recompute
+    lin = paddle.nn.Linear(4, 4)
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    y = recompute(lin.forward, x)
+    loss = paddle.sum(y)
+    loss.backward()
+    assert lin.weight.grad is not None
