@@ -12,8 +12,10 @@ the number is auditable.
 The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
 1.0 until a measured reference lands.
 
-Configs (BENCH_CONFIG=...): bert_base (default, seq 128) | bert_base_512 |
-bert_tiny | lenet | flash_attn (pallas-vs-jnp microbench) | allreduce.
+Configs (BENCH_CONFIG=...): bert_base (default, seq 128; also records the
+secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
+| bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | flash_attn
+(pallas-vs-jnp microbench) | allreduce.
 """
 from __future__ import annotations
 
@@ -203,6 +205,92 @@ def bench_flash_attn(steps=20, warmup=3):
             "jnp_ms": round(t_jnp * 1e3, 3)}
 
 
+def gpt_train_flops_per_step(cfg, batch, seq):
+    """Matmul-only analytic FLOPs, fwd + 2x bwd (MFU convention)."""
+    H, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    F = cfg.intermediate_size
+    tokens = batch * seq
+    per_layer = (3 * 2 * H * H      # q, k, v projections
+                 + 2 * H * H        # out projection
+                 + 2 * 2 * seq * H  # scores + context (per token)
+                 + 2 * H * F + 2 * F * H)
+    fwd = tokens * (L * per_layer + 2 * H * V)
+    return 3 * fwd
+
+
+def bench_gpt(batch=8, seq=1024, steps=10, warmup=2, dp=1, pp=1, tp=1):
+    """GPT-350M causal-LM train step (BASELINE config 5 single-chip proxy;
+    the full dp x pp x tp path is validated by dryrun_multichip and scales
+    via the same HybridParallelTrainStep)."""
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel.hybrid import HybridParallelTrainStep
+
+    cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                    max_position_embeddings=max(1024, seq),
+                    amp_dtype="bfloat16")
+    step = HybridParallelTrainStep(cfg, dp=dp, pp=pp, tp=tp,
+                                   n_microbatches=2 * pp if pp > 1 else None,
+                                   grad_clip_norm=1.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    for _ in range(warmup):
+        loss = step(ids)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    toks = batch * seq * steps / dt
+    peak, kind = chip_peak_flops()
+    mfu = gpt_train_flops_per_step(cfg, batch, seq) * steps / dt / peak
+    return {"metric": "gpt_350m_train_tokens_per_sec_per_chip",
+            "value": round(toks, 1), "unit": "tokens/sec/chip",
+            "mfu": round(mfu, 4), "batch": batch, "seq": seq,
+            "dp": dp, "pp": pp, "tp": tp, "device_kind": str(kind)}
+
+
+def resnet_train_flops_per_step(batch):
+    """ResNet-50 ~4.1 GFLOP (2x MACs) per 224x224 image forward; train
+    step = 3x forward."""
+    return 3 * 4.1e9 * batch
+
+
+def bench_resnet50(batch=64, steps=10, warmup=3):
+    """ResNet-50 ImageNet train step (BASELINE config 2), bf16 autocast."""
+    import jax
+    from paddle_tpu.jit.functional import make_train_step
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn.functional as F
+
+    model = resnet50(num_classes=1000)
+    model.train()
+
+    def loss_fn(m, img, label):
+        logits = m(img)
+        return F.cross_entropy(logits, label)
+
+    step = make_train_step(model, loss_fn, optimizer="momentum", lr=0.1,
+                           amp_level="O1")
+    rng = np.random.RandomState(0)
+    img = rng.randn(batch, 3, 224, 224).astype("float32")
+    lab = rng.randint(0, 1000, (batch, 1)).astype("int64")
+    for _ in range(warmup):
+        loss = step(img, lab)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(img, lab)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    peak, kind = chip_peak_flops()
+    mfu = resnet_train_flops_per_step(batch) * steps / dt / peak
+    return {"metric": "resnet50_train_images_per_sec",
+            "value": round(batch * steps / dt, 2), "unit": "images/sec",
+            "mfu": round(mfu, 4), "batch": batch, "device_kind": str(kind)}
+
+
 def bench_allreduce(mb=64, steps=30, warmup=5):
     """Achieved allreduce bandwidth over the device mesh (BASELINE config 2
     companion metric). Algorithmic bandwidth: 2·(n-1)/n · bytes / time."""
@@ -248,10 +336,38 @@ def main():
         rec = bench_flash_attn()
     elif which == "allreduce":
         rec = bench_allreduce()
+    elif which == "gpt":
+        rec = bench_gpt()
+    elif which == "resnet50":
+        rec = bench_resnet50()
     else:
         # batch 32 is the measured sweet spot on v5e (24.1% MFU; batch 64
         # regresses to 18.6% — memory pressure)
         rec = bench_bert("base", batch=32)
+        # secondary configs ride along in the single JSON line so every
+        # round's BENCH record carries the whole BASELINE matrix
+        if os.environ.get("BENCH_EXTRAS", "1") != "0":
+            extras = {}
+            for name, fn in [
+                    ("bert_base_512",
+                     lambda: bench_bert("base_512", batch=16, seq=512,
+                                        steps=6, warmup=2)),
+                    ("gpt_350m", lambda: bench_gpt(steps=6, warmup=2)),
+                    ("resnet50", lambda: bench_resnet50(steps=8, warmup=2)),
+                    ("flash_attn", bench_flash_attn),
+            ]:
+                try:
+                    extras[name] = fn()
+                except Exception as e:  # keep the headline robust
+                    extras[name] = {"error": f"{type(e).__name__}: {e}"}
+            import jax
+            if len(jax.devices()) > 1:
+                try:
+                    extras["allreduce"] = bench_allreduce()
+                except Exception as e:
+                    extras["allreduce"] = {
+                        "error": f"{type(e).__name__}: {e}"}
+            rec["extras"] = extras
     rec.setdefault("vs_baseline", 1.0)
     print(json.dumps(rec))
 

@@ -66,7 +66,16 @@ _DEFAULTS = {
     "cudnn_batchnorm_spatial_persistent": False,
     # TPU-native extensions (absent in reference — SURVEY §2.9 TP/SP/EP rows)
     "tensor_parallel": False,
-    "tensor_parallel_configs": {"tensor_parallel_degree": 1},
+    # sharding_rules: [(param-name-regex, partition-spec-tuple)], e.g.
+    # ("fc_.*\\.w_0", (None, "tp")) — consumed by the static Executor, which
+    # device_puts matching persistables with NamedSharding over the
+    # ("dp","tp") mesh and lets GSPMD insert the collectives.
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "sharding_rules": []},
+    # hybrid dp x pp x tp for the functional engine
+    # (parallel.HybridParallelTrainStep via fleet.hybrid_train_step)
+    "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                       "micro_batches": None},
     "sharding": False,
     "sharding_configs": {"sharding_degree": 1, "stage": 1},
     "sequence_parallel": False,

@@ -121,12 +121,36 @@ class Fleet:
     def get_loss_scaling(self):
         return None
 
+    def hybrid_train_step(self, cfg, **kwargs):
+        """Build the dp x pp x tp functional train step from this fleet's
+        strategy (`hybrid_configs` + pipeline/tensor_parallel flags) — the
+        consumer of `strategy.pipeline`/`tensor_parallel` for Layer-free GPT
+        training (reference chain: fleet pipeline meta-optimizer
+        meta_optimizers/pipeline_optimizer.py:24)."""
+        from ....parallel.hybrid import HybridParallelTrainStep
+        st = self._strategy or DistributedStrategy()
+        hc = st.hybrid_configs
+        dp, pp, tp = hc["dp_degree"], hc["pp_degree"], hc["mp_degree"]
+        if st.tensor_parallel and tp == 1:
+            tp = st.tensor_parallel_configs["tensor_parallel_degree"]
+        micro = hc["micro_batches"]
+        if st.pipeline and micro is None:
+            # accumulate_steps defaults to 1 in the strategy bag; only an
+            # explicit >1 value is a microbatch count (1 would deadlock the
+            # pipeline — HybridParallelTrainStep's 2*pp default is safe)
+            acc = st.pipeline_configs.get("accumulate_steps") or 0
+            micro = acc if acc > 1 else None
+        kwargs.setdefault("n_microbatches", micro)
+        return HybridParallelTrainStep(cfg, dp=dp, pp=pp, tp=tp, **kwargs)
+
 
 def _sharding_info_from_strategy(strategy: DistributedStrategy) -> dict:
     info = {"mode": "dp"}
     if strategy.tensor_parallel:
         info["tp"] = strategy.tensor_parallel_configs[
             "tensor_parallel_degree"]
+        info["tp_rules"] = list(
+            strategy.tensor_parallel_configs.get("sharding_rules") or [])
     if strategy.pipeline:
         info["pp"] = strategy.pipeline_configs
     if strategy.sequence_parallel:

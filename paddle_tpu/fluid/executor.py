@@ -200,15 +200,52 @@ class Executor:
     def _mesh_for(self, program):
         """Mesh when the program is marked data-parallel. Grad allreduce is
         implicit: batch-sharded inputs make XLA insert the psum in the
-        sharded backward (replaces details/all_reduce_op_handle.cc)."""
+        sharded backward (replaces details/all_reduce_op_handle.cc).
+        When `strategy.tensor_parallel` set a tp degree, the mesh gains a
+        "tp" axis and persistables matching the strategy's sharding_rules
+        are partitioned over it (GSPMD tensor parallelism — fresh design,
+        absent in reference per SURVEY §2.9)."""
         info = getattr(program, "_sharding_info", None)
         if not info:
             return None
         import jax
         if len(jax.devices()) <= 1:
             return None
+        tp = int(info.get("tp") or 1)
+        if tp > 1:
+            from ..distributed.mesh import make_mesh
+            return make_mesh({"dp": -1, "tp": tp})
         from ..distributed.mesh import default_mesh
         return default_mesh()
+
+    @staticmethod
+    def _param_sharding(name, mesh, info, shape=None):
+        """Resolve a persistable's NamedSharding from the strategy's
+        tensor-parallel rules; default replicated. A matching rule is
+        applied only where it fits the value: optimizer accumulators
+        inherit their param's name prefix (fc_0.w_0_beta1_pow_acc_0), so a
+        spec with more dims than the value is ignored (scalar beta-pows
+        stay replicated, same-shaped moments pick up the param's sharding),
+        and spec axes that don't divide the dim are dropped."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if info and info.get("tp_rules"):
+            from ..parallel.sharding import ShardingRules
+            rules = ShardingRules(
+                [(pat, P(*spec)) for pat, spec in info["tp_rules"]])
+            spec = rules.spec(name, mesh)
+            if shape is not None:
+                if len(spec) > len(shape):
+                    spec = P()
+                else:
+                    def fits(i, entry):
+                        axes = entry if isinstance(entry, (tuple, list)) \
+                            else (entry,)
+                        size = int(np.prod([mesh.shape[a] for a in axes]))
+                        return shape[i] % size == 0
+                    spec = P(*(e if e is None or fits(i, e) else None
+                               for i, e in enumerate(spec)))
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, P())
 
     @staticmethod
     def _val_sharding(val, mesh):
@@ -230,6 +267,8 @@ class Executor:
         sig = (
             skey,
             None if mesh is None else tuple(mesh.shape.items()),
+            repr((getattr(program, "_sharding_info", None) or {})
+                 .get("tp_rules")),
             tuple(ro_names), tuple(upd_names), tuple(upd_in_names),
             tuple(fetch_names),
             tuple((n, v.shape, str(jnp.result_type(v)))
@@ -262,20 +301,29 @@ class Executor:
             if mesh is None:
                 fn = jax.jit(step, donate_argnums=(0,))
             else:
-                # params/state replicated; fetches+updates replicated; the
-                # batch stays sharded inside, grads psum automatically
+                # params/state replicated unless a tensor-parallel rule
+                # matches; fetches replicated; the batch stays sharded
+                # inside, grads psum automatically
                 from jax.sharding import NamedSharding, PartitionSpec as P
+                info = getattr(program, "_sharding_info", None)
                 repl = NamedSharding(mesh, P())
+                shapes = {n: getattr(v, "shape", None)
+                          for n, v in list(zip(upd_in_names, upd_in_vals))
+                          + list(zip(ro_names, ro_vals))}
+                psh = {n: self._param_sharding(n, mesh, info,
+                                               shapes.get(n))
+                       for n in set(upd_in_names) | set(ro_names)
+                       | set(upd_names)}
                 fn = jax.jit(
                     step, donate_argnums=(0,),
                     in_shardings=(
-                        tuple(repl for _ in upd_in_names),
-                        tuple(repl for _ in ro_names),
+                        tuple(psh[n] for n in upd_in_names),
+                        tuple(psh[n] for n in ro_names),
                         tuple(self._val_sharding(v, mesh)
                               for v in feed_vals),
                         None),
                     out_shardings=(tuple(repl for _ in fetch_names),
-                                   tuple(repl for _ in upd_names)))
+                                   tuple(psh[n] for n in upd_names)))
         if len(self._cache) >= core.get_flags(
                 "FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]:
             self._cache.clear()

@@ -1,0 +1,264 @@
+"""GPT-style causal decoder — BASELINE config 5 flagship (GPT-3 1.3B).
+
+Reference parity target: the PaddleNLP GPT built on the reference
+transformer stack (python/paddle/nn/layer/transformer.py) and trained with
+PipelineOptimizer (/root/reference/python/paddle/fluid/optimizer.py:3666).
+Here the model has a **functional core**: params are a pytree, the forward
+is a pure jax function, and one implementation serves every execution mode —
+
+  * single device / dygraph (`GPTForCausalLM` Layer wraps the core),
+  * dp x tp via GSPMD PartitionSpec rules (`gpt_sharding_rules`),
+  * pipeline parallel via stacked per-stage params
+    (paddle_tpu.parallel.pipeline + hybrid.HybridParallelTrainStep).
+
+Blocks are pre-LN transformer decoders; block params are stacked [L, ...]
+and scanned with lax.scan (compile time stays O(1) in depth — the
+TPU answer to the reference's per-op graph growing with depth).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["GPTConfig", "init_gpt_params", "gpt_param_specs", "gpt_forward",
+           "gpt_loss", "gpt_block_fn", "GPTForCausalLM"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_position_embeddings: int = 1024
+    intermediate_size: int | None = None  # default 4*hidden
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    dropout: float = 0.0
+    amp_dtype: str | None = None  # "bfloat16" casts block compute
+    attn_impl: str = "xla"  # "xla" | "flash" (Pallas kernel)
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.hidden_size % self.num_heads == 0
+
+    @classmethod
+    def tiny(cls, **kw):
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 4)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("max_position_embeddings", 128)
+        return cls(**kw)
+
+    @classmethod
+    def gpt2_small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def gpt3_1p3b(cls, **kw):
+        """GPT-3 XL: 24 layers, d_model 2048, 16 heads of 128."""
+        kw.setdefault("hidden_size", 2048)
+        kw.setdefault("num_layers", 24)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("max_position_embeddings", 2048)
+        return cls(**kw)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_gpt_params(cfg: GPTConfig, seed: int = 0) -> dict:
+    """Pytree: embeddings + stacked blocks [L, ...] + final LN. LM head is
+    tied to wte (Megatron/GPT-2 convention)."""
+    rng = np.random.RandomState(seed)
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    s = cfg.initializer_range
+
+    def norm(*shape):
+        return rng.normal(0.0, s, shape).astype(np.float32)
+
+    blocks = {
+        "ln1_s": np.ones((L, D), np.float32),
+        "ln1_b": np.zeros((L, D), np.float32),
+        "wq": norm(L, D, D), "bq": np.zeros((L, D), np.float32),
+        "wk": norm(L, D, D), "bk": np.zeros((L, D), np.float32),
+        "wv": norm(L, D, D), "bv": np.zeros((L, D), np.float32),
+        # output/down projections scaled 1/sqrt(2L) (GPT-2 residual scaling)
+        "wo": norm(L, D, D) / math.sqrt(2 * L),
+        "bo": np.zeros((L, D), np.float32),
+        "ln2_s": np.ones((L, D), np.float32),
+        "ln2_b": np.zeros((L, D), np.float32),
+        "w_up": norm(L, D, F), "b_up": np.zeros((L, F), np.float32),
+        "w_down": norm(L, F, D) / math.sqrt(2 * L),
+        "b_down": np.zeros((L, D), np.float32),
+    }
+    return {
+        "wte": norm(cfg.vocab_size, D),
+        "wpe": norm(cfg.max_position_embeddings, D),
+        "blocks": blocks,
+        "lnf_s": np.ones((D,), np.float32),
+        "lnf_b": np.zeros((D,), np.float32),
+    }
+
+
+def gpt_param_specs(pp_stacked: bool = False) -> dict:
+    """PartitionSpec pytree (megatron-style tp; blocks get a leading "pp"
+    dim when stacked per-stage). Axes not present in the mesh are dropped by
+    ShardingRules._restrict-like resolution in hybrid.py."""
+    from jax.sharding import PartitionSpec as P
+
+    def blk(*entries):
+        return P(*(("pp",) if pp_stacked else ()), None, *entries)
+
+    blocks = {
+        "ln1_s": blk(None), "ln1_b": blk(None),
+        "wq": blk(None, "tp"), "bq": blk("tp"),
+        "wk": blk(None, "tp"), "bk": blk("tp"),
+        "wv": blk(None, "tp"), "bv": blk("tp"),
+        "wo": blk("tp", None), "bo": blk(None),
+        "ln2_s": blk(None), "ln2_b": blk(None),
+        "w_up": blk(None, "tp"), "b_up": blk("tp"),
+        "w_down": blk("tp", None), "b_down": blk(None),
+    }
+    return {
+        "wte": P("tp", None),
+        "wpe": P(),
+        "blocks": blocks,
+        "lnf_s": P(),
+        "lnf_b": P(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _ln(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, -1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), -1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _causal_attention(q, k, v, n_heads, impl="xla"):
+    """q,k,v: [B, T, D] -> [B, T, D]; softmax in fp32."""
+    B, T, D = q.shape
+    hd = D // n_heads
+    q = q.reshape(B, T, n_heads, hd)
+    k = k.reshape(B, T, n_heads, hd)
+    v = v.reshape(B, T, n_heads, hd)
+    if impl == "flash":
+        from ..ops.pallas_attention import flash_attention
+        o = flash_attention(q, k, v, causal=True)
+        return o.reshape(B, T, D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return o.reshape(B, T, D)
+
+
+def gpt_block_fn(p: dict, x, cfg: GPTConfig):
+    """One pre-LN decoder block; p leaves are unstacked ([D,...])."""
+    cdt = jnp.dtype(cfg.amp_dtype) if cfg.amp_dtype else x.dtype
+    c = lambda a: a.astype(cdt)
+    h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
+    q = c(h) @ c(p["wq"]) + c(p["bq"])
+    k = c(h) @ c(p["wk"]) + c(p["bk"])
+    v = c(h) @ c(p["wv"]) + c(p["bv"])
+    a = _causal_attention(q, k, v, cfg.num_heads, cfg.attn_impl)
+    x = x + (a @ c(p["wo"]) + c(p["bo"])).astype(x.dtype)
+    h = _ln(x, p["ln2_s"], p["ln2_b"], cfg.layer_norm_eps)
+    u = jax.nn.gelu(c(h) @ c(p["w_up"]) + c(p["b_up"]), approximate=True)
+    x = x + (u @ c(p["w_down"]) + c(p["b_down"])).astype(x.dtype)
+    return x
+
+
+def _embed(params, ids, cfg: GPTConfig):
+    T = ids.shape[-1]
+    x = jnp.take(params["wte"], ids, axis=0) + params["wpe"][:T]
+    if cfg.amp_dtype:
+        x = x.astype(jnp.dtype(cfg.amp_dtype))
+    return x
+
+
+def _head(params, x, cfg: GPTConfig):
+    x = _ln(x, params["lnf_s"], params["lnf_b"], cfg.layer_norm_eps)
+    # logits in fp32 for a stable softmax-xent
+    return x.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
+
+
+def gpt_forward(params: dict, ids, cfg: GPTConfig):
+    """ids [B, T] int -> logits [B, T, V]. Blocks run under lax.scan over
+    the stacked [L, ...] leaves."""
+    x = _embed(params, ids, cfg)
+
+    def body(h, blk):
+        return gpt_block_fn(blk, h, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return _head(params, x, cfg)
+
+
+def gpt_loss(params: dict, ids, cfg: GPTConfig, logits=None):
+    """Mean next-token cross entropy; predicts ids[:,1:] from ids[:,:-1]."""
+    if logits is None:
+        logits = gpt_forward(params, ids, cfg)
+    logits = logits[:, :-1]
+    labels = ids[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# dygraph wrapper (API parity with the Layer zoo)
+# ---------------------------------------------------------------------------
+
+class GPTForCausalLM:
+    """Thin Layer-style wrapper binding framework Parameters onto the
+    functional core (trainable with jit.functional.TrainStep pattern)."""
+
+    def __new__(cls, cfg: GPTConfig, seed: int = 0):
+        from .. import nn
+        from ..fluid.dygraph.varbase import Tensor
+
+        class _GPT(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.cfg = cfg
+                flat, self._treedef = jax.tree_util.tree_flatten(
+                    init_gpt_params(cfg, seed))
+                self._params = []
+                for i, leaf in enumerate(flat):
+                    p = Tensor(jnp.asarray(leaf), stop_gradient=False,
+                               persistable=True)
+                    self.add_parameter(f"p_{i}", p)
+                    self._params.append(p)
+
+            def param_tree(self):
+                return jax.tree_util.tree_unflatten(
+                    self._treedef, [p._value for p in self._params])
+
+            def forward(self, ids):
+                ids_v = ids._value if isinstance(ids, Tensor) else ids
+                return Tensor(gpt_forward(self.param_tree(), ids_v,
+                                          self.cfg), stop_gradient=False)
+
+            def loss(self, ids):
+                ids_v = ids._value if isinstance(ids, Tensor) else ids
+                return Tensor(gpt_loss(self.param_tree(), ids_v, self.cfg),
+                              stop_gradient=False)
+
+        return _GPT()

@@ -1,0 +1,21 @@
+"""Hybrid-parallel engine: dp x tp x pp (x sp) over a named device mesh.
+
+TPU-native replacement for the reference's parallelism mechanisms:
+
+- tensor parallel  -> GSPMD PartitionSpec rules on params (sharding.py);
+  XLA inserts the all-reduces Megatron-style col/row-parallel layers would
+  (absent in the reference, supplied fresh per SURVEY SS2.9).
+- pipeline parallel -> microbatch GPipe schedule as lax.scan + ppermute
+  inside a partial-manual shard_map over the "pp" mesh axis (pipeline.py);
+  replaces reference PipelineOptimizer program-splitting + SectionWorker
+  threads (/root/reference/python/paddle/fluid/optimizer.py:3666,
+  /root/reference/paddle/fluid/framework/device_worker.h:415).
+- data parallel    -> batch-dim sharding; grad psum is implicit in XLA's
+  sharded autodiff.
+"""
+from . import pipeline, sharding
+from .hybrid import HybridParallelTrainStep
+from .embedding import ShardedEmbedding, sharded_embedding_lookup
+
+__all__ = ["pipeline", "sharding", "HybridParallelTrainStep",
+           "ShardedEmbedding", "sharded_embedding_lookup"]

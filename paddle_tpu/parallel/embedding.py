@@ -1,0 +1,13 @@
+"""Sharded embedding — placeholder, filled in with the sparse tier."""
+from __future__ import annotations
+
+__all__ = ["ShardedEmbedding", "sharded_embedding_lookup"]
+
+
+def sharded_embedding_lookup(*a, **k):  # pragma: no cover
+    raise NotImplementedError
+
+
+class ShardedEmbedding:  # pragma: no cover
+    def __init__(self, *a, **k):
+        raise NotImplementedError

@@ -1,0 +1,78 @@
+"""Name-pattern -> PartitionSpec sharding rules (tensor parallelism).
+
+Tensor parallel is absent from the reference (SURVEY SS2.9) and designed
+fresh here the TPU way: instead of col/row-parallel layer classes that
+hand-insert collectives (Megatron style), parameters are annotated with
+`PartitionSpec`s and GSPMD partitions the matmuls and inserts the
+all-reduces.  A rule table maps parameter-name regexes to specs, so the same
+model code runs unsharded, dp-only, or dp x tp by swapping the rule set.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "shard_tree", "spec_for"]
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table; first match wins.
+
+    Axis names appearing in a spec but absent from the mesh are dropped at
+    resolution time, so one rule set serves tp=1 and tp>1 meshes.
+    """
+
+    def __init__(self, rules: Sequence[tuple[str, P]] | None = None,
+                 default: P = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def spec(self, name: str, mesh: Mesh | None = None,
+             ndim: int | None = None) -> P:
+        spec = self.default
+        for pat, s in self.rules:
+            if pat.search(name):
+                spec = s
+                break
+        if mesh is not None:
+            spec = _restrict(spec, mesh)
+        if ndim is not None and len(spec) > ndim:
+            raise ValueError(
+                f"spec {spec} for {name!r} has more dims than the {ndim}-d "
+                f"param")
+        return spec
+
+    def sharding(self, name: str, mesh: Mesh, ndim: int | None = None):
+        return NamedSharding(mesh, self.spec(name, mesh, ndim))
+
+
+def _restrict(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the mesh doesn't have (or that have size 1)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry
+                         if a in mesh.shape and mesh.shape[a] > 1)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.shape and
+                       mesh.shape[entry] > 1 else None)
+    return P(*out)
+
+
+def spec_for(tree_of_names: Any, rules: ShardingRules, mesh: Mesh):
+    """Map a pytree of param names to a pytree of NamedShardings."""
+    return jax.tree_util.tree_map(
+        lambda n: rules.sharding(n, mesh), tree_of_names)
+
+
+def shard_tree(params: Any, names: Any, rules: ShardingRules, mesh: Mesh):
+    """device_put every leaf with its resolved rule sharding."""
+    return jax.tree_util.tree_map(
+        lambda v, n: jax.device_put(v, rules.sharding(n, mesh, v.ndim)),
+        params, names)
