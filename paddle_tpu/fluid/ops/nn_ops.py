@@ -157,7 +157,33 @@ def _pool2d(ctx, ins, attrs):
             r = v.reshape(v.shape[0], v.shape[1], oh, h // oh, ow, w // ow)
             fn = jnp.max if ptype == "max" else jnp.mean
             return out(fn(r, axis=(3, 5)))
-        raise NotImplementedError("adaptive pool with non-divisible size")
+        # non-divisible bins (torch semantics: bin i spans
+        # [floor(i*n/o), ceil((i+1)*n/o)) ) via static per-axis bin
+        # matrices — one einsum per axis, fully differentiable
+        import numpy as _np
+
+        def bins(n, o):
+            m = _np.zeros((o, n), _np.float32)
+            for i in range(o):
+                lo, hi = (i * n) // o, -((-(i + 1) * n) // o)
+                m[i, lo:hi] = 1.0
+            return m
+
+        bh, bw = bins(h, oh), bins(w, ow)
+        if ptype == "max":
+            big = jnp.finfo(v.dtype).min if jnp.issubdtype(
+                v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+            mh = jnp.asarray(bh) > 0  # [oh, H]
+            mw = jnp.asarray(bw) > 0  # [ow, W]
+            r = jnp.max(jnp.where(mh[None, None, :, :, None],
+                                  v[:, :, None, :, :], big), axis=3)
+            r = jnp.max(jnp.where(mw[None, None, None, :, :],
+                                  r[:, :, :, None, :], big), axis=4)
+            return out(r)
+        wh = jnp.asarray(bh / bh.sum(1, keepdims=True))
+        ww = jnp.asarray(bw / bw.sum(1, keepdims=True))
+        r = jnp.einsum("nchw,oh,pw->ncop", v, wh, ww)
+        return out(r.astype(v.dtype))
     k = list(attrs["ksize"]); s = list(attrs["strides"])
     p = list(attrs["paddings"])
     dims = (1, 1, k[0], k[1])

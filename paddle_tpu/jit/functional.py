@@ -61,7 +61,10 @@ class TrainStep:
         opt_type = {"adam": "adam", "adamw": "adamw", "sgd": "sgd",
                     "momentum": "momentum", "lamb": "lamb"}[optimizer]
         opdef = registry.require(opt_type)
+        # registered per-op defaults (e.g. momentum's mu) under the shared
+        # adam-style hypers
         hyper = dict(self._hyper)
+        opdef.fill_default_attrs(hyper)
         clip = self._clip
 
         tracer = framework._dygraph_tracer()

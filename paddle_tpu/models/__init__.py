@@ -2,18 +2,29 @@
 flagships per BASELINE.json configs)."""
 from .lenet import LeNet, build_lenet_program
 
-__all__ = ["LeNet", "build_lenet_program"]
+__all__ = ["LeNet", "build_lenet_program", "ResNet", "resnet18", "resnet34",
+           "resnet50", "resnet101", "resnet152", "VGG", "vgg11", "vgg13",
+           "vgg16", "vgg19", "MobileNetV1", "MobileNetV2", "mobilenet_v1",
+           "mobilenet_v2", "BertModel", "BertForPretraining", "BertConfig",
+           "GPTConfig", "GPTForCausalLM"]
+
+_LAZY = {
+    "resnet": ("ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+               "resnet34", "resnet50", "resnet101", "resnet152"),
+    "vgg": ("VGG", "vgg11", "vgg13", "vgg16", "vgg19"),
+    "mobilenet": ("MobileNetV1", "MobileNetV2", "mobilenet_v1",
+                  "mobilenet_v2"),
+    "bert": ("BertModel", "BertForPretraining", "BertConfig"),
+    "gpt": ("GPTConfig", "GPTForCausalLM", "init_gpt_params", "gpt_forward",
+            "gpt_loss"),
+}
 
 
 def __getattr__(name):
     # lazy heavy families
-    if name in ("ResNet", "resnet50", "resnet18"):
-        from . import resnet
-        return getattr(resnet, name)
-    if name in ("BertModel", "BertForPretraining", "BertConfig"):
-        from . import bert
-        return getattr(bert, name)
-    if name in ("GPTModel", "GPTConfig"):
-        from . import gpt
-        return getattr(gpt, name)
+    for mod, names in _LAZY.items():
+        if name in names:
+            import importlib
+            m = importlib.import_module(f".{mod}", __name__)
+            return getattr(m, name)
     raise AttributeError(name)

@@ -226,39 +226,36 @@ def gpt_loss(params: dict, ids, cfg: GPTConfig, logits=None):
 # dygraph wrapper (API parity with the Layer zoo)
 # ---------------------------------------------------------------------------
 
-class GPTForCausalLM:
-    """Thin Layer-style wrapper binding framework Parameters onto the
-    functional core (trainable with jit.functional.TrainStep pattern)."""
+from ..fluid.dygraph.layers import Layer as _Layer
+from ..fluid.dygraph.varbase import Tensor as _Tensor
 
-    def __new__(cls, cfg: GPTConfig, seed: int = 0):
-        from .. import nn
-        from ..fluid.dygraph.varbase import Tensor
 
-        class _GPT(nn.Layer):
-            def __init__(self):
-                super().__init__()
-                self.cfg = cfg
-                flat, self._treedef = jax.tree_util.tree_flatten(
-                    init_gpt_params(cfg, seed))
-                self._params = []
-                for i, leaf in enumerate(flat):
-                    p = Tensor(jnp.asarray(leaf), stop_gradient=False,
-                               persistable=True)
-                    self.add_parameter(f"p_{i}", p)
-                    self._params.append(p)
+class GPTForCausalLM(_Layer):
+    """Layer wrapper binding framework Parameters onto the functional core
+    (trainable with the jit.functional.TrainStep pattern)."""
 
-            def param_tree(self):
-                return jax.tree_util.tree_unflatten(
-                    self._treedef, [p._value for p in self._params])
+    def __init__(self, cfg: GPTConfig, seed: int = 0):
+        super().__init__()
+        self.cfg = cfg
+        flat, self._treedef = jax.tree_util.tree_flatten(
+            init_gpt_params(cfg, seed))
+        self._param_list = []
+        for i, leaf in enumerate(flat):
+            p = _Tensor(jnp.asarray(leaf), stop_gradient=False,
+                        persistable=True)
+            self.add_parameter(f"p_{i}", p)
+            self._param_list.append(p)
 
-            def forward(self, ids):
-                ids_v = ids._value if isinstance(ids, Tensor) else ids
-                return Tensor(gpt_forward(self.param_tree(), ids_v,
-                                          self.cfg), stop_gradient=False)
+    def param_tree(self):
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [p._value for p in self._param_list])
 
-            def loss(self, ids):
-                ids_v = ids._value if isinstance(ids, Tensor) else ids
-                return Tensor(gpt_loss(self.param_tree(), ids_v, self.cfg),
-                              stop_gradient=False)
+    def forward(self, ids):
+        ids_v = ids._value if isinstance(ids, _Tensor) else ids
+        return _Tensor(gpt_forward(self.param_tree(), ids_v, self.cfg),
+                       stop_gradient=False)
 
-        return _GPT()
+    def loss(self, ids):
+        ids_v = ids._value if isinstance(ids, _Tensor) else ids
+        return _Tensor(gpt_loss(self.param_tree(), ids_v, self.cfg),
+                       stop_gradient=False)

@@ -1,0 +1,137 @@
+"""ResNet family — BASELINE config 2 flagship (ResNet-50 ImageNet).
+
+Capability parity with the reference model zoo
+(/root/reference/python/paddle/vision/models/resnet.py:1) on paddle_tpu.nn
+layers.  TPU notes: convs lower to XLA conv_general_dilated on the MXU;
+BatchNorm runs per-shard under data-parallel jit, which with batch-sharded
+inputs gives the same semantics the reference's sync_batch_norm_op.cu
+achieves with an explicit ncclAllReduce (SPMD psum is inserted by XLA for
+the grads; running stats stay per-replica as in the reference default BN).
+Train via jit.functional.make_train_step (whole step = one XLA program).
+"""
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152", "BasicBlock", "BottleneckBlock"]
+
+
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True):
+    pad = (k - 1) // 2
+    layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_c, c, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = _conv_bn(in_c, c, 3, stride)
+        self.conv2 = _conv_bn(c, c, 3, act=False)
+        self.downsample = downsample
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.conv2(self.conv1(x))
+        return self.relu(out + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_c, c, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = _conv_bn(in_c, c, 1)
+        self.conv2 = _conv_bn(c, c, 3, stride)
+        self.conv3 = _conv_bn(c, c * 4, 1, act=False)
+        self.downsample = downsample
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = self.conv3(self.conv2(self.conv1(x)))
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    """depth in {18, 34, 50, 101, 152}; `with_pool`/`num_classes` follow the
+    reference constructor surface."""
+
+    _SPECS = {18: (BasicBlock, [2, 2, 2, 2]),
+              34: (BasicBlock, [3, 4, 6, 3]),
+              50: (BottleneckBlock, [3, 4, 6, 3]),
+              101: (BottleneckBlock, [3, 4, 23, 3]),
+              152: (BottleneckBlock, [3, 8, 36, 3])}
+
+    def __init__(self, block=None, depth=50, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if block is None:
+            block, counts = self._SPECS[depth]
+        else:
+            _, counts = self._SPECS[depth]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(64), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1))
+        stages = []
+        in_c = 64
+        for i, (c, n) in enumerate(zip([64, 128, 256, 512], counts)):
+            blocks = []
+            for j in range(n):
+                stride = 2 if i > 0 and j == 0 else 1
+                down = None
+                if stride != 1 or in_c != c * block.expansion:
+                    down = _conv_bn(in_c, c * block.expansion, 1, stride,
+                                    act=False)
+                blocks.append(block(in_c, c, stride, down))
+                in_c = c * block.expansion
+            stages.append(nn.Sequential(*blocks))
+        self.layer1, self.layer2, self.layer3, self.layer4 = stages
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(512 * block.expansion, num_classes)
+        self.flatten = nn.Flatten()
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.flatten(x))
+        return x
+
+
+def _make(depth, **kwargs):
+    return ResNet(depth=depth, **kwargs)
+
+
+def resnet18(pretrained=False, **kwargs):
+    return _make(18, **kwargs)
+
+
+def resnet34(pretrained=False, **kwargs):
+    return _make(34, **kwargs)
+
+
+def resnet50(pretrained=False, **kwargs):
+    return _make(50, **kwargs)
+
+
+def resnet101(pretrained=False, **kwargs):
+    return _make(101, **kwargs)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return _make(152, **kwargs)

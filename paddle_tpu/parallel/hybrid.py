@@ -75,7 +75,6 @@ class HybridParallelTrainStep:
         self._hyper = dict(beta1=beta1, beta2=beta2, epsilon=epsilon)
         self._wd = weight_decay
         self._clip = grad_clip_norm
-        self._step_count = 0
 
         params = jax.tree_util.tree_map(jnp.asarray,
                                         G.init_gpt_params(cfg, seed))
@@ -138,7 +137,8 @@ class HybridParallelTrainStep:
     def _build(self, mesh):
         from ..fluid import registry
         opdef = registry.require("adamw")
-        hyper = self._hyper
+        hyper = dict(self._hyper)
+        opdef.fill_default_attrs(hyper)
         wd, clip = self._wd, self._clip
         names = self._names
 
@@ -194,7 +194,6 @@ class HybridParallelTrainStep:
     def __call__(self, ids):
         ids = jax.device_put(jnp.asarray(ids), self._batch_sharding)
         lr = self._lr() if callable(self._lr) else float(self._lr)
-        self._step_count += 1
         loss, self.params, self.opt_state, self._pows = self._jit_step(
             self.params, self.opt_state, self._pows, ids,
             np.float32(lr))

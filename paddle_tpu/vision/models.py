@@ -1,9 +1,14 @@
-"""paddle.vision.models — re-export of the model zoo."""
+"""paddle.vision.models — the vision model zoo (reference
+python/paddle/vision/models/__init__.py surface: LeNet, ResNet 18/34/50/
+101/152, VGG 11/13/16/19, MobileNet v1/v2)."""
 from ..models import LeNet
+from ..models.resnet import (ResNet, BasicBlock, BottleneckBlock, resnet18,
+                             resnet34, resnet50, resnet101, resnet152)
+from ..models.vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from ..models.mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,
+                                mobilenet_v2)
 
-__all__ = ["LeNet"]
-
-
-def __getattr__(name):
-    from .. import models as _m
-    return getattr(_m, name)
+__all__ = ["LeNet", "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+           "resnet34", "resnet50", "resnet101", "resnet152", "VGG", "vgg11",
+           "vgg13", "vgg16", "vgg19", "MobileNetV1", "MobileNetV2",
+           "mobilenet_v1", "mobilenet_v2"]
