@@ -14,8 +14,8 @@ The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
 
 Configs (BENCH_CONFIG=...): bert_base (default, seq 128; also records the
 secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
-| bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | flash_attn
-(pallas-vs-jnp microbench) | allreduce.
+| bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | widedeep |
+flash_attn (pallas-vs-jnp microbench) | allreduce.
 """
 from __future__ import annotations
 
@@ -291,6 +291,31 @@ def bench_resnet50(batch=64, steps=10, warmup=3):
             "mfu": round(mfu, 4), "batch": batch, "device_kind": str(kind)}
 
 
+def bench_widedeep(batch=4096, steps=20, warmup=3):
+    """wide&deep CTR train step (BASELINE config 4): mesh-sharded embedding
+    tier; single-chip dp=mp=1, scales via WideDeepTrainStep's mesh."""
+    from paddle_tpu.models.wide_deep import WideDeepConfig, WideDeepTrainStep
+
+    cfg = WideDeepConfig()  # 1M hashed vocab, 26 slots, 13 dense
+    step = WideDeepTrainStep(cfg, dp=1, mp=1)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, cfg.num_slots))
+    dense = rng.randn(batch, cfg.dense_dim).astype(np.float32)
+    label = (ids[:, 0] % 2).astype(np.float32)[:, None]
+    for _ in range(warmup):
+        loss = step(ids, dense, label)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, dense, label)
+    _sync(loss)
+    dt = time.perf_counter() - t0
+    return {"metric": "widedeep_train_examples_per_sec",
+            "value": round(batch * steps / dt, 1), "unit": "examples/sec",
+            "batch": batch, "vocab": cfg.vocab_size,
+            "slots": cfg.num_slots}
+
+
 def bench_allreduce(mb=64, steps=30, warmup=5):
     """Achieved allreduce bandwidth over the device mesh (BASELINE config 2
     companion metric). Algorithmic bandwidth: 2·(n-1)/n · bytes / time."""
@@ -340,6 +365,8 @@ def main():
         rec = bench_gpt()
     elif which == "resnet50":
         rec = bench_resnet50()
+    elif which == "widedeep":
+        rec = bench_widedeep()
     else:
         # batch 32 is the measured sweet spot on v5e (24.1% MFU; batch 64
         # regresses to 18.6% — memory pressure)
@@ -354,6 +381,8 @@ def main():
                                         steps=6, warmup=2)),
                     ("gpt_350m", lambda: bench_gpt(steps=6, warmup=2)),
                     ("resnet50", lambda: bench_resnet50(steps=8, warmup=2)),
+                    ("widedeep", lambda: bench_widedeep(steps=10,
+                                                        warmup=2)),
                     ("flash_attn", bench_flash_attn),
             ]:
                 try:

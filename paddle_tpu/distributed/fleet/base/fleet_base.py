@@ -62,19 +62,27 @@ class Fleet:
     def barrier_worker(self):
         self._rm()._barrier()
 
+    def _runtime(self):
+        if getattr(self, "_ps_runtime", None) is None:
+            from ..runtime.parameter_server_runtime import \
+                ParameterServerRuntime
+            self._ps_runtime = ParameterServerRuntime(self._rm())
+        return self._ps_runtime
+
     def init_worker(self):
-        pass
+        """Connect the worker-side PSClient to all server endpoints."""
+        return self._runtime().init_worker()
 
     def init_server(self, *args, **kwargs):
-        from ..runtime.parameter_server_runtime import ParameterServerRuntime
-        self._ps_runtime = ParameterServerRuntime(self._rm())
-        self._ps_runtime.init_server(*args)
+        self._runtime().init_server(*args, **kwargs)
 
-    def run_server(self):
-        self._ps_runtime.run_server()
+    def run_server(self, block: bool = True):
+        """Serve this shard. Blocks like the reference's run_server unless
+        block=False (in-process tests)."""
+        return self._runtime().run_server(block=block)
 
     def stop_worker(self):
-        pass
+        self._runtime().stop_worker()
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
                              target_vars, main_program=None,

@@ -1,82 +1,315 @@
-"""Parameter-server runtime (reference distributed/fleet/runtime/parameter_server_runtime.py).
+"""Parameter-server runtime — host-resident sharded KV over TCP.
 
-TPU-native PS tier: a host-resident sharded KV store served over DCN for the
-sparse-embedding workload (PaddleRec configs). The dense path should instead
-use mesh-sharded embeddings + all_to_all (paddle_tpu.parallel.embedding).
-Round-1 scope: single-host in-process KV; the RPC transport lands with the
-C++ runtime batch.
+Reference chain this replaces: `listen_and_serv` event loop
+(operators/distributed_ops/listen_and_serv_op.cc:352), gRPC/BRPC transport
+(operators/distributed/grpc/), `large_scale_kv.h` in-memory sparse table,
+and the fleet runtime glue (distributed/fleet/runtime/
+parameter_server_runtime.py).  TPU stance (SURVEY §7): embedding tables
+that FIT in HBM should use the mesh-sharded design in
+paddle_tpu.parallel.embedding; this host tier serves the beyond-HBM
+PaddleRec configs, with key-hash sharding across servers and a
+pickle-over-TCP protocol (one request per pull/push batch — the
+Communicator's merge semantics come from batched numpy application).
 """
 from __future__ import annotations
 
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
 import numpy as np
 
-__all__ = ["ParameterServerRuntime", "LargeScaleKV"]
+__all__ = ["ParameterServerRuntime", "LargeScaleKV", "PSServer", "PSClient"]
 
 
 class LargeScaleKV:
-    """In-memory sharded sparse table (reference operators/distributed/large_scale_kv.h)."""
+    """In-memory sparse table, vectorized (reference large_scale_kv.h).
 
-    def __init__(self, dim: int, init_std: float = 0.01, shards: int = 8):
+    Rows live in one growing [cap, dim] array; an id->slot dict indexes it.
+    pull/push touch numpy once per batch (no per-row RNG or loops)."""
+
+    def __init__(self, dim: int, init_std: float = 0.01, seed: int = 0):
         self.dim = dim
         self.init_std = init_std
-        self.shards = [dict() for _ in range(shards)]
+        self._rng = np.random.RandomState(seed)
+        self._index: dict[int, int] = {}
+        self._data = np.empty((0, dim), np.float32)
+        self._lock = threading.Lock()
 
-    def _shard(self, key: int) -> dict:
-        return self.shards[key % len(self.shards)]
+    def _ensure(self, keys: np.ndarray) -> np.ndarray:
+        """Slots for keys, creating missing rows in one batched init."""
+        idx = self._index
+        missing = [k for k in keys.tolist() if k not in idx]
+        if missing:
+            start = len(idx)
+            fresh = self._rng.normal(
+                0, self.init_std,
+                (len(missing), self.dim)).astype(np.float32)
+            need = start + len(missing)
+            if need > len(self._data):
+                grow = np.empty((max(need, 2 * len(self._data) + 64),
+                                 self.dim), np.float32)
+                grow[:len(self._data)] = self._data
+                self._data = grow
+            self._data[start:start + len(missing)] = fresh
+            for i, k in enumerate(missing):
+                idx[k] = start + i
+        return np.fromiter((idx[k] for k in keys.tolist()), np.int64,
+                           len(keys))
 
     def pull(self, keys: np.ndarray) -> np.ndarray:
-        out = np.empty((len(keys), self.dim), dtype=np.float32)
-        for i, k in enumerate(keys.tolist()):
-            s = self._shard(k)
-            row = s.get(k)
-            if row is None:
-                row = np.random.normal(
-                    0, self.init_std, self.dim).astype(np.float32)
-                s[k] = row
-            out[i] = row
-        return out
+        with self._lock:
+            slots = self._ensure(np.asarray(keys).ravel())
+            return self._data[slots].copy()
 
     def push(self, keys: np.ndarray, grads: np.ndarray, lr: float = 1.0):
-        for k, g in zip(keys.tolist(), grads):
-            s = self._shard(k)
-            row = s.get(k)
-            if row is None:
-                row = np.random.normal(
-                    0, self.init_std, self.dim).astype(np.float32)
-            s[k] = row - lr * g
+        """SGD apply (reference async PS applies grads on arrival);
+        duplicate keys accumulate via np.add.at."""
+        with self._lock:
+            slots = self._ensure(np.asarray(keys).ravel())
+            np.add.at(self._data, slots,
+                      (-lr * np.asarray(grads)).astype(np.float32))
 
     def size(self) -> int:
-        return sum(len(s) for s in self.shards)
+        return len(self._index)
 
     def save(self, path: str):
-        import pickle
-        with open(path, "wb") as f:
-            pickle.dump(self.shards, f, protocol=4)
+        with self._lock, open(path, "wb") as f:
+            keys = np.fromiter(self._index, np.int64, len(self._index))
+            slots = np.fromiter(self._index.values(), np.int64,
+                                len(self._index))
+            pickle.dump({"dim": self.dim, "keys": keys,
+                         "rows": self._data[slots]}, f, protocol=4)
 
     def load(self, path: str):
-        import pickle
         with open(path, "rb") as f:
-            self.shards = pickle.load(f)
+            blob = pickle.load(f)
+        with self._lock:
+            self.dim = blob["dim"]
+            self._data = np.ascontiguousarray(blob["rows"])
+            self._index = {int(k): i for i, k in enumerate(blob["keys"])}
+
+
+# ---------------------------------------------------------------------------
+# transport: length-prefixed pickle over TCP
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    blob = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(blob)) + blob)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    n = struct.unpack("<Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class PSServer(socketserver.ThreadingTCPServer):
+    """One PS shard: serves pull/push/save/size for its tables (reference
+    listen_and_serv_op RunAsyncLoop — apply-on-arrival, no global
+    barrier). Port 0 binds an ephemeral port; `endpoint` reports it."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self.tables: dict[str, LargeScaleKV] = {}
+        self._tables_lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        req = _recv_msg(self.request)
+                        _send_msg(self.request, outer._dispatch(req))
+                except (ConnectionError, OSError):
+                    pass
+
+        super().__init__((host, int(port)), Handler)
+        self.endpoint = f"{host}:{self.server_address[1]}"
+
+    def table(self, name: str, dim: int) -> LargeScaleKV:
+        with self._tables_lock:
+            if name not in self.tables:
+                self.tables[name] = LargeScaleKV(dim)
+            return self.tables[name]
+
+    def _dispatch(self, req: dict):
+        op = req["op"]
+        if op == "pull":
+            return self.table(req["table"], req["dim"]).pull(req["keys"])
+        if op == "push":
+            self.table(req["table"], req["dim"]).push(
+                req["keys"], req["grads"], req.get("lr", 1.0))
+            return True
+        if op == "save":
+            tag = self.endpoint.replace(":", "_")
+            for name, t in self.tables.items():
+                t.save(f"{req['dirname']}/{name}.{tag}.kv")
+            return True
+        if op == "size":
+            t = self.tables.get(req["table"])
+            return 0 if t is None else t.size()
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def serve_in_thread(self) -> threading.Thread:
+        th = threading.Thread(target=self.serve_forever, daemon=True)
+        th.start()
+        return th
+
+
+class PSClient:
+    """Worker-side stub: key-hash routing across server shards (reference
+    ps_dispatcher hash dispatch + Communicator send path)."""
+
+    def __init__(self, endpoints: list[str]):
+        self.endpoints = list(endpoints)
+        self._socks: list[socket.socket | None] = [None] * len(endpoints)
+        self._locks = [threading.Lock() for _ in endpoints]
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i: int, req: dict):
+        with self._locks[i]:
+            s = self._sock(i)
+            _send_msg(s, req)
+            return _recv_msg(s)
+
+    def _route(self, keys: np.ndarray) -> np.ndarray:
+        return (keys.astype(np.int64) % len(self.endpoints)).astype(np.int64)
+
+    def _fanout(self, calls):
+        """Dispatch shard RPCs concurrently (reference Communicator sends
+        per-shard in parallel threads); sequential round-trips would make
+        latency N_shards x RTT."""
+        if len(calls) <= 1:
+            return [fn() for fn in calls]
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(calls)) as ex:
+            return list(ex.map(lambda fn: fn(), calls))
+
+    def pull(self, table: str, dim: int, keys) -> np.ndarray:
+        keys = np.asarray(keys, np.int64).ravel()
+        owner = self._route(keys)
+        out = np.empty((len(keys), dim), np.float32)
+        masks = [(i, owner == i) for i in range(len(self.endpoints))]
+        masks = [(i, m) for i, m in masks if m.any()]
+        res = self._fanout([
+            (lambda i=i, m=m: self._call(i, {"op": "pull", "table": table,
+                                             "dim": dim,
+                                             "keys": keys[m]}))
+            for i, m in masks])
+        for (i, m), r in zip(masks, res):
+            out[m] = r
+        return out
+
+    def push(self, table: str, dim: int, keys, grads, lr: float = 1.0):
+        keys = np.asarray(keys, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(len(keys), dim)
+        owner = self._route(keys)
+        masks = [(i, owner == i) for i in range(len(self.endpoints))]
+        self._fanout([
+            (lambda i=i, m=m: self._call(i, {"op": "push", "table": table,
+                                             "dim": dim, "keys": keys[m],
+                                             "grads": grads[m],
+                                             "lr": lr}))
+            for i, m in masks if m.any()])
+
+    def size(self, table: str) -> int:
+        return sum(self._call(i, {"op": "size", "table": table})
+                   for i in range(len(self.endpoints)))
+
+    def save(self, dirname: str):
+        for i in range(len(self.endpoints)):
+            self._call(i, {"op": "save", "dirname": dirname})
+
+    def close(self):
+        for s in self._socks:
+            if s is not None:
+                s.close()
+        self._socks = [None] * len(self.endpoints)
 
 
 class ParameterServerRuntime:
+    """fleet runtime: the server role owns a PSServer shard; the worker
+    role owns a PSClient over all server endpoints (reference
+    runtime/parameter_server_runtime.py lifecycle)."""
+
     def __init__(self, role_maker):
         self._role_maker = role_maker
-        self._tables: dict[str, LargeScaleKV] = {}
+        self.server: PSServer | None = None
+        self.client: PSClient | None = None
+        self._thread: threading.Thread | None = None
 
-    def init_server(self, *args):
-        pass
+    # -- server lifecycle ----------------------------------------------
+    def init_server(self, *args, **kwargs):
+        eps = self._role_maker.get_pserver_endpoints()
+        me = eps[self._role_maker.server_index()]
+        self.server = PSServer(me)
+        model_dir = args[0] if args else kwargs.get("dirname")
+        if model_dir:
+            import glob
+            import os
+            tag = self.server.endpoint.replace(":", "_")
+            for path in glob.glob(f"{model_dir}/*.{tag}.kv"):
+                name = os.path.basename(path).split(".")[0]
+                t = LargeScaleKV(1)
+                t.load(path)
+                self.server.tables[name] = t
 
-    def run_server(self):
-        pass
+    def run_server(self, block: bool = False):
+        if self.server is None:
+            self.init_server()
+        if block:
+            self.server.serve_forever()
+        else:
+            self._thread = self.server.serve_in_thread()
+        return self.server
 
+    def stop_server(self):
+        if self.server is not None:
+            self.server.shutdown()
+            self.server.server_close()
+
+    # -- worker lifecycle ----------------------------------------------
     def init_worker(self):
-        pass
+        self.client = PSClient(self._role_maker.get_pserver_endpoints())
+        return self.client
 
     def stop_worker(self):
-        pass
+        if self.client is not None:
+            self.client.close()
 
     def get_table(self, name: str, dim: int) -> LargeScaleKV:
-        if name not in self._tables:
-            self._tables[name] = LargeScaleKV(dim)
-        return self._tables[name]
+        """In-process access (single-process/local mode) — no socket."""
+        if self.server is not None:
+            return self.server.table(name, dim)
+        if not hasattr(self, "_local_tables"):
+            self._local_tables: dict[str, LargeScaleKV] = {}
+        if name not in self._local_tables:
+            self._local_tables[name] = LargeScaleKV(dim)
+        return self._local_tables[name]

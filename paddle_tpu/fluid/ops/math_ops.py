@@ -224,10 +224,42 @@ def _scale(ctx, ins, attrs):
 @register("sum", infer_shape=same_shape_as("X"))
 def _sum(ctx, ins, attrs):
     vals = [v for v in ins.get("X", []) if v is not None]
+    from ..selected_rows import SelectedRows
+    srs = [v for v in vals if isinstance(v, SelectedRows)]
+    if srs:
+        if len(srs) == len(vals):
+            # all-sparse fan-out: concatenation IS accumulation (consumers
+            # scatter-add; reference math/selected_rows_functor.cc add)
+            return out(SelectedRows(
+                jnp.concatenate([s.rows for s in srs]),
+                jnp.concatenate([s.values for s in srs]), srs[0].height))
+        vals = [v.to_dense() if isinstance(v, SelectedRows) else v
+                for v in vals]
     r = vals[0]
     for v in vals[1:]:
         r = r + v
     return out(r)
+
+
+@register("merge_selected_rows", grad=None)
+def _merge_selected_rows(ctx, ins, attrs):
+    """Reference operators/merge_selected_rows_op.cc: combine duplicate
+    rows. Under jit the row count is static, so tracing is identity
+    (consumers scatter-add, which already accumulates duplicates); on
+    concrete host values the real merge runs."""
+    sr = x(ins)
+    from ..selected_rows import SelectedRows
+    if isinstance(sr, SelectedRows) and \
+            not isinstance(sr.rows, jax.core.Tracer):
+        return out(sr.merged())
+    return out(sr)
+
+
+@register("get_tensor_from_selected_rows", grad=None)
+def _get_tensor_from_selected_rows(ctx, ins, attrs):
+    sr = x(ins)
+    from ..selected_rows import SelectedRows
+    return out(sr.to_dense() if isinstance(sr, SelectedRows) else sr)
 
 
 # ---------------------------------------------------------------------------

@@ -574,6 +574,34 @@ register("lookup_table",
          attrs={"padding_idx": -1, "is_sparse": False, "is_distributed": False})
 
 
+def _lookup_grad(ctx, ins, attrs, squeeze_last):
+    """W@GRAD: SelectedRows when is_sparse (reference
+    operators/lookup_table_v2_op.cc grad kernel emits SelectedRows), else
+    dense scatter-add."""
+    from ..selected_rows import SelectedRows
+    ids, w = x(ins, "Ids"), x(ins, "W")
+    og = x(ins, "Out@GRAD")
+    if squeeze_last and ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    rows = ids.astype(jnp.int32).reshape(-1)
+    vals = og.reshape(-1, og.shape[-1]).astype(jnp.float32)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        vals = jnp.where((rows == pad)[:, None], 0.0, vals)
+    if attrs.get("is_sparse"):
+        return {"W@GRAD": [SelectedRows(rows, vals, w.shape[0])]}
+    dense = jnp.zeros(w.shape, vals.dtype).at[rows].add(vals)
+    return {"W@GRAD": [dense.astype(w.dtype)]}
+
+
+register("lookup_table_v2_grad",
+         lambda ctx, ins, attrs: _lookup_grad(ctx, ins, attrs, False),
+         grad=None, no_grad_slots=("Ids", "W", "Out@GRAD"))
+register("lookup_table_grad",
+         lambda ctx, ins, attrs: _lookup_grad(ctx, ins, attrs, True),
+         grad=None, no_grad_slots=("Ids", "W", "Out@GRAD"))
+
+
 @register("one_hot_v2", grad=None, attrs={"depth": -1, "dtype": "float32",
                                           "allow_out_of_range": False})
 def _one_hot(ctx, ins, attrs):

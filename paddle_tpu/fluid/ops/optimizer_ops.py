@@ -20,10 +20,21 @@ def _lr(ins):
     return v.reshape(()) if v is not None and getattr(v, "ndim", 0) else v
 
 
+def _is_sr(g):
+    from ..selected_rows import SelectedRows
+    return isinstance(g, SelectedRows)
+
+
 @register("sgd", grad=None, no_grad_slots=("Param", "Grad", "LearningRate"))
 def _sgd(ctx, ins, attrs):
     p, g = x(ins, "Param"), x(ins, "Grad")
-    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+    lr = _lr(ins)
+    if _is_sr(g):
+        # sparse rows: touch only the looked-up rows (reference sgd_op.cc
+        # SelectedRows kernel); duplicate rows accumulate via scatter-add
+        return {"ParamOut": [p.at[g.rows].add(
+            (-lr * g.values).astype(p.dtype))]}
+    return {"ParamOut": [p - lr * g.astype(p.dtype)]}
 
 
 @register("momentum", grad=None, attrs={"mu": 0.9, "use_nesterov": False,
@@ -33,6 +44,21 @@ def _momentum(ctx, ins, attrs):
     p, g, v = x(ins, "Param"), x(ins, "Grad"), x(ins, "Velocity")
     lr = _lr(ins)
     mu = attrs["mu"]
+    if _is_sr(g):
+        # exact dense semantics (sparse grad is zero off-rows): decay the
+        # whole velocity, scatter-add the sparse grad
+        if attrs.get("regularization_method") == "l2_decay":
+            raise NotImplementedError(
+                "l2_decay with sparse momentum grads — densify the grad or "
+                "use weight decay on the dense path")
+        v_new = (mu * v).at[g.rows].add(g.values.astype(v.dtype))
+        if attrs.get("use_nesterov"):
+            # dense rule p - lr*(g + mu*v_new) with g zero off-rows
+            p_new = (p - lr * mu * v_new).at[g.rows].add(
+                (-lr * g.values).astype(p.dtype))
+        else:
+            p_new = p - lr * v_new
+        return {"ParamOut": [p_new], "VelocityOut": [v_new]}
     if attrs.get("regularization_method") == "l2_decay":
         g = g + attrs["regularization_coeff"] * p
     v_new = mu * v + g
@@ -51,6 +77,10 @@ def _adam(ctx, ins, attrs):
     m1, m2 = x(ins, "Moment1"), x(ins, "Moment2")
     b1p, b2p = x(ins, "Beta1Pow"), x(ins, "Beta2Pow")
     lr = _lr(ins)
+    if _is_sr(g):
+        if attrs.get("lazy_mode"):
+            return _adam_sparse_lazy(p, g, m1, m2, b1p, b2p, lr, attrs)
+        g = g.to_dense()  # exact adam semantics decay ALL moments
     b1 = x(ins, "Beta1Tensor")
     b2 = x(ins, "Beta2Tensor")
     b1 = attrs["beta1"] if b1 is None else b1.reshape(())
@@ -63,6 +93,37 @@ def _adam(ctx, ins, attrs):
     lr_t = lr * jnp.sqrt(1 - b2pn.reshape(())) / (1 - b1pn.reshape(()))
     p_new = p - lr_t * (m1n / (jnp.sqrt(m2n) + eps)).astype(p.dtype)
     return {"ParamOut": [p_new], "Moment1Out": [m1n], "Moment2Out": [m2n],
+            "Beta1PowOut": [b1pn], "Beta2PowOut": [b2pn]}
+
+
+def _adam_sparse_lazy(p, g, m1, m2, b1p, b2p, lr, attrs):
+    """Reference adam lazy_mode (operators/optimizers/adam_op.h SelectedRows
+    path): duplicate rows are merged first (scatter::MergeAdd), then
+    moments and param update touch only the grad's rows.
+
+    The merge keeps static shapes under jit: sort rows, segment-sum the
+    values, broadcast each segment's sum back to every duplicate (so all
+    duplicates write identical moment values), and apply the param step
+    once per segment via a first-occurrence mask."""
+    b1, b2, eps = attrs["beta1"], attrs["beta2"], attrs["epsilon"]
+    order = jnp.argsort(g.rows)
+    rows = g.rows[order]
+    vals = g.values.astype(jnp.float32)[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), rows[1:] != rows[:-1]])
+    seg = jnp.cumsum(first) - 1                      # segment index per pos
+    merged = jnp.zeros_like(vals).at[seg].add(vals)  # per-segment sums
+    gv = merged[seg]                                 # merged grad per pos
+    m1r, m2r = m1[rows], m2[rows]
+    m1n = b1 * m1r + (1 - b1) * gv
+    m2n = b2 * m2r + (1 - b2) * jnp.square(gv)
+    b1pn, b2pn = b1p * b1, b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2pn.reshape(())) / (1 - b1pn.reshape(()))
+    upd = (lr_t * m1n / (jnp.sqrt(m2n) + eps)).astype(p.dtype)
+    upd = jnp.where(first[:, None], upd, 0)          # one step per row
+    return {"ParamOut": [p.at[rows].add(-upd)],
+            "Moment1Out": [m1.at[rows].set(m1n)],
+            "Moment2Out": [m2.at[rows].set(m2n)],
             "Beta1PowOut": [b1pn], "Beta2PowOut": [b2pn]}
 
 

@@ -1,0 +1,61 @@
+"""SelectedRows — the sparse-rows gradient representation.
+
+Reference: framework/selected_rows.h (rows index + value tensor; embedding
+grads become SelectedRows so the optimizer touches only the looked-up rows,
+operators/lookup_table_v2_op.cc grad kernel).  TPU redesign: a pytree of two
+device arrays (rows [N] int32, values [N, D]) with a static `height`, so it
+flows through jit; duplicated row ids are legal — consumers use scatter-add
+(`at[rows].add`), which accumulates duplicates natively on XLA, so the
+reference's merge_selected_rows pass is only needed for host export.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "is_selected_rows"]
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self):
+        z = jnp.zeros(self.shape, self.values.dtype)
+        return z.at[self.rows].add(self.values)
+
+    def merged(self):
+        """Host-side duplicate-row merge (for export/inspection)."""
+        import numpy as np
+        rows = np.asarray(self.rows)
+        vals = np.asarray(self.values)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(out, inv, vals)
+        return SelectedRows(jnp.asarray(uniq), jnp.asarray(out), self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, dim={self.shape[1:]})")
+
+
+def is_selected_rows(v: Any) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRows,
+    lambda sr: ((sr.rows, sr.values), sr.height),
+    lambda height, kids: SelectedRows(kids[0], kids[1], height))

@@ -1,0 +1,177 @@
+"""wide&deep CTR model — BASELINE config 4 flagship (PaddleRec wide_deep).
+
+Reference counterpart: PaddleRec wide_deep on the PS runtime
+(distributed_lookup_table_op + large_scale_kv.h pull/push).  TPU redesign:
+the embedding tables are mesh-sharded device arrays
+(paddle_tpu.parallel.embedding.ShardedEmbedding) and the "pull" is a
+collective lookup; same functional-core pattern as models/gpt.py so one
+implementation serves single-chip and the dp x mp mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["WideDeepConfig", "init_widedeep_params", "widedeep_forward",
+           "widedeep_loss", "WideDeepTrainStep"]
+
+
+@dataclasses.dataclass
+class WideDeepConfig:
+    """Criteo-style: `num_slots` categorical slots hashed into one unified
+    vocab + `dense_dim` continuous features."""
+    vocab_size: int = 1024 * 1024
+    num_slots: int = 26
+    embed_dim: int = 16
+    dense_dim: int = 13
+    hidden: tuple = (400, 400, 400)
+    init_std: float = 0.01
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=4096, num_slots=8, embed_dim=8, dense_dim=4,
+                   hidden=(32, 16))
+
+
+def init_widedeep_params(cfg: WideDeepConfig, seed: int = 0) -> dict:
+    rng = np.random.RandomState(seed)
+    n = lambda *s: rng.normal(0, cfg.init_std, s).astype(np.float32)
+    widths = [cfg.num_slots * cfg.embed_dim + cfg.dense_dim, *cfg.hidden, 1]
+    mlp = []
+    for i, (a, b) in enumerate(zip(widths[:-1], widths[1:])):
+        mlp.append({"w": (rng.normal(0, np.sqrt(2.0 / a), (a, b))
+                          .astype(np.float32)),
+                    "b": np.zeros((b,), np.float32)})
+    return {
+        "embed": n(cfg.vocab_size, cfg.embed_dim),   # deep table
+        "wide": n(cfg.vocab_size, 1),                # wide (linear) table
+        "wide_dense": n(cfg.dense_dim, 1),
+        "bias": np.zeros((1,), np.float32),
+        "mlp": mlp,
+    }
+
+
+def widedeep_forward(params: dict, sparse_ids, dense, cfg: WideDeepConfig,
+                     lookup=None):
+    """sparse_ids [B, S] int, dense [B, F] -> logits [B, 1].
+
+    `lookup(table, ids) -> [B, S, dim]` defaults to a dense take; the
+    mesh trainer passes the sharded-collective lookup."""
+    take = lookup or (lambda t, i: jnp.take(t, i.astype(jnp.int32), axis=0))
+    emb = take(params["embed"], sparse_ids)          # [B, S, D]
+    wide_rows = take(params["wide"], sparse_ids)     # [B, S, 1]
+    B = sparse_ids.shape[0]
+    h = jnp.concatenate([emb.reshape(B, -1), dense], axis=-1)
+    for i, layer in enumerate(params["mlp"]):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params["mlp"]) - 1:
+            h = jax.nn.relu(h)
+    wide = jnp.sum(wide_rows, axis=1) + dense @ params["wide_dense"]
+    return h + wide + params["bias"]
+
+
+def widedeep_loss(params, sparse_ids, dense, label, cfg, lookup=None):
+    """Mean sigmoid BCE-with-logits."""
+    z = widedeep_forward(params, sparse_ids, dense, cfg, lookup)
+    lab = label.astype(jnp.float32).reshape(z.shape)
+    return jnp.mean(jnp.maximum(z, 0) - z * lab + jnp.log1p(
+        jnp.exp(-jnp.abs(z))))
+
+
+class WideDeepTrainStep:
+    """step(sparse_ids, dense, label) -> loss over a ("dp","mp") mesh:
+    batch sharded over dp, embedding tables row-sharded over mp with the
+    collective lookup, MLP replicated; Adam state sharded like its param."""
+
+    def __init__(self, cfg: WideDeepConfig, mesh=None, dp: int = 1,
+                 mp: int = 1, lr=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, seed: int = 0, devices=None):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        if mesh is None:
+            devs = np.array(devices if devices is not None
+                            else jax.devices())[:dp * mp]
+            mesh = Mesh(devs.reshape(dp, mp), ("dp", "mp"))
+        self.cfg, self.mesh = cfg, mesh
+        self.mp = mesh.shape.get("mp", 1)
+        self._lr = lr
+        self._hyper = dict(beta1=beta1, beta2=beta2, epsilon=epsilon)
+
+        params = jax.tree_util.tree_map(
+            jnp.asarray, init_widedeep_params(cfg, seed))
+        tbl = NamedSharding(mesh, P("mp", None))
+        repl = NamedSharding(mesh, P())
+        self._shardings = jax.tree_util.tree_map(lambda _: repl, params)
+        self._shardings["embed"] = tbl
+        self._shardings["wide"] = tbl
+        self.params = jax.tree_util.tree_map(jax.device_put, params,
+                                             self._shardings)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda v, sh: {"m1": jax.device_put(
+                               jnp.zeros(v.shape, jnp.float32), sh),
+                           "m2": jax.device_put(
+                               jnp.zeros(v.shape, jnp.float32), sh)},
+            self.params, self._shardings)
+        self._pows = (jax.device_put(jnp.ones((1,), jnp.float32), repl),
+                      jax.device_put(jnp.ones((1,), jnp.float32), repl))
+        self._batch_sh = NamedSharding(mesh, P("dp"))
+
+        if self.mp > 1:
+            from ..parallel.embedding import sharded_embedding_lookup
+            lookup = lambda t, i: sharded_embedding_lookup(
+                t, i, mesh, "mp")
+        else:
+            lookup = None
+
+        from ..fluid import registry
+        opdef = registry.require("adam")
+        hyper = dict(self._hyper)
+        opdef.fill_default_attrs(hyper)
+
+        def step(params, opt_state, pows, lr, ids, dense, label):
+            loss, grads = jax.value_and_grad(widedeep_loss)(
+                params, ids, dense, label, cfg, lookup)
+            lr_arr = jnp.asarray([lr], jnp.float32)
+            b1p, b2p = pows
+
+            def upd(p, g, st):
+                ins = {"Param": [p], "Grad": [g], "LearningRate": [lr_arr],
+                       "Moment1": [st["m1"]], "Moment2": [st["m2"]],
+                       "Beta1Pow": [b1p], "Beta2Pow": [b2p]}
+                outs = opdef.compute(None, ins, dict(hyper))
+                return (outs["ParamOut"][0],
+                        {"m1": outs["Moment1Out"][0],
+                         "m2": outs["Moment2Out"][0]},
+                        outs["Beta1PowOut"][0], outs["Beta2PowOut"][0])
+
+            flat_p, tdef = jax.tree_util.tree_flatten(params)
+            flat_g = jax.tree_util.tree_leaves(grads)
+            flat_s = tdef.flatten_up_to(opt_state)
+            new_p, new_s = [], []
+            for p, g, st in zip(flat_p, flat_g, flat_s):
+                p2, s2, b1n, b2n = upd(p, g, st)
+                new_p.append(p2)
+                new_s.append(s2)
+            return (loss, jax.tree_util.tree_unflatten(tdef, new_p),
+                    jax.tree_util.tree_unflatten(tdef, new_s), (b1n, b2n))
+
+        self._jit_step = jax.jit(
+            step, donate_argnums=(0, 1, 2),
+            out_shardings=(repl, self._shardings,
+                           jax.tree_util.tree_map(
+                               lambda s: {"m1": s, "m2": s},
+                               self._shardings,
+                               is_leaf=lambda s: isinstance(
+                                   s, NamedSharding)),
+                           (repl, repl)))
+
+    def __call__(self, sparse_ids, dense, label):
+        args = [jax.device_put(jnp.asarray(a), self._batch_sh)
+                for a in (sparse_ids, dense, label)]
+        lr = self._lr() if callable(self._lr) else float(self._lr)
+        loss, self.params, self.opt_state, self._pows = self._jit_step(
+            self.params, self.opt_state, self._pows, np.float32(lr), *args)
+        return loss

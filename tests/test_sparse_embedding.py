@@ -1,0 +1,278 @@
+"""Sparse tier: SelectedRows grads, mesh-sharded embedding, wide&deep,
+host-KV PS runtime (reference large_scale_kv.h + lookup_table SelectedRows
+grad kernel + listen_and_serv; BASELINE config 4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows through the static graph
+# ---------------------------------------------------------------------------
+
+def test_lookup_grad_emits_selected_rows():
+    from paddle_tpu.fluid import registry
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+    opdef = registry.require("lookup_table_v2_grad")
+    ids = jnp.asarray([[1, 3], [3, 0]], jnp.int64)
+    w = jnp.zeros((8, 4))
+    og = jnp.ones((2, 2, 4))
+    outs = opdef.compute(None, {"Ids": [ids], "W": [w], "Out@GRAD": [og]},
+                         {"is_sparse": True, "padding_idx": -1})
+    g = outs["W@GRAD"][0]
+    assert isinstance(g, SelectedRows)
+    assert g.height == 8 and g.values.shape == (4, 4)
+    dense = np.asarray(g.to_dense())
+    assert dense[3].sum() == 8.0  # row 3 hit twice
+    assert dense[2].sum() == 0.0
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_static_embedding_train(sparse, fresh_programs):
+    """is_sparse=True path (SelectedRows -> sparse sgd) matches the dense
+    path numerically."""
+    from paddle_tpu.fluid import Executor, framework, layers, optimizer
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 5
+        with framework.program_guard(main, startup):
+            ids = layers.data("ids", [-1, 4], "int64")
+            y = layers.data("y", [-1, 1], "float32")
+            emb = layers.embedding(ids, [64, 8], is_sparse=sparse)
+            s = layers.reduce_sum(emb, dim=[1, 2], keep_dim=False)
+            d = layers.elementwise_sub(layers.reshape(s, [-1, 1]), y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    losses = []
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(10):
+            idb = rng.randint(0, 64, (16, 4)).astype("int64")
+            yb = np.full((16, 1), 2.0, "float32")
+            lv, = exe.run(main, feed={"ids": idb, "y": yb},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.5
+    if sparse:
+        test_static_embedding_train._sparse_losses = losses
+    else:
+        test_static_embedding_train._dense_losses = losses
+
+
+def test_sparse_matches_dense():
+    d = getattr(test_static_embedding_train, "_dense_losses", None)
+    s = getattr(test_static_embedding_train, "_sparse_losses", None)
+    assert d is not None and s is not None
+    np.testing.assert_allclose(d, s, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_lazy_adam_op():
+    """lazy_mode adam touches only the grad's rows."""
+    from paddle_tpu.fluid import registry
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+    opdef = registry.require("adam")
+    p = jnp.ones((6, 3))
+    sr = SelectedRows(jnp.asarray([1, 4]), jnp.ones((2, 3)), 6)
+    st = {"Moment1": [jnp.zeros((6, 3))], "Moment2": [jnp.zeros((6, 3))],
+          "Beta1Pow": [jnp.ones((1,))], "Beta2Pow": [jnp.ones((1,))]}
+    outs = opdef.compute(None, {
+        "Param": [p], "Grad": [sr],
+        "LearningRate": [jnp.asarray([0.1])], **st},
+        {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8, "lazy_mode": True})
+    pn = np.asarray(outs["ParamOut"][0])
+    changed = np.where(np.abs(pn - 1.0).sum(1) > 0)[0]
+    np.testing.assert_array_equal(changed, [1, 4])
+
+
+def test_sparse_lazy_adam_merges_duplicates():
+    """Duplicate rows merge before the moment update (reference
+    scatter::MergeAdd) — equivalent to a single pre-summed row."""
+    from paddle_tpu.fluid import registry
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+    opdef = registry.require("adam")
+    attrs = {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+             "lazy_mode": True}
+    p = jnp.ones((6, 3))
+    st = lambda: {"Moment1": [jnp.zeros((6, 3))],
+                  "Moment2": [jnp.zeros((6, 3))],
+                  "Beta1Pow": [jnp.ones((1,))],
+                  "Beta2Pow": [jnp.ones((1,))]}
+    lr = {"LearningRate": [jnp.asarray([0.1])]}
+    dup = SelectedRows(jnp.asarray([2, 2, 5]),
+                       jnp.asarray([[1.], [2.], [4.]]) *
+                       jnp.ones((3, 3)), 6)
+    pre = SelectedRows(jnp.asarray([2, 5]),
+                       jnp.asarray([[3.], [4.]]) * jnp.ones((2, 3)), 6)
+    o1 = opdef.compute(None, {"Param": [p], "Grad": [dup], **lr, **st()},
+                       attrs)
+    o2 = opdef.compute(None, {"Param": [p], "Grad": [pre], **lr, **st()},
+                       attrs)
+    for k in ("ParamOut", "Moment1Out", "Moment2Out"):
+        np.testing.assert_allclose(np.asarray(o1[k][0]),
+                                   np.asarray(o2[k][0]), atol=1e-6)
+
+
+def test_sparse_momentum_nesterov():
+    """Sparse nesterov matches the dense update rule."""
+    from paddle_tpu.fluid import registry
+    from paddle_tpu.fluid.selected_rows import SelectedRows
+    opdef = registry.require("momentum")
+    attrs = {"mu": 0.9, "use_nesterov": True}
+    p = jnp.ones((4, 2))
+    v = jnp.full((4, 2), 0.5)
+    lr = jnp.asarray([0.1])
+    sr = SelectedRows(jnp.asarray([1, 3]), jnp.ones((2, 2)), 4)
+    o_sp = opdef.compute(None, {"Param": [p], "Grad": [sr],
+                                "Velocity": [v],
+                                "LearningRate": [lr]}, attrs)
+    o_dn = opdef.compute(None, {"Param": [p], "Grad": [sr.to_dense()],
+                                "Velocity": [v],
+                                "LearningRate": [lr]}, attrs)
+    np.testing.assert_allclose(np.asarray(o_sp["ParamOut"][0]),
+                               np.asarray(o_dn["ParamOut"][0]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o_sp["VelocityOut"][0]),
+                               np.asarray(o_dn["VelocityOut"][0]),
+                               atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded embedding
+# ---------------------------------------------------------------------------
+
+def test_sharded_lookup_matches_dense_take():
+    from paddle_tpu.parallel.embedding import sharded_embedding_lookup
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "mp"))
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randn(64, 8).astype(np.float32))
+    ids = jnp.asarray(rng.randint(0, 64, (16, 5)))
+    tsh = jax.device_put(table, NamedSharding(mesh, P("mp", None)))
+
+    def loss_sh(t, i):
+        return jnp.sum(sharded_embedding_lookup(t, i, mesh, "mp") ** 2)
+
+    def loss_ref(t, i):
+        return jnp.sum(jnp.take(t, i, axis=0) ** 2)
+
+    l1, g1 = jax.jit(jax.value_and_grad(loss_sh))(tsh, ids)
+    l2, g2 = jax.jit(jax.value_and_grad(loss_ref))(table, ids)
+    assert abs(float(l1) - float(l2)) < 1e-3
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-5)
+    assert g1.sharding.spec == P("mp", None)  # grad sharded like the table
+
+
+def test_widedeep_trains_and_matches_single_device():
+    from paddle_tpu.models.wide_deep import (WideDeepConfig,
+                                             WideDeepTrainStep)
+    cfg = WideDeepConfig.tiny()
+    rng = np.random.RandomState(0)
+
+    def batch(i):
+        r = np.random.RandomState(100 + i)
+        ids = r.randint(0, cfg.vocab_size, (16, cfg.num_slots))
+        dense = r.randn(16, cfg.dense_dim).astype(np.float32)
+        # learnable structure: label depends on one slot's parity
+        label = (ids[:, 0] % 2).astype(np.float32)[:, None]
+        return ids, dense, label
+
+    s1 = WideDeepTrainStep(cfg, dp=1, mp=1, seed=0,
+                           devices=jax.devices()[:1])
+    s8 = WideDeepTrainStep(cfg, dp=2, mp=4, seed=0)
+    l1 = l8 = None
+    for i in range(5):
+        ids, dense, label = batch(i)
+        l1, l8 = float(s1(ids, dense, label)), float(s8(ids, dense, label))
+        assert abs(l1 - l8) < 5e-4, f"step {i}: {l1} vs {l8}"
+    first = float(np.log(2))  # BCE at init ~ ln 2
+    assert l8 < first  # it learns
+
+
+# ---------------------------------------------------------------------------
+# host KV + PS runtime
+# ---------------------------------------------------------------------------
+
+def test_large_scale_kv_vectorized():
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import LargeScaleKV
+    kv = LargeScaleKV(4, init_std=0.0)
+    keys = np.array([5, 9, 5, 1000000007])
+    rows = kv.pull(keys)
+    assert rows.shape == (4, 4)
+    np.testing.assert_allclose(rows, 0.0)
+    kv.push(np.array([5, 5]), np.ones((2, 4)), lr=0.5)
+    got = kv.pull(np.array([5]))
+    np.testing.assert_allclose(got, -1.0)  # two pushes of -0.5 accumulated
+    assert kv.size() == 3
+
+
+def test_kv_save_load(tmp_path):
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import LargeScaleKV
+    kv = LargeScaleKV(3)
+    keys = np.array([2, 7, 11])
+    orig = kv.pull(keys)
+    kv.save(str(tmp_path / "t.kv"))
+    kv2 = LargeScaleKV(3)
+    kv2.load(str(tmp_path / "t.kv"))
+    np.testing.assert_allclose(kv2.pull(keys), orig)
+
+
+def test_ps_server_client_roundtrip():
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient, PSServer
+    servers = [PSServer("127.0.0.1:0") for _ in range(2)]
+    for s in servers:
+        s.serve_in_thread()
+    try:
+        client = PSClient([s.endpoint for s in servers])
+        keys = np.arange(20)
+        dim = 4
+        rows = client.pull("emb", dim, keys)
+        assert rows.shape == (20, 4)
+        client.push("emb", dim, keys, np.ones((20, 4)), lr=1.0)
+        rows2 = client.pull("emb", dim, keys)
+        np.testing.assert_allclose(rows2, rows - 1.0, atol=1e-6)
+        # rows landed on their hash-routed shard
+        assert servers[0].tables["emb"].size() == 10
+        assert servers[1].tables["emb"].size() == 10
+        client.close()
+    finally:
+        for s in servers:
+            s.shutdown()
+            s.server_close()
+
+
+def test_fleet_ps_lifecycle():
+    """init_server/run_server/init_worker through the fleet facade."""
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet import Role, UserDefinedRoleMaker
+    from paddle_tpu.distributed.fleet.base.fleet_base import Fleet
+
+    # server side (ephemeral port)
+    server_fleet = Fleet()
+    server_fleet.init(UserDefinedRoleMaker(
+        current_id=0, role=Role.SERVER,
+        server_endpoints=["127.0.0.1:0"]))
+    server_fleet.init_server()
+    srv = server_fleet.run_server(block=False)
+    try:
+        worker_fleet = Fleet()
+        worker_fleet.init(UserDefinedRoleMaker(
+            current_id=0, role=Role.WORKER, worker_num=1,
+            server_endpoints=[srv.endpoint]))
+        client = worker_fleet.init_worker()
+        rows = client.pull("table0", 8, np.array([1, 2, 3]))
+        assert rows.shape == (3, 8)
+        worker_fleet.stop_worker()
+    finally:
+        server_fleet._runtime().stop_server()
