@@ -41,7 +41,11 @@ class LargeScaleKV:
     def _ensure(self, keys: np.ndarray) -> np.ndarray:
         """Slots for keys, creating missing rows in one batched init."""
         idx = self._index
-        missing = [k for k in keys.tolist() if k not in idx]
+        # dedup while preserving order: duplicate new keys in one batch
+        # must allocate ONE slot (else start drifts off the data high-water
+        # mark and later inserts clobber existing rows)
+        missing = list(dict.fromkeys(
+            k for k in keys.tolist() if k not in idx))
         if missing:
             start = len(idx)
             fresh = self._rng.normal(
@@ -160,7 +164,9 @@ class PSServer(socketserver.ThreadingTCPServer):
             return True
         if op == "save":
             tag = self.endpoint.replace(":", "_")
-            for name, t in self.tables.items():
+            with self._tables_lock:
+                items = list(self.tables.items())
+            for name, t in items:
                 t.save(f"{req['dirname']}/{name}.{tag}.kv")
             return True
         if op == "size":
@@ -184,6 +190,7 @@ class PSClient:
         self.endpoints = list(endpoints)
         self._socks: list[socket.socket | None] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
+        self._pool = None  # lazy persistent fan-out pool
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -203,14 +210,17 @@ class PSClient:
         return (keys.astype(np.int64) % len(self.endpoints)).astype(np.int64)
 
     def _fanout(self, calls):
-        """Dispatch shard RPCs concurrently (reference Communicator sends
-        per-shard in parallel threads); sequential round-trips would make
-        latency N_shards x RTT."""
+        """Dispatch shard RPCs concurrently over a persistent pool
+        (reference Communicator's long-lived send threads); sequential
+        round-trips would make latency N_shards x RTT."""
         if len(calls) <= 1:
             return [fn() for fn in calls]
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(calls)) as ex:
-            return list(ex.map(lambda fn: fn(), calls))
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=len(self.endpoints),
+                thread_name_prefix="ps-client")
+        return list(self._pool.map(lambda fn: fn(), calls))
 
     def pull(self, table: str, dim: int, keys) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
@@ -248,6 +258,9 @@ class PSClient:
             self._call(i, {"op": "save", "dirname": dirname})
 
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         for s in self._socks:
             if s is not None:
                 s.close()

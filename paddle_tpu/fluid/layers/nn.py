@@ -13,6 +13,9 @@ __all__ = [
     "fc", "conv2d", "pool2d", "batch_norm", "layer_norm", "group_norm",
     "instance_norm", "embedding", "dropout", "relu", "softmax", "one_hot",
     "matmul", "label_smooth", "clip_by_norm", "l2_normalize", "pad", "pad2d",
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_softmax", "sequence_reverse", "sequence_expand",
+    "segment_pool", "dynamic_rnn",
 ]
 
 
@@ -340,3 +343,117 @@ def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
                             "pad_value": pad_value,
                             "data_format": data_format})
     return out
+
+
+# ---------------------------------------------------------------------------
+# sequence layers (LoD-free mask/segment design — SURVEY §7; reference
+# fluid/layers/sequence_lod.py over operators/sequence_ops/*)
+# ---------------------------------------------------------------------------
+
+def _seq_op(type, inputs, attrs, dtype, n_out=1):
+    helper = LayerHelper(type)
+    outs = [helper.create_variable_for_type_inference(dtype)
+            for _ in range(n_out)]
+    outputs = {"Out": [outs[0]]}
+    if n_out > 1:
+        outputs["Length"] = [outs[1]]
+    helper.append_op(type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+    return outs[0] if n_out == 1 else tuple(outs)
+
+
+def sequence_mask(x, maxlen=-1, dtype="int64", name=None):
+    """lengths [..] -> mask [.., maxlen]. `maxlen` must be static under
+    jit (reference sequence_mask_op takes it dynamically from LoD)."""
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sequence_mask", inputs={"X": [x]},
+                     outputs={"Y": [out]},
+                     attrs={"maxlen": maxlen if maxlen else -1,
+                            "out_dtype": dtype})
+    return out
+
+
+def sequence_pad(x, pad_value=0.0, length=None, maxlen=-1, name=None):
+    if length is None:
+        raise ValueError(
+            "sequence_pad needs `length` (per-sequence lengths) — the "
+            "flat-rows input carries no LoD in this framework")
+    return _seq_op("sequence_pad",
+                   {"X": [x], "Length": [length]},
+                   {"padded_length": maxlen, "pad_value": pad_value},
+                   x.dtype, n_out=2)
+
+
+def sequence_unpad(x, length, name=None):
+    return _seq_op("sequence_unpad", {"X": [x], "Length": [length]}, {},
+                   x.dtype)
+
+
+def sequence_pool(input, pool_type="average", length=None, pad_value=0.0,
+                  name=None):
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    return _seq_op("sequence_pool", ins,
+                   {"pooltype": pool_type.upper(), "pad_value": pad_value},
+                   input.dtype)
+
+
+def sequence_softmax(input, length=None, name=None):
+    ins = {"X": [input]}
+    if length is not None:
+        ins["Length"] = [length]
+    return _seq_op("sequence_softmax", ins, {}, input.dtype)
+
+
+def sequence_reverse(x, length=None, name=None):
+    ins = {"X": [x]}
+    if length is not None:
+        ins["Length"] = [length]
+    return _seq_op("sequence_reverse", ins, {}, x.dtype)
+
+
+def sequence_expand(x, ref_length, name=None):
+    return _seq_op("sequence_expand",
+                   {"X": [x], "RefLength": [ref_length]}, {}, x.dtype)
+
+
+def segment_pool(data, segment_ids, pool_type="sum", num_segments=-1,
+                 name=None):
+    return _seq_op("segment_pool",
+                   {"X": [data], "SegmentIds": [segment_ids]},
+                   {"pooltype": pool_type.upper(),
+                    "num_segments": num_segments}, data.dtype)
+
+
+def dynamic_rnn(input, hidden_size, mode="LSTM", num_layers=1,
+                is_bidirec=False, sequence_length=None, param_attr=None,
+                name=None):
+    """Static-graph fused RNN over dense [B, T, D] (replaces the
+    reference's dynamic_rnn/StaticRNN LoD machinery with the single `rnn`
+    op). Returns (out, final_hidden)."""
+    helper = LayerHelper("dynamic_rnn", name=name)
+    dtype = input.dtype or "float32"
+    D = input.shape[-1]
+    ndir = 2 if is_bidirec else 1
+    import math as _math
+    std = 1.0 / _math.sqrt(hidden_size)
+    from ..initializer import UniformInitializer
+    from ..ops.sequence_ops import rnn_weight_shapes
+    weights = [helper.create_parameter(
+        param_attr, shape=list(shape), dtype=dtype,
+        default_initializer=UniformInitializer(-std, std))
+        for shape in rnn_weight_shapes(mode, D, hidden_size, num_layers,
+                                       ndir)]
+    out = helper.create_variable_for_type_inference(dtype)
+    h_n = helper.create_variable_for_type_inference(dtype)
+    c_n = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": [input], "WeightList": weights}
+    if sequence_length is not None:
+        ins["SequenceLength"] = [sequence_length]
+    helper.append_op(type="rnn", inputs=ins,
+                     outputs={"Out": [out], "State": [h_n, c_n]},
+                     attrs={"mode": mode, "hidden_size": hidden_size,
+                            "num_layers": num_layers,
+                            "is_bidirec": is_bidirec, "dropout_prob": 0.0})
+    return out, h_n

@@ -1,0 +1,368 @@
+"""Sequence ops — the LoD-free mask/segment tier (SURVEY §7).
+
+The reference represents ragged batches as LoDTensors and ships 13
+sequence_* ops over them (operators/sequence_ops/sequence_pool_op.cc,
+sequence_pad_op.cc, sequence_softmax_op.cc, sequence_reverse_op.h,
+sequence_expand_op.cc; LoD itself at framework/lod_tensor.h:52).  LoD's
+dynamic offsets don't fit XLA's static shapes, so here every sequence is
+dense [B, T, ...] plus either a `lengths` vector or segment ids — masks are
+computed on the fly, shapes stay static, everything jits.  The `rnn` op
+(reference operators/rnn_op + cudnn_lstm_op.cu, math/lstm_compute.*) is a
+single lax.scan over time, multi-layer and bidirectional, with
+per-sequence-length masking replacing LoD-sorted batching.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register, same_shape_as
+from .common import out, x
+
+
+def _len_mask(lengths, maxlen):
+    """[B] lengths -> [B, maxlen] bool mask."""
+    return jnp.arange(maxlen)[None, :] < lengths.reshape(-1, 1)
+
+
+# ---------------------------------------------------------------------------
+# masking / padding
+# ---------------------------------------------------------------------------
+
+def _seq_mask_infer(op):
+    v = op.invar("X")
+    maxlen = op.attr("maxlen", -1)
+    if v is None or v.shape is None or maxlen is None or maxlen < 0:
+        return
+    for name in op.output("Y"):
+        op.block.create_var(name=name, shape=tuple(v.shape) + (maxlen,),
+                            dtype=op.attr("out_dtype", "int64"))
+
+
+@register("sequence_mask", infer_shape=_seq_mask_infer, grad=None,
+          attrs={"maxlen": -1, "out_dtype": "int64"})
+def _sequence_mask(ctx, ins, attrs):
+    lens = x(ins)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        if isinstance(lens, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask under jit needs a static maxlen attr "
+                "(dynamic max(lengths) would be a dynamic shape)")
+        maxlen = int(jnp.max(lens))
+    m = jnp.arange(maxlen) < lens[..., None]
+    from .. import core
+    return {"Y": [m.astype(core.convert_dtype(
+        attrs.get("out_dtype", "int64")))]}
+
+
+@register("sequence_pad", no_grad_slots=("Length",),
+          no_grad_out_slots=("Length",))
+def _sequence_pad(ctx, ins, attrs):
+    """Flat rows [sum(len), D] + lengths -> [B, maxlen, D] (+ Length out).
+    attrs: padded_length (static), pad_value."""
+    v, lens = x(ins, "X"), x(ins, "Length")
+    maxlen = attrs.get("padded_length", -1)
+    if maxlen is None or maxlen < 0:
+        if isinstance(v, jax.core.Tracer):
+            raise ValueError("sequence_pad under jit needs a static "
+                             "padded_length attr")
+        maxlen = int(jnp.max(lens))
+    pad = attrs.get("pad_value", 0.0)
+    B = lens.shape[0]
+    starts = jnp.cumsum(lens) - lens
+    pos = jnp.arange(maxlen)[None, :]                   # [1, T]
+    idx = starts[:, None] + pos                          # [B, T]
+    valid = pos < lens[:, None]
+    idx = jnp.clip(idx, 0, v.shape[0] - 1)
+    rows = jnp.take(v, idx.reshape(-1), axis=0).reshape(
+        (B, maxlen) + v.shape[1:])
+    rows = jnp.where(valid.reshape(B, maxlen, *([1] * (v.ndim - 1))),
+                     rows, pad)
+    return {"Out": [rows], "Length": [lens]}
+
+
+@register("sequence_unpad", grad=None, no_grad_slots=("Length",))
+def _sequence_unpad(ctx, ins, attrs):
+    """[B, T, ...] + lengths -> flat [sum(len), ...]. The output length is
+    data-dependent, so this op is eager/host-only (the mask-native design
+    keeps jitted graphs padded; unpad only at the host boundary)."""
+    v, lens = x(ins, "X"), x(ins, "Length")
+    if isinstance(v, jax.core.Tracer) or isinstance(lens, jax.core.Tracer):
+        raise ValueError(
+            "sequence_unpad has a data-dependent output shape and cannot "
+            "run under jit — keep data padded+masked on device and unpad "
+            "at the host boundary")
+    import numpy as np
+    vn, ln = np.asarray(v), np.asarray(lens)
+    return out(jnp.asarray(np.concatenate(
+        [vn[b, :ln[b]] for b in range(len(ln))], axis=0)))
+
+
+# ---------------------------------------------------------------------------
+# masked reductions / transforms
+# ---------------------------------------------------------------------------
+
+def _seq_pool_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=(v.shape[0],) + tuple(
+            v.shape[2:]), dtype=v.dtype)
+
+
+@register("sequence_pool", infer_shape=_seq_pool_infer,
+          no_grad_slots=("Length",),
+          attrs={"pooltype": "AVERAGE", "pad_value": 0.0})
+def _sequence_pool(ctx, ins, attrs):
+    """[B, T, ...] (+ optional Length) -> [B, ...] by SUM/AVERAGE/SQRT/
+    MAX/MIN/LAST/FIRST over the valid prefix."""
+    v = x(ins, "X")
+    lens = x(ins, "Length")
+    T = v.shape[1]
+    if lens is None:
+        lens = jnp.full((v.shape[0],), T, jnp.int32)
+    m = _len_mask(lens, T).reshape(v.shape[0], T, *([1] * (v.ndim - 2)))
+    pt = attrs.get("pooltype", "AVERAGE").upper()
+    denom = jnp.maximum(lens, 1).reshape(-1, *([1] * (v.ndim - 2)))
+    if pt == "SUM":
+        r = jnp.sum(jnp.where(m, v, 0), axis=1)
+    elif pt == "AVERAGE":
+        r = jnp.sum(jnp.where(m, v, 0), axis=1) / denom
+    elif pt == "SQRT":
+        r = jnp.sum(jnp.where(m, v, 0), axis=1) / jnp.sqrt(
+            denom.astype(v.dtype))
+    elif pt == "MAX":
+        r = jnp.max(jnp.where(m, v, -jnp.inf), axis=1)
+    elif pt == "MIN":
+        r = jnp.min(jnp.where(m, v, jnp.inf), axis=1)
+    elif pt == "LAST":
+        idx = jnp.maximum(lens - 1, 0)
+        r = jnp.take_along_axis(
+            v, idx.reshape(-1, 1, *([1] * (v.ndim - 2))), axis=1)[:, 0]
+    elif pt == "FIRST":
+        r = v[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {pt!r}")
+    # empty sequences produce pad_value, not ±inf / stale rows (reference
+    # sequence_pool_op.cc pad_value semantics)
+    empty = (lens == 0).reshape(-1, *([1] * (v.ndim - 2)))
+    r = jnp.where(empty, jnp.asarray(attrs.get("pad_value", 0.0), v.dtype),
+                  r)
+    return out(r)
+
+
+@register("sequence_softmax", no_grad_slots=("Length",))
+def _sequence_softmax(ctx, ins, attrs):
+    """Masked softmax over the time dim of [B, T] (or [B, T, ...])."""
+    v = x(ins, "X")
+    lens = x(ins, "Length")
+    T = v.shape[1]
+    if lens is None:
+        lens = jnp.full((v.shape[0],), T, jnp.int32)
+    m = _len_mask(lens, T).reshape(v.shape[0], T, *([1] * (v.ndim - 2)))
+    z = jnp.where(m, v, -jnp.inf)
+    r = jax.nn.softmax(z, axis=1)
+    return out(jnp.where(m, r, 0))
+
+
+@register("sequence_reverse", infer_shape=same_shape_as("X"),
+          no_grad_slots=("Length",))
+def _sequence_reverse(ctx, ins, attrs):
+    """Reverse each sequence's valid prefix; padding stays in place."""
+    v = x(ins, "X")
+    lens = x(ins, "Length")
+    T = v.shape[1]
+    if lens is None:
+        return out(v[:, ::-1])
+    pos = jnp.arange(T)[None, :]
+    idx = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    return out(jnp.take_along_axis(
+        v, idx.reshape(v.shape[0], T, *([1] * (v.ndim - 2))), axis=1))
+
+
+@register("sequence_expand", grad=None, no_grad_slots=("RefLength",))
+def _sequence_expand(ctx, ins, attrs):
+    """Repeat row b of X RefLength[b] times (host-only: output length is
+    data-dependent — reference sequence_expand_op.cc)."""
+    v, ref = x(ins, "X"), x(ins, "RefLength")
+    if isinstance(v, jax.core.Tracer) or isinstance(ref, jax.core.Tracer):
+        raise ValueError("sequence_expand has a data-dependent output "
+                         "shape and cannot run under jit")
+    import numpy as np
+    return out(jnp.asarray(np.repeat(np.asarray(v), np.asarray(ref),
+                                     axis=0)))
+
+
+# ---------------------------------------------------------------------------
+# segment ops (TPU-native replacement for LoD grouping)
+# ---------------------------------------------------------------------------
+
+@register("segment_pool", no_grad_slots=("SegmentIds",),
+          attrs={"pooltype": "SUM", "num_segments": -1})
+def _segment_pool(ctx, ins, attrs):
+    """Pool rows of X [N, ...] by SegmentIds [N] into [num_segments, ...]
+    (jit-able: num_segments is a static attr)."""
+    v, seg = x(ins, "X"), x(ins, "SegmentIds")
+    n = attrs.get("num_segments", -1)
+    if n is None or n < 0:
+        if isinstance(seg, jax.core.Tracer):
+            raise ValueError("segment_pool under jit needs a static "
+                             "num_segments attr")
+        n = int(jnp.max(seg)) + 1
+    seg = seg.astype(jnp.int32)
+    pt = attrs.get("pooltype", "SUM").upper()
+    if pt == "SUM":
+        r = jax.ops.segment_sum(v, seg, num_segments=n)
+    elif pt == "MEAN":
+        s = jax.ops.segment_sum(v, seg, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((v.shape[0],), v.dtype), seg,
+                                num_segments=n)
+        r = s / jnp.maximum(c, 1).reshape(-1, *([1] * (v.ndim - 1)))
+    elif pt == "MAX":
+        r = jax.ops.segment_max(v, seg, num_segments=n)
+    elif pt == "MIN":
+        r = jax.ops.segment_min(v, seg, num_segments=n)
+    else:
+        raise ValueError(f"unknown pooltype {pt!r}")
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# rnn op: lax.scan over time
+# ---------------------------------------------------------------------------
+
+def rnn_weight_shapes(mode, input_size, hidden_size, num_layers=1,
+                      ndir=1):
+    """Shapes of the `rnn` op's WeightList, in slot order — the single
+    source of truth consumed by nn.LSTM/GRU/SimpleRNN and
+    layers.dynamic_rnn: per (layer, direction) four arrays
+    (w_ih [G*H, in], w_hh [G*H, H], b_ih [G*H], b_hh [G*H])."""
+    G = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+    H = hidden_size
+    shapes = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * ndir
+        for _ in range(ndir):
+            shapes += [(G * H, in_sz), (G * H, H), (G * H,), (G * H,)]
+    return shapes
+
+
+def _lstm_step(xw, h, c, w_hh, b_hh):
+    g = xw + h @ w_hh.T + b_hh
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+    h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(xw, h, w_hh, b_hh):
+    # gate layout r|z|n (torch convention; self-consistent weights)
+    hw = h @ w_hh.T + b_hh
+    xr, xz, xn = jnp.split(xw, 3, axis=-1)
+    hr, hz, hn = jnp.split(hw, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_single(v, lens, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, reverse):
+    """One direction of one layer. v [B,T,D] -> (out [B,T,H], h_n, c_n)."""
+    B, T, _ = v.shape
+    if reverse:
+        v = _sequence_reverse(None, {"X": [v], "Length": [lens]}, {})[
+            "Out"][0]
+    # hoist the input projection out of the scan (one big MXU matmul)
+    xw = jnp.moveaxis(v @ w_ih.T + b_ih, 1, 0)           # [T, B, G*H]
+    mask = (jnp.ones((T, B, 1), bool) if lens is None
+            else _len_mask(lens, T).T[..., None])        # [T, B, 1]
+
+    def step(carry, xs):
+        h, c = carry
+        xt, keep = xs
+        if mode == "LSTM":
+            h2, c2 = _lstm_step(xt, h, c, w_hh, b_hh)
+        elif mode == "GRU":
+            h2, c2 = _gru_step(xt, h, w_hh, b_hh), c
+        elif mode == "RNN_RELU":
+            h2, c2 = jax.nn.relu(xt + h @ w_hh.T + b_hh), c
+        else:  # RNN_TANH
+            h2, c2 = jnp.tanh(xt + h @ w_hh.T + b_hh), c
+        h2 = jnp.where(keep, h2, h)
+        c2 = jnp.where(keep, c2, c)
+        return (h2, c2), jnp.where(keep, h2, 0)
+
+    (h_n, c_n), ys = jax.lax.scan(step, (h0, c0), (xw, mask))
+    outp = jnp.moveaxis(ys, 0, 1)                       # [B, T, H]
+    if reverse:
+        outp = _sequence_reverse(None, {"X": [outp], "Length": [lens]},
+                                 {})["Out"][0]
+    return outp, h_n, c_n
+
+
+def _rnn_infer(op):
+    v = op.invar("Input")
+    if v is None or v.shape is None:
+        return
+    H = op.attr("hidden_size", 0)
+    L = op.attr("num_layers", 1)
+    ndir = 2 if op.attr("is_bidirec", False) else 1
+    B, T = v.shape[0], v.shape[1]
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=(B, T, H * ndir),
+                            dtype=v.dtype)
+    for name in op.output("State"):
+        op.block.create_var(name=name, shape=(L * ndir, B, H),
+                            dtype=v.dtype)
+
+
+@register("rnn", infer_shape=_rnn_infer, no_grad_slots=("SequenceLength",),
+          stochastic=True,
+          attrs={"mode": "LSTM", "hidden_size": 0, "num_layers": 1,
+                 "is_bidirec": False, "dropout_prob": 0.0, "is_test": False})
+def _rnn(ctx, ins, attrs):
+    """Multi-layer (bi)directional recurrent net (reference rnn_op /
+    cudnn_lstm): Input [B,T,D], WeightList = per (layer,direction) four
+    arrays (w_ih [G*H, in], w_hh [G*H, H], b_ih, b_hh), PreState h0 (+c0)
+    each [L*ndir, B, H]."""
+    v = x(ins, "Input")
+    lens = x(ins, "SequenceLength")
+    weights = ins.get("WeightList") or []
+    pre = ins.get("PreState") or []
+    mode = attrs.get("mode", "LSTM")
+    L = attrs.get("num_layers", 1)
+    bi = attrs.get("is_bidirec", False)
+    ndir = 2 if bi else 1
+    p = attrs.get("dropout_prob", 0.0)
+    is_test = attrs.get("is_test", False) or (ctx is not None and
+                                              ctx.is_test)
+    B = v.shape[0]
+    H = attrs["hidden_size"] or weights[1].shape[-1]
+    h0 = pre[0] if pre else jnp.zeros((L * ndir, B, H), v.dtype)
+    c0 = pre[1] if len(pre) > 1 else jnp.zeros_like(h0)
+
+    inp = v
+    h_out, c_out = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(ndir):
+            k = layer * ndir + d
+            w_ih, w_hh, b_ih, b_hh = weights[4 * k: 4 * k + 4]
+            o, hn, cn = _rnn_single(inp, lens, h0[k], c0[k], w_ih, w_hh,
+                                    b_ih, b_hh, mode, reverse=(d == 1))
+            outs.append(o)
+            h_out.append(hn)
+            c_out.append(cn)
+        inp = jnp.concatenate(outs, axis=-1) if bi else outs[0]
+        if p and not is_test and layer < L - 1 and ctx is not None:
+            key = jax.random.fold_in(ctx.rng(attrs), layer)
+            keep = jax.random.bernoulli(key, 1.0 - p, inp.shape)
+            inp = jnp.where(keep, inp / (1.0 - p), 0.0)
+    state = [jnp.stack(h_out)]
+    if mode == "LSTM":
+        state.append(jnp.stack(c_out))
+    else:
+        state.append(jnp.zeros_like(state[0]))
+    return {"Out": [inp], "State": state}

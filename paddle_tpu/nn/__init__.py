@@ -6,6 +6,8 @@ from .layers_common import __all__ as _common_all
 from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerEncoder, TransformerDecoderLayer,
                           TransformerDecoder, Transformer)
+from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+                  SimpleRNN, LSTM, GRU)
 from ..fluid.dygraph.layers import Layer
 from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
                           ClipGradByGlobalNorm)
@@ -14,4 +16,6 @@ __all__ = ["Layer", "functional", "initializer", "ClipGradByValue",
            "ClipGradByNorm", "ClipGradByGlobalNorm", "MultiHeadAttention",
            "TransformerEncoderLayer", "TransformerEncoder",
            "TransformerDecoderLayer", "TransformerDecoder",
-           "Transformer"] + list(_common_all)
+           "Transformer", "RNNCellBase", "SimpleRNNCell", "LSTMCell",
+           "GRUCell", "RNN", "BiRNN", "SimpleRNN", "LSTM",
+           "GRU"] + list(_common_all)

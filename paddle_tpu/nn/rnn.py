@@ -1,0 +1,238 @@
+"""Recurrent layers (reference python/paddle/nn/layer/rnn.py:401,1074 —
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU).
+
+The multi-layer LSTM/GRU/SimpleRNN classes dispatch to the single `rnn` op
+(fluid/ops/sequence_ops.py) — one lax.scan over time per direction, so the
+whole network jits into one XLA computation instead of per-step op chains;
+variable lengths are handled by masking, not LoD."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..common_ops import run_op_multi
+from ..fluid.dygraph.layers import Layer
+from ..fluid.dygraph.varbase import Tensor
+from . import functional as F
+from .initializer import Uniform
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def _make(self, shape, std):
+        return self.create_parameter(
+            shape, default_initializer=Uniform(-std, std))
+
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32"):
+        import paddle_tpu as paddle
+        B = batch_ref.shape[0]
+        # state_shape is either one shape tuple (H,) or a tuple of shape
+        # tuples ((H,), (H,)) for multi-state cells like LSTM
+        if self.state_shape and isinstance(self.state_shape[0],
+                                           (tuple, list)):
+            return tuple(paddle.zeros([B, s[-1]], dtype)
+                         for s in self.state_shape)
+        return paddle.zeros([B, self.state_shape[-1]], dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self._make([hidden_size, input_size], std)
+        self.weight_hh = self._make([hidden_size, hidden_size], std)
+        self.bias_ih = self._make([hidden_size], std)
+        self.bias_hh = self._make([hidden_size], std)
+        self.state_shape = (hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        h = states if states is not None else \
+            self.get_initial_states(inputs)
+        z = paddle.add(
+            F.linear(inputs, paddle.t(self.weight_ih), self.bias_ih),
+            F.linear(h, paddle.t(self.weight_hh), self.bias_hh))
+        out = paddle.tanh(z) if self.activation == "tanh" else F.relu(z)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self._make([4 * hidden_size, input_size], std)
+        self.weight_hh = self._make([4 * hidden_size, hidden_size], std)
+        self.bias_ih = self._make([4 * hidden_size], std)
+        self.bias_hh = self._make([4 * hidden_size], std)
+        self.state_shape = ((hidden_size,), (hidden_size,))
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        h, c = states if states is not None else \
+            self.get_initial_states(inputs)
+        g = paddle.add(
+            F.linear(inputs, paddle.t(self.weight_ih), self.bias_ih),
+            F.linear(h, paddle.t(self.weight_hh), self.bias_hh))
+        i, f, gg, o = paddle.split(g, 4, axis=-1)
+        c2 = paddle.add(paddle.multiply(F.sigmoid(f), c),
+                        paddle.multiply(F.sigmoid(i), paddle.tanh(gg)))
+        h2 = paddle.multiply(F.sigmoid(o), paddle.tanh(c2))
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = self._make([3 * hidden_size, input_size], std)
+        self.weight_hh = self._make([3 * hidden_size, hidden_size], std)
+        self.bias_ih = self._make([3 * hidden_size], std)
+        self.bias_hh = self._make([3 * hidden_size], std)
+        self.state_shape = (hidden_size,)
+
+    def forward(self, inputs, states=None):
+        import paddle_tpu as paddle
+        h = states if states is not None else \
+            self.get_initial_states(inputs)
+        xw = F.linear(inputs, paddle.t(self.weight_ih), self.bias_ih)
+        hw = F.linear(h, paddle.t(self.weight_hh), self.bias_hh)
+        xr, xz, xn = paddle.split(xw, 3, axis=-1)
+        hr, hz, hn = paddle.split(hw, 3, axis=-1)
+        r = F.sigmoid(paddle.add(xr, hr))
+        z = F.sigmoid(paddle.add(xz, hz))
+        n = paddle.tanh(paddle.add(xn, paddle.multiply(r, hn)))
+        h2 = paddle.add(paddle.multiply(z, h),
+                        paddle.multiply(paddle.add(
+                            paddle.full_like(z, 1.0),
+                            paddle.scale(z, -1.0)), n))
+        return h2, h2
+
+
+class RNN(Layer):
+    """Python-loop cell runner (reference nn/layer/rnn.py RNN): unrolls
+    time steps; fine for short sequences / eager use — the fused LSTM/GRU
+    classes below are the jit-friendly path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        t_axis = 0 if self.time_major else 1
+        T = inputs.shape[t_axis]
+        steps = list(range(T))
+        if self.is_reverse:
+            steps = steps[::-1]
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            xt = paddle.squeeze(paddle.slice(inputs, [t_axis], [t], [t + 1]),
+                                axis=[t_axis])
+            y, states = self.cell(xt, states)
+            outs[t] = y
+        outp = paddle.stack(outs, axis=t_axis)
+        return outp, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        s_fw, s_bw = initial_states if initial_states is not None \
+            else (None, None)
+        o_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        o_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        return paddle.concat([o_fw, o_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    _MODE = "LSTM"
+    _GATES = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirect = direction != "forward"
+        self.time_major = time_major
+        self.dropout = dropout
+        ndir = 2 if self.bidirect else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        from ..fluid.ops.sequence_ops import rnn_weight_shapes
+        self.weights = []
+        for i, shape in enumerate(rnn_weight_shapes(
+                self._MODE, input_size, hidden_size, num_layers, ndir)):
+            p = self.create_parameter(
+                list(shape), default_initializer=Uniform(-std, std))
+            self.add_parameter(f"w_{i}", p)
+            self.weights.append(p)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import paddle_tpu as paddle
+        v = inputs
+        if self.time_major:
+            v = paddle.transpose(v, [1, 0, 2])
+        ins = {"Input": [v], "WeightList": list(self.weights)}
+        if initial_states is not None:
+            states = initial_states if isinstance(initial_states,
+                                                  (list, tuple)) \
+                else [initial_states]
+            ins["PreState"] = list(states)
+        if sequence_length is not None:
+            ins["SequenceLength"] = [sequence_length]
+        res = run_op_multi(
+            "rnn", ins,
+            {"mode": self._MODE, "hidden_size": self.hidden_size,
+             "num_layers": self.num_layers, "is_bidirec": self.bidirect,
+             "dropout_prob": self.dropout, "is_test": not self.training},
+            out_slots={"Out": 1, "State": 2})
+        outp = res["Out"][0]
+        if self.time_major:
+            outp = paddle.transpose(outp, [1, 0, 2])
+        h_n, c_n = res["State"]
+        if self._MODE == "LSTM":
+            return outp, (h_n, c_n)
+        return outp, h_n
+
+
+class LSTM(_RNNBase):
+    _MODE, _GATES = "LSTM", 4
+
+
+class GRU(_RNNBase):
+    _MODE, _GATES = "GRU", 3
+
+
+class SimpleRNN(_RNNBase):
+    _MODE, _GATES = "RNN_TANH", 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 activation="tanh", **kwargs):
+        self._MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, **kwargs)

@@ -18,6 +18,13 @@ class Imdb(Dataset):
         self.docs = rng.randint(1, self.vocab, (self.n, self.seq_len)) \
             .astype("int64")
         self.labels = rng.randint(0, 2, self.n).astype("int64")
+        # plant sentiment signal: ~25% of tokens come from a class-specific
+        # range ([1,100) positive / [100,200) negative) so models can
+        # actually learn, not only memorise
+        signal = rng.random_sample((self.n, self.seq_len)) < 0.25
+        tok = rng.randint(1, 100, (self.n, self.seq_len))
+        tok = tok + 100 * (1 - self.labels)[:, None]
+        self.docs = np.where(signal, tok, self.docs).astype("int64")
 
     def __getitem__(self, idx):
         return self.docs[idx], np.array([self.labels[idx]], dtype="int64")

@@ -1,0 +1,295 @@
+"""Sequence/mask ops + RNN tier (reference operators/sequence_ops/*,
+rnn/lstm/gru ops, python/paddle/nn/layer/rnn.py; SURVEY §7 LoD->mask
+redesign)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import registry
+
+
+def op(name):
+    return registry.require(name).compute
+
+
+# ---------------------------------------------------------------------------
+# sequence ops
+# ---------------------------------------------------------------------------
+
+def test_sequence_mask():
+    outs = op("sequence_mask")(None, {"X": [jnp.asarray([2, 0, 3])]},
+                               {"maxlen": 4, "out_dtype": "int64"})
+    np.testing.assert_array_equal(
+        np.asarray(outs["Y"][0]),
+        [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_pad_roundtrip():
+    flat = jnp.arange(10, dtype=jnp.float32).reshape(5, 2)
+    lens = jnp.asarray([2, 3])
+    outs = op("sequence_pad")(None, {"X": [flat], "Length": [lens]},
+                              {"padded_length": 4, "pad_value": -1.0})
+    padded = np.asarray(outs["Out"][0])
+    assert padded.shape == (2, 4, 2)
+    np.testing.assert_allclose(padded[0, :2], [[0, 1], [2, 3]])
+    np.testing.assert_allclose(padded[0, 2:], -1.0)
+    np.testing.assert_allclose(padded[1, :3], [[4, 5], [6, 7], [8, 9]])
+    # unpad (host-only) inverts
+    outs2 = op("sequence_unpad")(None, {
+        "X": [jnp.asarray(padded)], "Length": [lens]}, {})
+    np.testing.assert_allclose(np.asarray(outs2["Out"][0]),
+                               np.asarray(flat))
+
+
+@pytest.mark.parametrize("pt,expect", [
+    ("SUM", [[3.0], [4.0]]),
+    ("AVERAGE", [[1.5], [4.0]]),
+    ("MAX", [[2.0], [4.0]]),
+    ("LAST", [[2.0], [4.0]]),
+    ("FIRST", [[1.0], [4.0]]),
+])
+def test_sequence_pool(pt, expect):
+    v = jnp.asarray([[[1.], [2.], [9.]], [[4.], [9.], [9.]]])
+    lens = jnp.asarray([2, 1])
+    outs = op("sequence_pool")(None, {"X": [v], "Length": [lens]},
+                               {"pooltype": pt})
+    np.testing.assert_allclose(np.asarray(outs["Out"][0]), expect)
+
+
+def test_sequence_pad_is_differentiable():
+    flat = jnp.arange(10, dtype=jnp.float32).reshape(5, 2)
+    lens = jnp.asarray([2, 3])
+
+    def f(v):
+        return jnp.sum(op("sequence_pad")(
+            None, {"X": [v], "Length": [lens]},
+            {"padded_length": 4, "pad_value": 0.0})["Out"][0] ** 2)
+
+    g = np.asarray(jax.grad(f)(flat))
+    np.testing.assert_allclose(g, 2 * np.asarray(flat), atol=1e-5)
+
+
+def test_sequence_pool_empty_sequence_pad_value():
+    v = jnp.ones((2, 3, 1))
+    lens = jnp.asarray([0, 2])
+    outs = op("sequence_pool")(None, {"X": [v], "Length": [lens]},
+                               {"pooltype": "MAX", "pad_value": -7.0})
+    r = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(r, [[-7.0], [1.0]])
+
+
+def test_sequence_softmax_masked():
+    v = jnp.asarray([[1.0, 1.0, 100.0]])
+    outs = op("sequence_softmax")(None, {
+        "X": [v], "Length": [jnp.asarray([2])]}, {})
+    r = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(r, [[0.5, 0.5, 0.0]], atol=1e-6)
+
+
+def test_sequence_reverse():
+    v = jnp.arange(8, dtype=jnp.float32).reshape(2, 4, 1)
+    outs = op("sequence_reverse")(None, {
+        "X": [v], "Length": [jnp.asarray([3, 4])]}, {})
+    r = np.asarray(outs["Out"][0])[..., 0]
+    np.testing.assert_allclose(r, [[2, 1, 0, 3], [7, 6, 5, 4]])
+
+
+def test_segment_pool_grad():
+    v = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+    seg = jnp.asarray([0, 0, 1, 1])
+
+    def f(v):
+        return jnp.sum(op("segment_pool")(
+            None, {"X": [v], "SegmentIds": [seg]},
+            {"pooltype": "MEAN", "num_segments": 2})["Out"][0] ** 2)
+
+    g = jax.grad(f)(v)
+    # numeric grad check
+    eps = 1e-3
+    for i in (0, 3):
+        vp = v.at[i, 0].add(eps)
+        vm = v.at[i, 0].add(-eps)
+        num = (f(vp) - f(vm)) / (2 * eps)
+        np.testing.assert_allclose(np.asarray(g)[i, 0], float(num),
+                                   rtol=1e-3)
+
+
+def test_sequence_pool_grad_masked():
+    """Gradient flows only into the valid prefix."""
+    v = jnp.ones((2, 3, 2))
+    lens = jnp.asarray([2, 1])
+
+    def f(v):
+        return jnp.sum(op("sequence_pool")(
+            None, {"X": [v], "Length": [lens]},
+            {"pooltype": "SUM"})["Out"][0])
+
+    g = np.asarray(jax.grad(f)(v))
+    np.testing.assert_allclose(g[0], [[1, 1], [1, 1], [0, 0]])
+    np.testing.assert_allclose(g[1], [[1, 1], [0, 0], [0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# rnn op
+# ---------------------------------------------------------------------------
+
+def _rnn_weights(rng, mode, in_sz, H, layers=1, ndir=1):
+    G = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+    ws = []
+    for layer in range(layers):
+        d_in = in_sz if layer == 0 else H * ndir
+        for d in range(ndir):
+            ws += [jnp.asarray(rng.randn(G * H, d_in).astype("float32") * .3),
+                   jnp.asarray(rng.randn(G * H, H).astype("float32") * .3),
+                   jnp.asarray(rng.randn(G * H).astype("float32") * .1),
+                   jnp.asarray(rng.randn(G * H).astype("float32") * .1)]
+    return ws
+
+
+@pytest.mark.parametrize("mode", ["LSTM", "GRU", "RNN_TANH"])
+def test_rnn_op_masking(mode):
+    """Padded steps change nothing: final state for a length-L sequence
+    equals running the truncated sequence."""
+    rng = np.random.RandomState(0)
+    B, T, D, H = 2, 5, 3, 4
+    v = jnp.asarray(rng.randn(B, T, D).astype("float32"))
+    ws = _rnn_weights(rng, mode, D, H)
+    lens = jnp.asarray([3, 5])
+    full = op("rnn")(None, {"Input": [v], "WeightList": ws,
+                            "SequenceLength": [lens]},
+                     {"mode": mode, "hidden_size": H, "num_layers": 1,
+                      "is_bidirec": False, "is_test": True})
+    trunc = op("rnn")(None, {"Input": [v[:1, :3]], "WeightList": ws},
+                      {"mode": mode, "hidden_size": H, "num_layers": 1,
+                       "is_bidirec": False, "is_test": True})
+    np.testing.assert_allclose(np.asarray(full["State"][0][0, 0]),
+                               np.asarray(trunc["State"][0][0, 0]),
+                               atol=1e-5)
+    # outputs past the length are zero
+    np.testing.assert_allclose(np.asarray(full["Out"][0][0, 3:]), 0.0)
+
+
+def test_rnn_op_bidirectional_shapes():
+    rng = np.random.RandomState(1)
+    B, T, D, H, L = 2, 4, 3, 5, 2
+    v = jnp.asarray(rng.randn(B, T, D).astype("float32"))
+    ws = _rnn_weights(rng, "LSTM", D, H, layers=L, ndir=2)
+    outs = op("rnn")(None, {"Input": [v], "WeightList": ws},
+                     {"mode": "LSTM", "hidden_size": H, "num_layers": L,
+                      "is_bidirec": True, "is_test": True})
+    assert outs["Out"][0].shape == (B, T, 2 * H)
+    assert outs["State"][0].shape == (L * 2, B, H)
+    assert outs["State"][1].shape == (L * 2, B, H)
+
+
+def test_lstm_layer_matches_cell_loop():
+    """Fused nn.LSTM == nn.RNN(LSTMCell) stepped in python, with shared
+    weights."""
+    rng = np.random.RandomState(2)
+    B, T, D, H = 2, 4, 3, 5
+    lstm = paddle.nn.LSTM(D, H)
+    cell = paddle.nn.LSTMCell(D, H)
+    # share weights
+    cell.weight_ih._set_value(lstm.weights[0]._value)
+    cell.weight_hh._set_value(lstm.weights[1]._value)
+    cell.bias_ih._set_value(lstm.weights[2]._value)
+    cell.bias_hh._set_value(lstm.weights[3]._value)
+    x = paddle.to_tensor(rng.randn(B, T, D).astype("float32"))
+    lstm.eval()
+    y1, (h1, c1) = lstm(x)
+    y2, (h2, c2) = paddle.nn.RNN(cell)(x)
+    np.testing.assert_allclose(np.asarray(y1._value),
+                               np.asarray(y2._value), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h1._value[0]),
+                               np.asarray(h2._value), atol=1e-5)
+
+
+def test_gru_layer_runs_and_grads():
+    gru = paddle.nn.GRU(4, 6, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.RandomState(3)
+                         .randn(2, 5, 4).astype("float32"))
+    y, h = gru(x)
+    assert tuple(y.shape) == (2, 5, 12)
+    loss = paddle.mean(y)
+    loss.backward()
+    g = gru.weights[0].grad
+    assert g is not None and np.isfinite(np.asarray(g._value)).all()
+
+
+def test_static_dynamic_rnn(fresh_programs):
+    """Static-graph rnn op via layers.dynamic_rnn trains."""
+    from paddle_tpu.fluid import Executor, framework, layers, optimizer
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 3
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 6, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            seq_out, h_n = layers.dynamic_rnn(x, hidden_size=8, mode="GRU")
+            pooled = layers.sequence_pool(seq_out, "average")
+            pred = layers.fc(pooled, 1)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            optimizer.Adam(learning_rate=0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            xb = rng.randn(16, 6, 4).astype("float32")
+            yb = xb.sum((1, 2), keepdims=False)[:, None].astype("float32")
+            lv, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_bilstm_sentiment_on_imdb():
+    """bi-LSTM sentiment classifier trains on the synthetic Imdb set
+    (reference book test style — BASELINE 'book' coverage)."""
+    from paddle_tpu.jit.functional import make_train_step
+    from paddle_tpu.text import Imdb
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    ds = Imdb(mode="train")
+
+    class BiLSTMSentiment(nn.Layer):
+        def __init__(self, vocab=5000, emb=32, hidden=32):
+            super().__init__()
+            self.embedding = nn.Embedding(vocab, emb)
+            self.lstm = nn.LSTM(emb, hidden, direction="bidirect")
+            self.fc = nn.Linear(2 * hidden, 2)
+
+        def forward(self, ids):
+            e = self.embedding(ids)
+            out, (h, c) = self.lstm(e)
+            import paddle_tpu as paddle
+            pooled = paddle.mean(out, axis=1)
+            return self.fc(pooled)
+
+    model = BiLSTMSentiment()
+    model.train()
+    step = make_train_step(
+        model, lambda m, ids, lab: F.cross_entropy(m(ids), lab),
+        optimizer="adam", lr=5e-3)
+    rng = np.random.RandomState(0)
+    losses = []
+    for i in range(40):
+        idx = rng.randint(0, len(ds), 32)
+        ids = ds.docs[idx][:, :64]
+        lab = ds.labels[idx][:, None]
+        losses.append(float(np.ravel(np.asarray(step(ids, lab)))[0]))
+    assert losses[-1] < 0.1, losses[-5:]
+    # eval accuracy on held-out
+    step.write_back()
+    model.eval()
+    test = Imdb(mode="test")
+    logits = model(paddle.to_tensor(test.docs[:64, :64])).numpy()
+    acc = (np.argmax(logits, 1) == test.labels[:64]).mean()
+    assert acc > 0.85, acc

@@ -215,6 +215,22 @@ def test_large_scale_kv_vectorized():
     assert kv.size() == 3
 
 
+def test_kv_duplicate_new_keys_one_batch():
+    """Duplicate unseen keys in one pull must allocate ONE slot; a drifted
+    high-water mark would let later inserts clobber rows (code-review
+    regression)."""
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import LargeScaleKV
+    kv = LargeScaleKV(4)
+    first = kv.pull(np.array([5, 5, 5]))
+    np.testing.assert_allclose(first[0], first[1])
+    kv.pull(np.array([9]))
+    kv.pull(np.array([7]))
+    again = kv.pull(np.array([5]))
+    np.testing.assert_allclose(again[0], first[0])
+    assert kv.size() == 3
+
+
 def test_kv_save_load(tmp_path):
     from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
         import LargeScaleKV
