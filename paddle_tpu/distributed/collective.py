@@ -117,7 +117,24 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    return all_reduce(tensor, op, group, sync_op)
+    """Reduce to `dst`: only the destination ends up with the reduced
+    value; other ranks keep their input (reference c_reduce_* semantics —
+    previously this was a plain all_reduce, leaving the result on every
+    rank)."""
+    ax = current_axis()
+    orig = _eager_value(tensor)
+    if ax is not None:
+        reduced = _eager_value(all_reduce(
+            jnp.asarray(orig), op, group, sync_op))
+        idx = jax.lax.axis_index(ax)
+        return _wrap_like(tensor, jnp.where(idx == dst, reduced, orig))
+    if jax.process_count() == 1:
+        return tensor
+    reduced = _eager_value(all_reduce(jnp.asarray(orig), op, group,
+                                      sync_op))
+    if jax.process_index() == dst:
+        return _wrap_like(tensor, reduced)
+    return _wrap_like(tensor, orig)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):

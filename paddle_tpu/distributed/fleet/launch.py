@@ -1,0 +1,13 @@
+"""fleetrun — `python -m paddle_tpu.distributed.fleet.launch`.
+
+Reference python/paddle/distributed/fleet/launch.py (console entry
+`fleetrun`, python/setup.py.in:505): same engine as
+paddle_tpu.distributed.launch, with --servers/--workers parameter-server
+mode as the first-class interface.
+"""
+from ..launch import launch, main
+
+__all__ = ["launch", "main"]
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Process launcher — `python -m paddle_tpu.distributed.launch`.
+
+Reference: python/paddle/distributed/launch.py:59,140,214 (parse ips/ports
+-> Cluster/Pod -> start_local_trainers sets PADDLE_* env, spawns children,
+watches and tears all down on failure) and fleet/launch.py (fleetrun, adds
+--servers/--workers PS mode).  TPU differences: no per-GPU device
+assignment — each process drives its local chips; cross-process rendezvous
+is jax.distributed's coordinator (PADDLE_COORDINATOR = first trainer
+endpoint) instead of the NCCL-id TCP dance.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main", "get_cluster_env"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch multi-process distributed training")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="trainers on this node (default: 1, or inferred "
+                        "from --trainer_endpoints)")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated node ips (this launcher starts "
+                        "only the local node's processes)")
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--started_port", type=int, default=6170)
+    p.add_argument("--trainer_endpoints", type=str, default=None,
+                   help="explicit comma-separated endpoints (overrides "
+                        "ips/started_port)")
+    p.add_argument("--servers", type=str, default="",
+                   help="PS mode: comma-separated server endpoints")
+    p.add_argument("--workers", type=str, default="",
+                   help="PS mode: comma-separated worker endpoints")
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def get_cluster_env(rank, endpoints, role="TRAINER", servers="",
+                    workers=""):
+    """PADDLE_* env for one child (reference launch_utils.py
+    start_local_trainers)."""
+    env = {
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_COORDINATOR": endpoints[0],
+        "TRAINING_ROLE": role,
+        "FLAGS_selected_gpus": "0",
+    }
+    if servers:
+        env["PADDLE_PSERVERS_IP_PORT_LIST"] = servers
+    if workers:
+        env["PADDLE_WORKERS_IP_PORT_LIST"] = workers
+    return env
+
+
+def _spawn_children(specs, log_dir):
+    """specs: list of (name, env_overrides, argv). Returns Popen list."""
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    procs = []
+    for name, env_over, argv in specs:
+        env = dict(os.environ)
+        env.update(env_over)
+        if log_dir:
+            fh = open(os.path.join(log_dir, f"{name}.log"), "w")
+            stdout = stderr = fh
+        else:
+            fh, stdout, stderr = None, None, None
+        procs.append((name, subprocess.Popen(argv, env=env, stdout=stdout,
+                                             stderr=stderr), fh))
+    return procs
+
+
+def _watch(procs):
+    """Poll children; on any failure kill the rest (reference
+    launch.py:214 watch + terminate_local_trainers)."""
+    try:
+        while True:
+            alive = False
+            for name, p, _ in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    sys.stderr.write(
+                        f"[launch] {name} exited with code {rc}; "
+                        f"terminating the job\n")
+                    _kill_all(procs)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        _kill_all(procs)
+        return 1
+    finally:
+        for _, _, fh in procs:
+            if fh:
+                fh.close()
+
+
+def _kill_all(procs):
+    for _, p, _ in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 5
+    for _, p, _ in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.05)
+        if p.poll() is None:
+            p.kill()
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    script = [sys.executable, args.training_script] \
+        + args.training_script_args
+    specs = []
+    if args.servers or args.workers:
+        # PS mode (fleetrun --servers/--workers)
+        servers = [e for e in args.servers.split(",") if e]
+        workers = [e for e in args.workers.split(",") if e]
+        for i, ep in enumerate(servers):
+            env = get_cluster_env(0, workers or ["127.0.0.1:6170"],
+                                  role="PSERVER", servers=args.servers,
+                                  workers=args.workers)
+            env.update({"PADDLE_PORT": ep.rsplit(":", 1)[1],
+                        "POD_IP": ep.rsplit(":", 1)[0],
+                        "PADDLE_SERVER_ID": str(i)})
+            specs.append((f"server.{i}", env, script))
+        for i, ep in enumerate(workers):
+            env = get_cluster_env(i, workers, role="TRAINER",
+                                  servers=args.servers,
+                                  workers=args.workers)
+            specs.append((f"worker.{i}", env, script))
+    else:
+        if args.trainer_endpoints:
+            endpoints = args.trainer_endpoints.split(",")
+        else:
+            n = args.nproc_per_node or 1
+            ips = args.ips.split(",")
+            endpoints = [f"{ip}:{args.started_port + i}"
+                         for ip in ips for i in range(n)]
+        n_local = args.nproc_per_node or \
+            len([e for e in endpoints
+                 if e.startswith(args.ips.split(",")[args.node_rank])])
+        base = args.node_rank * n_local
+        for i in range(n_local):
+            rank = base + i
+            specs.append((f"trainer.{rank}",
+                          get_cluster_env(rank, endpoints), script))
+    procs = _spawn_children(specs, args.log_dir)
+    # forward SIGTERM to the job
+    signal.signal(signal.SIGTERM, lambda *a: (_kill_all(procs),
+                                              sys.exit(143)))
+    return _watch(procs)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ class DataParallel(Layer):
                  last_comm_buffer_size=1, find_unused_parameters=False):
         super().__init__()
         self._layers = layers
+        self._comm_buffer_bytes = int(comm_buffer_size * (1 << 20))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -37,12 +38,40 @@ class DataParallel(Layer):
         return scale_loss(loss)
 
     def apply_collective_grads(self):
+        """Allreduce grads fused into flat buckets of ~comm_buffer_size MB
+        (reference dygraph/parallel.py:449 coalesced allreduce /
+        details/fused_all_reduce_op_handle.cc): one collective per bucket
+        instead of one per parameter."""
         if get_world_size() <= 1:
             return
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                g = all_reduce(p.grad, ReduceOp.SUM)
-                p.grad = g if g is not None else p.grad
+        params = [p for p in self._layers.parameters()
+                  if p.grad is not None]
+        # bucket by dtype, bounded by the buffer budget
+        buckets: list[list] = []
+        cur, cur_bytes, cur_dtype = [], 0, None
+        for p in params:
+            g = p.grad._value
+            if cur and (g.dtype != cur_dtype or
+                        cur_bytes + g.nbytes > self._comm_buffer_bytes):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += g.nbytes
+            cur_dtype = g.dtype
+        if cur:
+            buckets.append(cur)
+        from ..fluid.dygraph.varbase import Tensor
+        for bucket in buckets:
+            grads = [p.grad._value for p in bucket]
+            flat = jnp.concatenate([g.reshape(-1) for g in grads])
+            red = all_reduce(flat, ReduceOp.SUM)
+            red = red._value if hasattr(red, "_value") else red
+            off = 0
+            for p, g in zip(bucket, grads):
+                n = g.size
+                p.grad = Tensor(red[off:off + n].reshape(g.shape),
+                                stop_gradient=True)
+                off += n
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

@@ -1,14 +1,84 @@
 """paddle.distributed.spawn (reference python/paddle/distributed/spawn.py).
 
-On TPU a single process drives all local chips through the mesh, so spawn
-degenerates to running `func` once; multi-host launch goes through
-`python -m paddle_tpu.distributed.launch` (fleetrun) instead.
+Forks `nprocs` worker processes with fleetrun-style PADDLE_* env and runs
+`func(*args)` in each — the in-Python twin of
+`python -m paddle_tpu.distributed.launch`.  Note the TPU stance: a single
+process already drives all local chips through the mesh, so spawn is for
+multi-process semantics (PS tests, DCN simulation), not for per-device
+workers like the reference's per-GPU processes.
 """
 from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+from .launch import get_cluster_env
 
 __all__ = ["spawn"]
 
 
-def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+def _worker(rank, endpoints, func, args):
+    os.environ.update(get_cluster_env(rank, endpoints))
     func(*args)
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False,
+          started_port=None, timeout=None, **options):
+    """Run func in `nprocs` processes (nprocs<=1: run inline).
+
+    Ports default to freshly-bound free ports (a fixed base would collide
+    across concurrent spawns on one host). One worker failing terminates
+    the rest — joining a blocked sibling of a dead rank would hang
+    forever."""
+    if nprocs is None or nprocs <= 1:
+        func(*args)
+        return None
+    if started_port is None:
+        ports = _free_ports(nprocs)
+    else:
+        ports = [started_port + i for i in range(nprocs)]
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(rank, endpoints, func, args), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    import time
+    deadline = None if timeout is None else time.time() + timeout
+    failed = []
+    while True:
+        codes = [p.exitcode for p in procs]
+        failed = [(r, c) for r, c in enumerate(codes)
+                  if c is not None and c != 0]
+        if failed or all(c == 0 for c in codes):
+            break
+        if deadline is not None and time.time() > deadline:
+            failed = [(r, "timeout") for r, c in enumerate(codes)
+                      if c is None]
+            break
+        time.sleep(0.05)
+    if failed:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(5)
+        raise RuntimeError(f"spawn workers failed: {failed}")
     return None

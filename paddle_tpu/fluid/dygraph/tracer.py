@@ -20,7 +20,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import registry
+from .. import core, registry
+
+
+def _check_nan_inf(op_type: str, out_vals: dict):
+    """FLAGS_check_nan_inf per-op sweep (reference operator.cc:1056 ->
+    details/nan_inf_utils_detail.*): eager values are concrete, so every
+    float output is checked after the kernel; inside a jax trace the
+    values are symbolic and the sweep is skipped (use the executor's
+    post-step sweep / jax_debug_nans there)."""
+    for slot, vals in out_vals.items():
+        for v in vals:
+            if v is None or isinstance(v, jax.core.Tracer) or \
+                    not isinstance(v, jax.Array) or \
+                    not jnp.issubdtype(v.dtype, jnp.floating):
+                continue
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise RuntimeError(
+                    f"NaN/Inf detected in output slot {slot!r} of op "
+                    f"{op_type!r} (FLAGS_check_nan_inf)")
 from ..registry import GRAD_SUFFIX
 from .varbase import Tensor
 
@@ -125,6 +143,9 @@ class Tracer:
                     for slot, lst in in_tensors.items()}
         ctx = _EagerCtx(self._base_key, is_test=not self.train_mode)
         out_vals = opdef.compute(ctx, ins_vals, attrs)
+
+        if core.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+            _check_nan_inf(op_type, out_vals)
 
         out_tensors: dict[str, list] = {}
         requires_grad = (self._has_grad and not stop_gradient and

@@ -65,9 +65,11 @@ def _env_get(env: dict, name: str):
             f"an op, or run the startup program first") from None
 
 
-def trace_block(block: Block, env: dict, ctx: ExecContext) -> dict:
-    """Symbolically run every op of `block` against `env` (name -> value)."""
-    for op in block.ops:
+def trace_block(block: Block, env: dict, ctx: ExecContext,
+                ops=None) -> dict:
+    """Symbolically run every op of `block` (or the `ops` subset) against
+    `env` (name -> value)."""
+    for op in (block.ops if ops is None else ops):
         opdef = registry.require(op.type)
         ins = {slot: [_env_get(env, n) for n in names]
                for slot, names in op.inputs.items()}
@@ -82,24 +84,56 @@ def trace_block(block: Block, env: dict, ctx: ExecContext) -> dict:
     return env
 
 
-def _analyze_program(program: Program):
+def _analyze_ops(ops):
     """Find names read before written (external inputs) and all writes."""
     written: set[str] = set()
     ext_reads: set[str] = set()
 
-    def visit(block: Block):
-        for op in block.ops:
+    def visit(op_list):
+        for op in op_list:
             for n in op.input_arg_names:
                 if n not in written:
                     ext_reads.add(n)
             for v in op.attrs.values():
                 if isinstance(v, Block):
-                    visit(v)  # conservative: sub-block reads count here
+                    visit(v.ops)  # conservative: sub-block reads count here
             for n in op.output_arg_names:
                 written.add(n)
 
-    visit(program.global_block())
+    visit(ops)
     return ext_reads, written
+
+
+def _block_reads(block: Block) -> set[str]:
+    reads: set[str] = set()
+
+    def visit(b):
+        for op in b.ops:
+            reads.update(op.input_arg_names)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    visit(v)
+
+    visit(block)
+    return reads
+
+
+def _prune_to_fetch(program: Program, fetch_names):
+    """Backward slice: keep only ops whose outputs (transitively) feed a
+    fetch target (reference framework/prune.h + Executor use_prune).
+    Fetching only `loss` from a program that also contains optimizer ops
+    skips the parameter updates, like the reference."""
+    needed = set(fetch_names)
+    keep: list = []
+    for op in reversed(list(program.global_block().ops)):
+        if set(op.output_arg_names) & needed:
+            keep.append(op)
+            needed.update(op.input_arg_names)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    needed.update(_block_reads(v))
+    keep.reverse()
+    return keep
 
 
 class Executor:
@@ -114,7 +148,8 @@ class Executor:
     # -- public API --------------------------------------------------------
     def run(self, program: Program | None = None, feed: dict | None = None,
             fetch_list: Sequence | None = None, scope: Scope | None = None,
-            return_numpy: bool = True, use_program_cache: bool = True):
+            return_numpy: bool = True, use_program_cache: bool = True,
+            use_prune: bool = False):
         program = program if program is not None else default_main_program()
         # CompiledProgram.with_data_parallel → batch-axis sharding over the
         # mesh (replaces reference ParallelExecutor, parallel_executor.cc:443)
@@ -125,13 +160,32 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in (fetch_list or [])]
 
-        if program._analysis_cache is None:
-            ext_reads, written = _analyze_program(program)
-            persistable = {v.name for v in program.list_vars()
-                           if v.persistable}
-            program._analysis_cache = (ext_reads, written, persistable,
-                                       program._structure_key())
-        ext_reads, written, persistable, skey = program._analysis_cache
+        run_ops = None
+        if use_prune:
+            # cached like _analysis_cache: pruning + analysis are O(#ops)
+            # python per call otherwise
+            pc = getattr(program, "_prune_cache", None)
+            if pc is None:
+                pc = program._prune_cache = {}
+            key = tuple(fetch_names)
+            if key not in pc:
+                run_ops = _prune_to_fetch(program, fetch_names)
+                ext_reads, written = _analyze_ops(run_ops)
+                persistable = {v.name for v in program.list_vars()
+                               if v.persistable}
+                pc[key] = (run_ops, ext_reads, written, persistable,
+                           (program._structure_key(), "prune", key))
+            run_ops, ext_reads, written, persistable, skey = pc[key]
+        else:
+            if program._analysis_cache is None:
+                ext_reads, written = _analyze_ops(
+                    program.global_block().ops)
+                persistable = {v.name for v in program.list_vars()
+                               if v.persistable}
+                program._analysis_cache = (ext_reads, written, persistable,
+                                           program._structure_key())
+            ext_reads, written, persistable, skey = \
+                program._analysis_cache
 
         feed_names = sorted(feed)
         # persistables the computation must read from the scope
@@ -179,7 +233,7 @@ class Executor:
 
         fn = self._compile(program, skey, feed_names, feed_vals, ro_names,
                            ro_vals, upd_names, upd_in_names, upd_in_vals,
-                           fetch_names, mesh)
+                           fetch_names, mesh, run_ops)
 
         self._run_counter += 1
         seed = np.uint32(
@@ -192,6 +246,20 @@ class Executor:
             scope.set(n, v)
         if core.get_flags("FLAGS_benchmark")["FLAGS_benchmark"]:
             jax.block_until_ready(fetches)
+        if core.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+            # post-step sweep over fetches + updated persistables (the
+            # whole block is ONE fused computation, so the reference's
+            # per-op sweep maps to a per-step output sweep; for op-level
+            # isolation run dygraph eager where the tracer checks per op)
+            bad = [n for n, v in
+                   list(zip(fetch_names, fetches))
+                   + list(zip(upd_names, updates))
+                   if jnp.issubdtype(jnp.result_type(v), jnp.floating)
+                   and not bool(jnp.all(jnp.isfinite(v)))]
+            if bad:
+                raise RuntimeError(
+                    f"NaN/Inf detected in {bad[:8]} after executor step "
+                    f"(FLAGS_check_nan_inf)")
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
@@ -263,7 +331,7 @@ class Executor:
     # -- compilation -------------------------------------------------------
     def _compile(self, program, skey, feed_names, feed_vals, ro_names,
                  ro_vals, upd_names, upd_in_names, upd_in_vals, fetch_names,
-                 mesh=None):
+                 mesh=None, run_ops=None):
         sig = (
             skey,
             None if mesh is None else tuple(mesh.shape.items()),
@@ -279,6 +347,7 @@ class Executor:
         )
         fn = self._cache.get(sig)
         if fn is not None:
+            self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
             return fn
 
         is_test = program._is_test
@@ -291,7 +360,7 @@ class Executor:
             env.update(zip(feed_names, feeds))
             ctx = ExecContext(jax.random.PRNGKey(seed), is_test=is_test,
                               executor=self)
-            trace_block(gb, env, ctx)
+            trace_block(gb, env, ctx, ops=run_ops)
             fetches = tuple(_env_get(env, n) for n in fetch_names)
             updates = tuple(env[n] for n in upd_names)
             return fetches, updates
@@ -324,10 +393,12 @@ class Executor:
                         None),
                     out_shardings=(tuple(repl for _ in fetch_names),
                                    tuple(psh[n] for n in upd_names)))
-        if len(self._cache) >= core.get_flags(
-                "FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]:
-            self._cache.clear()
-        self._cache[sig] = fn
+        cap = core.get_flags(
+            "FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]
+        while self._cache and len(self._cache) >= cap:
+            self._cache.pop(next(iter(self._cache)))  # evict oldest (LRU)
+        if cap > 0:
+            self._cache[sig] = fn
         return fn
 
     def close(self):

@@ -203,14 +203,43 @@ BatchNorm3D = _BatchNormBase
 
 
 class SyncBatchNorm(_BatchNormBase):
-    """Cross-replica batch norm. Under pjit data parallelism the batch axis is
-    sharded on the mesh and XLA computes global statistics when the reduction
-    is marked — here we rely on executor-level mesh context (the psum happens
-    inside the sharded computation, replacing the reference's
-    sync_batch_norm ncclAllReduce at sync_batch_norm_op.cu.h:190)."""
+    """Cross-replica batch norm.
+
+    Under jit with a batch-sharded input (the executor's DP path /
+    TrainStep with a mesh), the mean/var reductions are GLOBAL by SPMD
+    semantics — XLA inserts the cross-replica psum, replacing the
+    reference's explicit ncclAllReduce (sync_batch_norm_op.cu.h:190);
+    tests/test_advice_fixes.py pins this behavior on the 8-device mesh.
+    In eager multi-PROCESS mode there is no sharded computation to hook,
+    so stats are per-process — forward warns once in that case."""
+
+    _warned = False
+
+    def forward(self, x):
+        import jax
+        if jax.process_count() > 1 and not isinstance(
+                getattr(x, "_value", x), jax.core.Tracer):
+            if not SyncBatchNorm._warned:
+                import warnings
+                warnings.warn(
+                    "SyncBatchNorm in eager multi-process mode computes "
+                    "per-process statistics; run under a jitted "
+                    "data-parallel step for global stats")
+                SyncBatchNorm._warned = True
+        return super().forward(x)
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):
+        """Swap every _BatchNormBase sublayer for SyncBatchNorm, keeping
+        params/buffers (reference nn/layer/norm.py convert_sync_batchnorm
+        — previously returned the layer unchanged)."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm.__new__(SyncBatchNorm)
+            new.__dict__.update(layer.__dict__)  # shares params/buffers
+            return new
+        for name, sub in list(layer.named_children()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
         return layer
 
 

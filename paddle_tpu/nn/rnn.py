@@ -114,10 +114,9 @@ class GRUCell(RNNCellBase):
         r = F.sigmoid(paddle.add(xr, hr))
         z = F.sigmoid(paddle.add(xz, hz))
         n = paddle.tanh(paddle.add(xn, paddle.multiply(r, hn)))
+        one_minus_z = paddle.scale(z, -1.0, bias=1.0)
         h2 = paddle.add(paddle.multiply(z, h),
-                        paddle.multiply(paddle.add(
-                            paddle.full_like(z, 1.0),
-                            paddle.scale(z, -1.0)), n))
+                        paddle.multiply(one_minus_z, n))
         return h2, h2
 
 
@@ -140,14 +139,46 @@ class RNN(Layer):
         if self.is_reverse:
             steps = steps[::-1]
         states = initial_states
+        if sequence_length is not None and states is None and \
+                hasattr(self.cell, "get_initial_states"):
+            # materialise zeros so step-0 masking has an "old" state
+            batch_ref = inputs if not self.time_major else \
+                paddle.transpose(inputs, [1, 0, 2])
+            states = self.cell.get_initial_states(batch_ref)
         outs = [None] * T
         for t in steps:
             xt = paddle.squeeze(paddle.slice(inputs, [t_axis], [t], [t + 1]),
                                 axis=[t_axis])
-            y, states = self.cell(xt, states)
+            y, new_states = self.cell(xt, states)
+            if sequence_length is not None and states is not None:
+                keep = self._keep_mask(sequence_length, t, y)
+                y = paddle.multiply(y, keep)
+                states = self._blend(new_states, states, keep)
+            else:
+                states = new_states
             outs[t] = y
         outp = paddle.stack(outs, axis=t_axis)
         return outp, states
+
+    @staticmethod
+    def _keep_mask(sequence_length, t, like):
+        """[B, 1] float mask: 1 where step t is within the sequence
+        (padded steps must not advance states nor emit output — matches
+        the fused rnn op's masking)."""
+        import paddle_tpu as paddle
+        lens = paddle.cast(sequence_length, "float32")
+        tt = paddle.full_like(lens, float(t))
+        return paddle.unsqueeze(
+            paddle.cast(paddle.less_than(tt, lens), "float32"), 1)
+
+    @classmethod
+    def _blend(cls, new, old, keep):
+        import paddle_tpu as paddle
+        if isinstance(new, (tuple, list)):
+            return tuple(cls._blend(n, o, keep) for n, o in zip(new, old))
+        inv = paddle.scale(keep, -1.0, bias=1.0)
+        return paddle.add(paddle.multiply(new, keep),
+                          paddle.multiply(old, inv))
 
 
 class BiRNN(Layer):

@@ -1,0 +1,53 @@
+"""2-process collective worker (launched by test_launcher.py via
+`python -m paddle_tpu.distributed.launch`). Mirrors the reference's
+test_collective_base.py child scripts."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def main():
+    dist.init_parallel_env()
+    assert dist.get_world_size() == 2, dist.get_world_size()
+    rank = dist.get_rank()
+    assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+
+    # eager cross-process all_reduce over DCN (multihost path)
+    t = paddle.to_tensor(np.array([float(rank + 1), 2.0], "float32"))
+    r = dist.all_reduce(t)
+    val = np.asarray(r._value if hasattr(r, "_value") else r)
+    assert val.tolist() == [3.0, 4.0], val
+
+    # broadcast from rank 1
+    b = paddle.to_tensor(np.array([float(rank * 10)], "float32"))
+    b = dist.broadcast(b, src=1)
+    assert float(np.asarray(b._value)[0]) == 10.0
+
+    # all_gather
+    parts = []
+    dist.all_gather(parts, paddle.to_tensor(
+        np.array([float(rank)], "float32")))
+    got = sorted(float(np.asarray(p._value)[0]) for p in parts)
+    assert got == [0.0, 1.0], got
+
+    # reduce lands on dst only (API parity semantics)
+    rd = dist.reduce(paddle.to_tensor(
+        np.array([float(rank + 1)], "float32")), dst=0)
+    if rank == 0:
+        assert float(np.asarray(rd._value)[0]) == 3.0
+
+    dist.barrier()
+    print(f"worker {rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

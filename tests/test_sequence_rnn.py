@@ -207,6 +207,25 @@ def test_lstm_layer_matches_cell_loop():
                                np.asarray(h2._value), atol=1e-5)
 
 
+def test_rnn_cell_runner_masks_sequence_length():
+    """RNN(cell) with sequence_length: padded steps neither advance the
+    state nor emit output (code-review regression — was silently
+    ignored)."""
+    rng = np.random.RandomState(4)
+    cell = paddle.nn.LSTMCell(3, 4)
+    runner = paddle.nn.RNN(cell)
+    x = paddle.to_tensor(rng.randn(2, 5, 3).astype("float32"))
+    lens = paddle.to_tensor(np.array([3, 5], "int64"))
+    y, (h, c) = runner(x, sequence_length=lens)
+    y_np = np.asarray(y._value)
+    np.testing.assert_allclose(y_np[0, 3:], 0.0)
+    # final state of row 0 equals running only its first 3 steps
+    x0 = paddle.to_tensor(np.asarray(x._value)[:1, :3])
+    _, (h0, c0) = runner(x0)
+    np.testing.assert_allclose(np.asarray(h._value)[0],
+                               np.asarray(h0._value)[0], atol=1e-5)
+
+
 def test_gru_layer_runs_and_grads():
     gru = paddle.nn.GRU(4, 6, num_layers=2, direction="bidirect")
     x = paddle.to_tensor(np.random.RandomState(3)
