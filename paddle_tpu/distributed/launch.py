@@ -136,7 +136,11 @@ def launch(argv=None):
             env = get_cluster_env(0, workers or ["127.0.0.1:6170"],
                                   role="PSERVER", servers=args.servers,
                                   workers=args.workers)
-            env.update({"PADDLE_PORT": ep.rsplit(":", 1)[1],
+            # a server's identity is its OWN endpoint/index, not worker
+            # 0's (the trainer fields above only give servers the cluster
+            # layout)
+            env.update({"PADDLE_CURRENT_ENDPOINT": ep,
+                        "PADDLE_PORT": ep.rsplit(":", 1)[1],
                         "POD_IP": ep.rsplit(":", 1)[0],
                         "PADDLE_SERVER_ID": str(i)})
             specs.append((f"server.{i}", env, script))
@@ -153,9 +157,15 @@ def launch(argv=None):
             ips = args.ips.split(",")
             endpoints = [f"{ip}:{args.started_port + i}"
                          for ip in ips for i in range(n)]
+        my_ip = args.ips.split(",")[args.node_rank]
         n_local = args.nproc_per_node or \
-            len([e for e in endpoints
-                 if e.startswith(args.ips.split(",")[args.node_rank])])
+            len([e for e in endpoints if e.startswith(my_ip + ":")])
+        if n_local == 0:
+            sys.stderr.write(
+                f"[launch] no endpoints on this node ({my_ip}) — pass "
+                f"--nproc_per_node or include this node's ip in "
+                f"--trainer_endpoints/--ips\n")
+            return 1
         base = args.node_rank * n_local
         for i in range(n_local):
             rank = base + i

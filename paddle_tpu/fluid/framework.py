@@ -400,6 +400,7 @@ class Program:
     def _bump_version(self):
         self._version += 1
         self._analysis_cache = None
+        self._prune_cache = {}  # executor's use_prune slices are stale too
 
     # -- block management --------------------------------------------------
     def global_block(self) -> Block:

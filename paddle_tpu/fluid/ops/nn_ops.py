@@ -91,24 +91,53 @@ register("depthwise_conv2d", _conv2d, infer_shape=_conv2d_infer,
 
 
 def _conv2d_transpose(ctx, ins, attrs):
+    """Gradient-style transpose conv as one conv_general_dilated with
+    lhs_dilation = stride (supports groups + output_padding, which
+    jax.lax.conv_transpose does not). Reference
+    operators/conv_transpose_op semantics:
+    out = (i-1)*s + k_eff - 2p + output_padding."""
     inp, flt = x(ins, "Input"), x(ins, "Filter")
     strides = attrs.get("strides", [1, 1])
+    dil = attrs.get("dilations", [1, 1])
+    g = attrs.get("groups", 1) or 1
+    out_pad = attrs.get("output_padding") or [0, 0]
+    if not out_pad:
+        out_pad = [0, 0]
     p = attrs.get("paddings", [0, 0])
     pads = _conv_pad(p, attrs.get("padding_algorithm", "EXPLICIT"),
-                     flt.shape[2:], [1, 1])
-    # filter layout for transpose conv in reference is (in, out/groups, kh, kw)
-    r = jax.lax.conv_transpose(
-        inp, jnp.swapaxes(flt, 0, 1), strides=strides,
-    padding=pads if isinstance(pads, str) else [tuple(q) for q in pads],
+                     flt.shape[2:], dil)
+    in_c, opg, kh, kw = flt.shape
+    k_eff = [dil[0] * (kh - 1) + 1, dil[1] * (kw - 1) + 1]
+    if isinstance(pads, str):
+        if pads == "VALID":
+            pads = [(0, 0), (0, 0)]
+        else:  # SAME: out = i*s  =>  total crop = k_eff - s
+            pads = [((k_eff[i] - strides[i]) // 2,
+                     k_eff[i] - strides[i] - (k_eff[i] - strides[i]) // 2)
+                    for i in (0, 1)]
+    # paddle pad crops the full transpose output; in dilated-input conv
+    # terms the edge padding is k_eff-1-p (+output_padding on the high
+    # side)
+    jpads = [(k_eff[i] - 1 - lo, k_eff[i] - 1 - hi + out_pad[i])
+             for i, (lo, hi) in enumerate(pads)]
+    # filter (in, out/g, kh, kw) -> grouped-OIHW (out, in/g, kh, kw),
+    # spatially flipped (the transpose of the forward conv's kernel)
+    w = flt.reshape(g, in_c // g, opg, kh, kw)
+    w = jnp.swapaxes(w, 1, 2).reshape(g * opg, in_c // g, kh, kw)
+    w = w[:, :, ::-1, ::-1]
+    r = jax.lax.conv_general_dilated(
+        inp, w, window_strides=(1, 1), padding=jpads,
+        lhs_dilation=strides, rhs_dilation=dil,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True)
+        feature_group_count=g)
     return {"Output": [r]}
 
 
 register("conv2d_transpose", _conv2d_transpose,
          attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
                 "groups": 1, "padding_algorithm": "EXPLICIT",
-                "data_format": "NCHW", "output_size": [], "use_cudnn": False})
+                "output_padding": [], "data_format": "NCHW",
+                "output_size": [], "use_cudnn": False})
 
 
 # ---------------------------------------------------------------------------

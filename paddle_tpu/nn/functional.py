@@ -50,9 +50,27 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     s = [stride, stride] if isinstance(stride, int) else list(stride)
     p = [padding, padding] if isinstance(padding, int) else list(padding)
     d = [dilation, dilation] if isinstance(dilation, int) else list(dilation)
+    op = [output_padding] * 2 if isinstance(output_padding, int) \
+        else list(output_padding)
+    if output_size is not None:
+        # output_size disambiguates the stride-ambiguous output shape:
+        # convert to output_padding over the default (reference
+        # conv_transpose_op.cc)
+        hw = x.shape[2:]
+        os_ = [output_size] * 2 if isinstance(output_size, int) \
+            else list(output_size)
+        for i in (0, 1):
+            k_eff = d[i] * (weight.shape[2 + i] - 1) + 1
+            default = (hw[i] - 1) * s[i] + k_eff - 2 * p[i]
+            op[i] = os_[i] - default
+            if not 0 <= op[i] < s[i]:
+                raise ValueError(
+                    f"output_size[{i}]={os_[i]} unreachable: must be in "
+                    f"[{default}, {default + s[i] - 1}]")
     out = run_op("conv2d_transpose", {"Input": x, "Filter": weight},
                  {"strides": s, "paddings": p, "dilations": d,
-                  "groups": groups, "data_format": data_format},
+                  "output_padding": op, "groups": groups,
+                  "data_format": data_format},
                  out_slot="Output")
     if bias is not None:
         out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})

@@ -150,9 +150,12 @@ class RNN(Layer):
             xt = paddle.squeeze(paddle.slice(inputs, [t_axis], [t], [t + 1]),
                                 axis=[t_axis])
             y, new_states = self.cell(xt, states)
-            if sequence_length is not None and states is not None:
+            if sequence_length is not None:
                 keep = self._keep_mask(sequence_length, t, y)
                 y = paddle.multiply(y, keep)
+                # states may still be None for custom cells without
+                # get_initial_states: blend against implicit zeros so
+                # padded first steps don't leak state
                 states = self._blend(new_states, states, keep)
             else:
                 states = new_states
@@ -175,7 +178,11 @@ class RNN(Layer):
     def _blend(cls, new, old, keep):
         import paddle_tpu as paddle
         if isinstance(new, (tuple, list)):
+            old = old if isinstance(old, (tuple, list)) \
+                else [None] * len(new)
             return tuple(cls._blend(n, o, keep) for n, o in zip(new, old))
+        if old is None:  # implicit zero initial state
+            return paddle.multiply(new, keep)
         inv = paddle.scale(keep, -1.0, bias=1.0)
         return paddle.add(paddle.multiply(new, keep),
                           paddle.multiply(old, inv))

@@ -1,0 +1,291 @@
+"""OpTest harness — the reference's workhorse test base
+(python/paddle/fluid/tests/unittests/op_test.py:170,948,1236) rebuilt for
+the TPU framework.
+
+check_output: run the op eagerly through the registry kernel and (optionally)
+through the static Executor, compare against a numpy reference.
+check_grad: build a static Program (data vars -> op -> projection loss),
+run the REAL backward machinery (append_backward emitting registered grad
+ops / auto-vjp), and compare every analytic input gradient against central
+finite differences of the eager compute (reference get_numeric_gradient,
+op_test.py:57).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.fluid import backward, framework, registry, unique_name
+from paddle_tpu.fluid.executor import ExecContext, Executor
+from paddle_tpu.fluid.scope import Scope, scope_guard
+
+__all__ = ["OpCase", "check_output", "check_grad", "run_eager"]
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _static_mode():
+    import paddle_tpu as paddle
+    was_dy = framework.in_dygraph_mode()
+    if was_dy:
+        paddle.enable_static()
+    try:
+        yield
+    finally:
+        if was_dy:
+            paddle.disable_static()
+
+
+class OpCase:
+    def __init__(self, op, inputs, attrs=None, ref=None, skip_grad=False,
+                 static=False, grad_slots=None, atol=1e-5, grad_atol=5e-3,
+                 grad_rtol=5e-3, eps=1e-3, reason=None):
+        self.op = op
+        self.inputs = inputs          # slot -> np.ndarray | [np.ndarray]
+        self.attrs = attrs or {}
+        self.ref = ref                # fn(inputs, attrs) -> slot -> arrays
+        self.skip_grad = skip_grad
+        self.static = static          # additionally run via the Executor
+        self.grad_slots = grad_slots  # restrict grad check to these slots
+        self.atol = atol
+        self.grad_atol = grad_atol
+        self.grad_rtol = grad_rtol
+        self.eps = eps
+        self.reason = reason
+
+
+def _as_list(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v]
+
+
+def _ins_vals(inputs):
+    return {slot: [jnp.asarray(a) for a in _as_list(arrs)]
+            for slot, arrs in inputs.items()}
+
+
+def run_eager(op, inputs, attrs, is_test=False, seed=0):
+    opdef = registry.require(op)
+    a = dict(attrs)
+    opdef.fill_default_attrs(a)
+    if opdef.stochastic:
+        a.setdefault("_rng_id", 0)
+    ctx = ExecContext(jax.random.PRNGKey(seed), is_test=is_test)
+    return opdef.compute(ctx, _ins_vals(inputs), a)
+
+
+def _float_out_slots(op, outs):
+    opdef = registry.require(op)
+    slots = []
+    for slot, vals in outs.items():
+        if slot in opdef.no_grad_out_slots:
+            continue
+        if any(v is not None and hasattr(v, "dtype")
+               and jnp.issubdtype(v.dtype, jnp.floating) for v in vals):
+            slots.append(slot)
+    return slots
+
+
+def check_output(case: OpCase):
+    outs = run_eager(case.op, case.inputs, case.attrs)
+    if case.ref is not None:
+        expect = case.ref(case.inputs, case.attrs)
+        for slot, exp in expect.items():
+            got = outs[slot]
+            for g, e in zip(got, _as_list(exp)):
+                np.testing.assert_allclose(
+                    np.asarray(g, dtype=np.float64),
+                    np.asarray(e, dtype=np.float64),
+                    atol=case.atol, rtol=1e-4,
+                    err_msg=f"{case.op} output {slot}")
+    if case.static:
+        s_outs = _run_static(case)
+        for slot in outs:
+            for g, s in zip(outs[slot], s_outs.get(slot, [])):
+                if g is None or s is None:
+                    continue
+                np.testing.assert_allclose(
+                    np.asarray(s, np.float64), np.asarray(g, np.float64),
+                    atol=case.atol, rtol=1e-4,
+                    err_msg=f"{case.op} static vs eager {slot}")
+    return outs
+
+
+def _build_program(case, outs_probe):
+    """Program: data vars -> op -> (loss = sum of out*R projections).
+    Caller must hold _static_mode()."""
+    from paddle_tpu.fluid import layers
+    main, startup = framework.Program(), framework.Program()
+    rng = np.random.RandomState(7)
+    proj = {}
+    with framework.program_guard(main, startup), unique_name.guard():
+        block = main.global_block()
+        in_names = {}
+        feed = {}
+        for slot, arrs in case.inputs.items():
+            names = []
+            for i, a in enumerate(_as_list(arrs)):
+                a = np.asarray(a)
+                n = f"in_{slot}_{i}"
+                block.create_var(name=n, shape=tuple(a.shape),
+                                 dtype=str(a.dtype))
+                names.append(n)
+                feed[n] = a
+            in_names[slot] = names
+        out_names = {}
+        for slot, vals in outs_probe.items():
+            names = [f"out_{slot}_{i}" for i, v in enumerate(vals)
+                     if v is not None]  # None outputs (e.g. v1 reshape's
+            # XShape) stay out of the op desc or backward zero-fill
+            # would read a never-written var
+            for n in names:
+                block.create_var(name=n)
+            if names:
+                out_names[slot] = names
+        block.append_op(type=case.op,
+                        inputs={s: list(ns) for s, ns in in_names.items()},
+                        outputs={s: list(ns)
+                                 for s, ns in out_names.items()},
+                        attrs=dict(case.attrs))
+        # projection loss over differentiable float outputs
+        partials = []
+        for slot in _float_out_slots(case.op, outs_probe):
+            for i, v in enumerate(outs_probe[slot]):
+                if v is None or not jnp.issubdtype(v.dtype, jnp.floating):
+                    continue
+                r = rng.randn(*v.shape).astype(np.float32)
+                proj[(slot, i)] = r
+                rn = f"r_{slot}_{i}"
+                block.create_var(name=rn, shape=tuple(r.shape),
+                                 dtype="float32")
+                feed[rn] = r
+                m = layers.elementwise_mul(
+                    block.var(f"out_{slot}_{i}"), block.var(rn))
+                partials.append(layers.reduce_sum(m, dim=None,
+                                                  keep_dim=False))
+        loss = partials[0]
+        for p in partials[1:]:
+            loss = layers.elementwise_add(loss, p)
+    return main, startup, feed, in_names, loss, proj
+
+
+def _run_static(case):
+    outs_probe = run_eager(case.op, case.inputs, case.attrs)
+    from paddle_tpu.fluid import layers
+    main, startup = framework.Program(), framework.Program()
+    with _static_mode(), framework.program_guard(main, startup), \
+            unique_name.guard():
+        block = main.global_block()
+        feed = {}
+        in_names = {}
+        for slot, arrs in case.inputs.items():
+            names = []
+            for i, a in enumerate(_as_list(arrs)):
+                a = np.asarray(a)
+                n = f"in_{slot}_{i}"
+                block.create_var(name=n, shape=tuple(a.shape),
+                                 dtype=str(a.dtype))
+                names.append(n)
+                feed[n] = a
+            in_names[slot] = names
+        out_names = {}
+        for slot, vals in outs_probe.items():
+            out_names[slot] = [f"out_{slot}_{i}"
+                               for i in range(len(vals))]
+            for n in out_names[slot]:
+                block.create_var(name=n)
+        block.append_op(type=case.op,
+                        inputs={s: list(ns) for s, ns in in_names.items()},
+                        outputs={s: list(ns)
+                                 for s, ns in out_names.items()},
+                        attrs=dict(case.attrs))
+    fetch = [n for slot, ns in out_names.items() for n in ns
+             if outs_probe[slot][int(n.rsplit("_", 1)[1])] is not None]
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        vals = exe.run(main, feed=feed, fetch_list=fetch)
+    res = {}
+    i = 0
+    for slot, ns in out_names.items():
+        res[slot] = []
+        for n in ns:
+            if outs_probe[slot][int(n.rsplit("_", 1)[1])] is None:
+                res[slot].append(None)
+            else:
+                res[slot].append(vals[i])
+                i += 1
+    return res
+
+
+def _loss_eager(case, inputs, proj):
+    outs = run_eager(case.op, inputs, case.attrs)
+    total = 0.0
+    for (slot, i), r in proj.items():
+        total += float(jnp.sum(outs[slot][i].astype(jnp.float32)
+                               * jnp.asarray(r)))
+    return total
+
+
+def check_grad(case: OpCase, max_elems=64):
+    """Analytic (static append_backward through registered grad rules) vs
+    central finite differences of the eager kernel."""
+    opdef = registry.require(case.op)
+    outs_probe = run_eager(case.op, case.inputs, case.attrs)
+    with _static_mode():
+        main, startup, feed, in_names, loss, proj = _build_program(
+            case, outs_probe)
+    # differentiable input slots
+    grad_targets = []
+    for slot, arrs in case.inputs.items():
+        if slot in opdef.no_grad_slots:
+            continue
+        if case.grad_slots is not None and slot not in case.grad_slots:
+            continue
+        for i, a in enumerate(_as_list(arrs)):
+            if np.issubdtype(np.asarray(a).dtype, np.floating):
+                grad_targets.append((slot, i, f"in_{slot}_{i}"))
+    assert grad_targets, f"no differentiable inputs for {case.op}"
+    with _static_mode():
+        with framework.program_guard(main, startup):
+            grad_map = backward.append_backward(loss)
+        name_of = {v.name: g.name for v, g in (grad_map or [])}
+        with scope_guard(Scope()):
+            exe = Executor()
+            exe.run(startup)
+            fetch = [name_of[n] if n in name_of else
+                     backward.grad_var_name(n) for _, _, n in grad_targets]
+            analytic = exe.run(main, feed=feed, fetch_list=fetch)
+
+    for (slot, i, name), g in zip(grad_targets, analytic):
+        a = np.asarray(_as_list(case.inputs[slot])[i], np.float64)
+        flat = a.reshape(-1)
+        num = np.zeros_like(flat)
+        idxs = range(len(flat)) if len(flat) <= max_elems else \
+            np.random.RandomState(0).choice(len(flat), max_elems,
+                                            replace=False)
+        checked = np.zeros(len(flat), bool)
+        for j in idxs:
+            checked[j] = True
+            for sgn in (+1, -1):
+                pert = dict(case.inputs)
+                mod = [np.array(x, np.float64, copy=True)
+                       for x in _as_list(case.inputs[slot])]
+                mf = mod[i].reshape(-1)
+                mf[j] += sgn * case.eps
+                mod = [m.astype(_as_list(case.inputs[slot])[k].dtype)
+                       for k, m in enumerate(mod)]
+                pert[slot] = mod if isinstance(case.inputs[slot],
+                                               (list, tuple)) else mod[0]
+                lv = _loss_eager(case, pert, proj)
+                num[j] += sgn * lv
+            num[j] /= (2 * case.eps)
+        ga = np.asarray(g, np.float64).reshape(-1)
+        np.testing.assert_allclose(
+            ga[checked], num[checked], rtol=case.grad_rtol,
+            atol=case.grad_atol,
+            err_msg=f"{case.op}: analytic vs numeric grad of "
+                    f"{slot}[{i}]")

@@ -1,0 +1,480 @@
+"""Registry-wide op sweep through the OpTest harness (reference op_test.py
+usage across ~900 unittest files; exemptions mirror unittests/white_list/).
+
+Every case: eager kernel vs numpy reference (when given) AND analytic
+gradient (static append_backward through the registered grad machinery)
+vs central finite differences.  A coverage gate asserts >=80% of the
+registry's grad-bearing ops are swept or explicitly exempted.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from op_test import OpCase, check_grad, check_output, run_eager
+from paddle_tpu.fluid import registry
+
+R = np.random.RandomState
+
+
+def _pos(shape, lo=0.3, hi=1.5, seed=0):
+    return (R(seed).uniform(lo, hi, shape)).astype("float32")
+
+
+def _sym(shape, seed=0, margin=0.25):
+    """Random values bounded away from 0 (kink-free for abs/relu/...)."""
+    r = R(seed)
+    return ((r.uniform(margin, 1.0, shape))
+            * np.where(r.rand(*shape) < 0.5, -1, 1)).astype("float32")
+
+
+def _rnd(shape, seed=0, scale=1.0):
+    return (R(seed).randn(*shape) * scale).astype("float32")
+
+
+CASES: dict[str, OpCase] = {}
+
+
+def case(op, **kw):
+    CASES[op] = OpCase(op, **kw)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise (one generic spec per op; domain chosen kink/domain-safe)
+# ---------------------------------------------------------------------------
+X34 = _sym((3, 4))
+UNARY = {
+    "abs": (np.abs, X34),
+    "exp": (np.exp, _rnd((3, 4))),
+    "log": (np.log, _pos((3, 4))),
+    "log2": (np.log2, _pos((3, 4))),
+    "log10": (np.log10, _pos((3, 4))),
+    "log1p": (np.log1p, _pos((3, 4))),
+    "sqrt": (np.sqrt, _pos((3, 4))),
+    "rsqrt": (lambda x: 1 / np.sqrt(x), _pos((3, 4))),
+    "square": (np.square, _rnd((3, 4))),
+    "reciprocal": (lambda x: 1 / x, _pos((3, 4))),
+    "sin": (np.sin, _rnd((3, 4))),
+    "cos": (np.cos, _rnd((3, 4))),
+    "tan": (np.tan, _rnd((3, 4), scale=0.5)),
+    "sinh": (np.sinh, _rnd((3, 4))),
+    "cosh": (np.cosh, _rnd((3, 4))),
+    "asin": (np.arcsin, _rnd((3, 4), scale=0.4)),
+    "acos": (np.arccos, _rnd((3, 4), scale=0.4)),
+    "atan": (np.arctan, _rnd((3, 4))),
+    "tanh": (np.tanh, _rnd((3, 4))),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), _rnd((3, 4))),
+    "logsigmoid": (lambda x: -np.log1p(np.exp(-x)), _rnd((3, 4))),
+    "relu": (lambda x: np.maximum(x, 0), _sym((3, 4))),
+    "relu6": (lambda x: np.clip(x, 0, 6), _sym((3, 4))),
+    "erf": (None, _rnd((3, 4))),
+    "gelu": (None, _rnd((3, 4))),
+    "silu": (lambda x: x / (1 + np.exp(-x)), _rnd((3, 4))),
+    "softplus": (None, _rnd((3, 4))),
+    "softsign": (lambda x: x / (1 + np.abs(x)), _sym((3, 4))),
+    "mish": (None, _rnd((3, 4))),
+    "swish": (None, _rnd((3, 4))),
+    "elu": (None, _sym((3, 4))),
+    "selu": (None, _sym((3, 4))),
+    "leaky_relu": (None, _sym((3, 4))),
+    "hard_sigmoid": (None, _rnd((3, 4), scale=0.3)),
+    "hard_swish": (None, _sym((3, 4))),
+    "hard_tanh": (None, _rnd((3, 4), scale=0.5)),
+    "hard_shrink": (None, _sym((3, 4), margin=0.6)),
+    "softshrink": (None, _sym((3, 4), margin=0.6)),
+    "tanh_shrink": (lambda x: x - np.tanh(x), _rnd((3, 4))),
+    "thresholded_relu": (None, _sym((3, 4), margin=1.1)),
+    "stanh": (None, _rnd((3, 4))),
+    "sign": (np.sign, _sym((3, 4))),
+    "floor": (np.floor, _sym((3, 4))),
+    "ceil": (np.ceil, _sym((3, 4))),
+    "round": (np.round, _sym((3, 4))),
+}
+for name, (ref, x) in UNARY.items():
+    skip = name in ("sign", "floor", "ceil", "round")  # zero-grad ops
+    case(name, inputs={"X": x},
+         ref=(lambda r: (lambda ins, attrs: {"Out": r(ins["X"])}))(ref)
+         if ref else None,
+         skip_grad=skip, reason="derivative is 0 a.e." if skip else None)
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+A = _rnd((3, 4), 1)
+B_ = _sym((3, 4), 2, margin=0.4)
+AB_APART = (A, np.where(np.abs(A - B_) < 0.2, B_ + 0.5, B_))
+BIN = {
+    "elementwise_add": (lambda a, b: a + b, A, B_),
+    "elementwise_sub": (lambda a, b: a - b, A, B_),
+    "elementwise_mul": (lambda a, b: a * b, A, B_),
+    "elementwise_div": (lambda a, b: a / b, A, B_),
+    "elementwise_max": (np.maximum, *AB_APART),
+    "elementwise_min": (np.minimum, *AB_APART),
+    "maximum": (np.maximum, *AB_APART),
+    "minimum": (np.minimum, *AB_APART),
+    "elementwise_pow": (np.power, _pos((3, 4), seed=3), _pos((3, 4), 4)),
+    "pow": (None, _pos((3, 4)), None),
+    "elementwise_mod": (np.mod, _pos((3, 4), 1.0, 5.0, 5),
+                        _pos((3, 4), 1.0, 2.0, 6)),
+    "elementwise_floordiv": (None, _pos((3, 4), 1.0, 5.0, 5),
+                             _pos((3, 4), 1.0, 2.0, 6)),
+}
+for name, (ref, a, b) in BIN.items():
+    ins = {"X": a} if b is None else {"X": a, "Y": b}
+    skip = name in ("elementwise_mod", "elementwise_floordiv")
+    case(name, inputs=ins,
+         attrs={"factor": 2.0} if name == "pow" else {},
+         ref=(lambda r: (lambda ins, attrs: {
+             "Out": r(ins["X"], ins["Y"])}))(ref) if ref else None,
+         skip_grad=skip,
+         reason="integer-like semantics" if skip else None)
+
+# ---------------------------------------------------------------------------
+# reductions / stats
+# ---------------------------------------------------------------------------
+for name, ref in [("reduce_sum", np.sum), ("reduce_mean", np.mean),
+                  ("reduce_prod", np.prod)]:
+    case(name, inputs={"X": _pos((3, 4), seed=8)}, attrs={"dim": [1]},
+         ref=(lambda r: (lambda ins, attrs: {
+             "Out": r(ins["X"], axis=1)}))(ref), static=True)
+uniq = (np.arange(12, dtype=np.float32).reshape(3, 4)
+        + _rnd((3, 4), 9, 0.1))
+case("reduce_max", inputs={"X": uniq}, attrs={"dim": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"].max(1)})
+case("reduce_min", inputs={"X": uniq}, attrs={"dim": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"].min(1)})
+case("reduce_all", inputs={"X": np.array([[True, False], [True, True]])},
+     attrs={"dim": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"].all(1)}, skip_grad=True,
+     reason="bool op")
+case("reduce_any", inputs={"X": np.array([[True, False], [False, False]])},
+     attrs={"dim": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"].any(1)}, skip_grad=True,
+     reason="bool op")
+case("mean", inputs={"X": _rnd((3, 4), 10)},
+     ref=lambda ins, attrs: {"Out": ins["X"].mean()}, static=True)
+case("cumsum", inputs={"X": _rnd((3, 4), 11)}, attrs={"axis": 1},
+     ref=lambda ins, attrs: {"Out": np.cumsum(ins["X"], 1)})
+case("frobenius_norm", inputs={"X": _pos((3, 4), seed=12)},
+     attrs={"dim": [0, 1], "keep_dim": False, "reduce_all": True},
+     ref=lambda ins, attrs: {"Out": np.sqrt((ins["X"] ** 2).sum())})
+case("p_norm", inputs={"X": _sym((3, 4), 13)},
+     attrs={"porder": 2.0, "axis": 1},
+     ref=lambda ins, attrs: {
+         "Out": np.sqrt((ins["X"] ** 2).sum(1))})
+case("squared_l2_norm", inputs={"X": _rnd((3, 4), 14)},
+     ref=lambda ins, attrs: {"Out": (ins["X"] ** 2).sum()})
+case("clip_by_norm", inputs={"X": _rnd((3, 4), 15)},
+     attrs={"max_norm": 1.0})
+case("clip", inputs={"X": _sym((3, 4), 16)},
+     attrs={"min": -0.8, "max": 0.8},
+     ref=lambda ins, attrs: {"Out": np.clip(ins["X"], -0.8, 0.8)})
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+case("mul", inputs={"X": _rnd((3, 4), 17), "Y": _rnd((4, 5), 18)},
+     ref=lambda ins, attrs: {"Out": ins["X"] @ ins["Y"]}, static=True)
+case("matmul", inputs={"X": _rnd((2, 3, 4), 19), "Y": _rnd((2, 4, 5), 20)},
+     ref=lambda ins, attrs: {"Out": ins["X"] @ ins["Y"]})
+case("matmul_v2",
+     inputs={"X": _rnd((2, 3, 4), 21), "Y": _rnd((2, 5, 4), 22)},
+     attrs={"trans_y": True},
+     ref=lambda ins, attrs: {
+         "Out": ins["X"] @ ins["Y"].transpose(0, 2, 1)})
+case("bmm", inputs={"X": _rnd((2, 3, 4), 23), "Y": _rnd((2, 4, 5), 24)},
+     ref=lambda ins, attrs: {"Out": ins["X"] @ ins["Y"]})
+case("dot", inputs={"X": _rnd((3, 4), 25), "Y": _rnd((3, 4), 26)},
+     # reference keeps the reduced dim: test_dot_op.py DotOpBatch
+     # expects [B, 1]
+     ref=lambda ins, attrs: {
+         "Out": (ins["X"] * ins["Y"]).sum(-1, keepdims=True)})
+case("addmm", inputs={"Input": _rnd((3, 5), 27), "X": _rnd((3, 4), 28),
+                      "Y": _rnd((4, 5), 29)},
+     ref=lambda ins, attrs: {"Out": ins["Input"] + ins["X"] @ ins["Y"]})
+case("kron", inputs={"X": _rnd((2, 3), 30), "Y": _rnd((3, 2), 31)},
+     ref=lambda ins, attrs: {"Out": np.kron(ins["X"], ins["Y"])})
+
+# ---------------------------------------------------------------------------
+# shape / indexing manipulation
+# ---------------------------------------------------------------------------
+case("reshape2", inputs={"X": _rnd((3, 4), 32)}, attrs={"shape": [2, 6]},
+     ref=lambda ins, attrs: {"Out": ins["X"].reshape(2, 6)})
+case("reshape", inputs={"X": _rnd((3, 4), 32)}, attrs={"shape": [12]},
+     ref=lambda ins, attrs: {"Out": ins["X"].reshape(12)})
+case("transpose2", inputs={"X": _rnd((2, 3, 4), 33)},
+     attrs={"axis": [2, 0, 1]},
+     ref=lambda ins, attrs: {"Out": ins["X"].transpose(2, 0, 1)})
+case("transpose", inputs={"X": _rnd((3, 4), 33)}, attrs={"axis": [1, 0]},
+     ref=lambda ins, attrs: {"Out": ins["X"].T})
+case("squeeze2", inputs={"X": _rnd((3, 1, 4), 34)}, attrs={"axes": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"][:, 0]})
+case("squeeze", inputs={"X": _rnd((3, 1, 4), 34)}, attrs={"axes": [1]})
+case("unsqueeze2", inputs={"X": _rnd((3, 4), 35)}, attrs={"axes": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"][:, None]})
+case("unsqueeze", inputs={"X": _rnd((3, 4), 35)}, attrs={"axes": [0]})
+case("flatten_contiguous_range", inputs={"X": _rnd((2, 3, 4), 36)},
+     attrs={"start_axis": 1, "stop_axis": 2},
+     ref=lambda ins, attrs: {"Out": ins["X"].reshape(2, 12)})
+case("flatten", inputs={"X": _rnd((2, 3, 4), 36)}, attrs={"axis": 1})
+case("flatten2", inputs={"X": _rnd((2, 3, 4), 36)}, attrs={"axis": 1})
+case("concat", inputs={"X": [_rnd((2, 3), 37), _rnd((2, 2), 38)]},
+     attrs={"axis": 1},
+     ref=lambda ins, attrs: {
+         "Out": np.concatenate(ins["X"], axis=1)}, static=True)
+case("split", inputs={"X": _rnd((2, 6), 39)}, attrs={"num": 3, "axis": 1},
+     ref=lambda ins, attrs: {"Out": np.split(ins["X"], 3, 1)})
+case("stack", inputs={"X": [_rnd((2, 3), 40), _rnd((2, 3), 41)]},
+     attrs={"axis": 0},
+     ref=lambda ins, attrs: {"Y": np.stack(ins["X"], 0)})
+case("unstack", inputs={"X": _rnd((3, 4), 42)}, attrs={"axis": 0},
+     ref=lambda ins, attrs: {"Y": list(ins["X"])})
+case("unbind", inputs={"X": _rnd((3, 4), 43)}, attrs={"axis": 0})
+case("tile", inputs={"X": _rnd((2, 3), 44)},
+     attrs={"repeat_times": [2, 2]},
+     ref=lambda ins, attrs: {"Out": np.tile(ins["X"], (2, 2))})
+case("expand", inputs={"X": _rnd((1, 3), 45)},
+     attrs={"expand_times": [4, 1]},
+     ref=lambda ins, attrs: {"Out": np.tile(ins["X"], (4, 1))})
+case("expand_v2", inputs={"X": _rnd((1, 3), 45)},
+     attrs={"shape": [4, 3]},
+     ref=lambda ins, attrs: {
+         "Out": np.broadcast_to(ins["X"], (4, 3))})
+case("expand_as_v2",
+     inputs={"X": _rnd((1, 3), 45), "Y": _rnd((4, 3), 46)},
+     grad_slots=["X"])
+case("flip", inputs={"X": _rnd((3, 4), 47)}, attrs={"axis": [1]},
+     ref=lambda ins, attrs: {"Out": ins["X"][:, ::-1]})
+case("roll", inputs={"X": _rnd((3, 4), 48)},
+     attrs={"shifts": [1], "axis": [1]},
+     ref=lambda ins, attrs: {"Out": np.roll(ins["X"], 1, 1)})
+case("pad", inputs={"X": _rnd((2, 3), 49)},
+     attrs={"paddings": [1, 1, 0, 2], "pad_value": 0.5},
+     ref=lambda ins, attrs: {"Out": np.pad(
+         ins["X"], [(1, 1), (0, 2)], constant_values=0.5)})
+case("pad2d", inputs={"X": _rnd((1, 2, 3, 3), 50)},
+     attrs={"paddings": [1, 1, 1, 1], "mode": "constant"})
+case("slice", inputs={"Input": _rnd((3, 6), 51)},
+     attrs={"axes": [1], "starts": [1], "ends": [4]},
+     ref=lambda ins, attrs: {"Out": ins["Input"][:, 1:4]})
+case("strided_slice", inputs={"Input": _rnd((3, 8), 52)},
+     attrs={"axes": [1], "starts": [0], "ends": [8], "strides": [2]},
+     ref=lambda ins, attrs: {"Out": ins["Input"][:, ::2]})
+case("gather", inputs={"X": _rnd((5, 3), 53),
+                       "Index": np.array([0, 2, 2, 4])},
+     ref=lambda ins, attrs: {"Out": ins["X"][ins["Index"]]})
+case("gather_nd", inputs={"X": _rnd((3, 4), 54),
+                          "Index": np.array([[0, 1], [2, 3]])},
+     ref=lambda ins, attrs: {"Out": ins["X"][[0, 2], [1, 3]]})
+case("index_select", inputs={"X": _rnd((5, 3), 55),
+                             "Index": np.array([1, 1, 3])},
+     attrs={"dim": 0},
+     ref=lambda ins, attrs: {"Out": ins["X"][[1, 1, 3]]})
+case("index_sample", inputs={"X": _rnd((3, 5), 56),
+                             "Index": np.array([[0, 2], [1, 1], [4, 0]])},
+     ref=lambda ins, attrs: {"Out": np.take_along_axis(
+         ins["X"], ins["Index"], 1)})
+case("scatter", inputs={"X": _rnd((5, 3), 57),
+                        "Ids": np.array([1, 3]),
+                        "Updates": _rnd((2, 3), 58)},
+     attrs={"overwrite": True})
+case("scatter_nd_add", inputs={"X": _rnd((5, 3), 59),
+                               "Index": np.array([[1], [3]]),
+                               "Updates": _rnd((2, 3), 60)})
+case("where", inputs={"Condition": np.array([[True, False],
+                                             [False, True]]),
+                      "X": _rnd((2, 2), 61), "Y": _rnd((2, 2), 62)},
+     ref=lambda ins, attrs: {"Out": np.where(
+         ins["Condition"], ins["X"], ins["Y"])})
+case("masked_fill", inputs={"X": _rnd((2, 3), 63),
+                            "Mask": np.array([[True, False, True],
+                                              [False, True, False]])},
+     attrs={"value": 9.0})
+case("tril_triu", inputs={"X": _rnd((4, 4), 64)},
+     attrs={"diagonal": 0, "lower": True},
+     ref=lambda ins, attrs: {"Out": np.tril(ins["X"])})
+case("diag_v2", inputs={"X": _rnd((4,), 65)},
+     attrs={"offset": 0, "padding_value": 0.0},
+     ref=lambda ins, attrs: {"Out": np.diag(ins["X"])})
+case("meshgrid", inputs={"X": [_rnd((3,), 66), _rnd((4,), 67)]})
+case("top_k_v2", inputs={"X": uniq}, attrs={"k": 2, "axis": 1},
+     ref=lambda ins, attrs: {
+         "Out": np.sort(ins["X"], 1)[:, ::-1][:, :2]})
+case("top_k", inputs={"X": uniq}, attrs={"k": 2})
+case("cast", inputs={"X": _rnd((3, 4), 68)},
+     attrs={"in_dtype": "float32", "out_dtype": "float32"})
+case("scale", inputs={"X": _rnd((3, 4), 69)},
+     attrs={"scale": 2.0, "bias": 1.0},
+     ref=lambda ins, attrs: {"Out": 2 * ins["X"] + 1}, static=True)
+case("lerp", inputs={"X": _rnd((3, 4), 70), "Y": _rnd((3, 4), 71),
+                     "Weight": _pos((3, 4), 0.1, 0.9, 72)},
+     ref=lambda ins, attrs: {"Out": ins["X"] + ins["Weight"]
+                             * (ins["Y"] - ins["X"])})
+case("increment", inputs={"X": np.array([2.0], "float32")},
+     attrs={"step": 1.0},
+     ref=lambda ins, attrs: {"Out": ins["X"] + 1})
+case("assign", inputs={"X": _rnd((3, 4), 73)},
+     ref=lambda ins, attrs: {"Out": ins["X"]})
+case("label_smooth",
+     inputs={"X": np.eye(3, dtype=np.float32)},
+     attrs={"epsilon": 0.1},
+     ref=lambda ins, attrs: {"Out": 0.9 * ins["X"] + 0.1 / 3})
+
+# ---------------------------------------------------------------------------
+# losses / nn
+# ---------------------------------------------------------------------------
+LOGITS = _rnd((4, 5), 80)
+LABELS = np.array([[1], [0], [4], [2]], "int64")
+case("softmax", inputs={"X": LOGITS}, attrs={"axis": -1},
+     ref=lambda ins, attrs: {"Out": np.exp(ins["X"]) / np.exp(
+         ins["X"]).sum(-1, keepdims=True)}, static=True)
+case("log_softmax", inputs={"X": LOGITS}, attrs={"axis": -1})
+case("softmax_with_cross_entropy",
+     inputs={"Logits": LOGITS, "Label": LABELS}, static=True)
+case("cross_entropy",
+     inputs={"X": _pos((4, 5), 0.05, 0.9, 81)
+             / _pos((4, 5), 0.05, 0.9, 81).sum(-1, keepdims=True),
+             "Label": LABELS})
+case("bce_loss", inputs={"X": _pos((3, 4), 0.1, 0.9, 82),
+                         "Label": (R(83).rand(3, 4) < 0.5)
+                         .astype("float32")},
+     grad_slots=["X"])
+case("sigmoid_cross_entropy_with_logits",
+     inputs={"X": _rnd((3, 4), 84),
+             "Label": (R(85).rand(3, 4) < 0.5).astype("float32")},
+     grad_slots=["X"])
+case("nll_loss", inputs={"X": np.log(_pos((4, 5), 0.1, 0.9, 86)),
+                         "Label": LABELS.ravel()},
+     grad_slots=["X"])
+case("kldiv_loss", inputs={"X": np.log(_pos((3, 4), 0.1, 0.9, 87)),
+                           "Target": _pos((3, 4), 0.1, 0.9, 88)},
+     attrs={"reduction": "mean"}, grad_slots=["X"])
+case("huber_loss", inputs={"X": _rnd((3, 1), 89), "Y": _rnd((3, 1), 90)},
+     attrs={"delta": 1.0})
+case("smooth_l1_loss", inputs={"X": _rnd((3, 4), 91),
+                               "Y": _rnd((3, 4), 92)},
+     grad_slots=["X"])
+case("mse_loss", inputs={"X": _rnd((3, 4), 93), "Y": _rnd((3, 4), 94)})
+case("squared_error_cost", inputs={"X": _rnd((3, 1), 95),
+                                   "Y": _rnd((3, 1), 96)})
+case("lookup_table_v2", inputs={"W": _rnd((10, 4), 97),
+                                "Ids": np.array([[1, 2], [3, 1]])},
+     ref=lambda ins, attrs: {"Out": ins["W"][ins["Ids"]]})
+case("lookup_table", inputs={"W": _rnd((10, 4), 97),
+                             "Ids": np.array([[1], [3]], "int64")})
+case("conv2d", inputs={"Input": _rnd((2, 3, 6, 6), 98),
+                       "Filter": _rnd((4, 3, 3, 3), 99, 0.3)},
+     attrs={"strides": [1, 1], "paddings": [1, 1]}, static=True,
+     grad_atol=1e-2, grad_rtol=1e-2)
+case("depthwise_conv2d", inputs={"Input": _rnd((1, 4, 5, 5), 100),
+                                 "Filter": _rnd((4, 1, 3, 3), 101, 0.3)},
+     attrs={"strides": [1, 1], "paddings": [1, 1], "groups": 4},
+     grad_atol=1e-2, grad_rtol=1e-2)
+def _conv_transpose_ref(ins, attrs):
+    import torch
+    import torch.nn.functional as TF
+    r = TF.conv_transpose2d(
+        torch.from_numpy(ins["Input"].copy()),
+        torch.from_numpy(ins["Filter"].copy()),
+        stride=attrs["strides"], padding=attrs["paddings"][0],
+        output_padding=(attrs.get("output_padding") or [0])[0],
+        groups=attrs.get("groups", 1),
+        dilation=attrs.get("dilations", [1, 1]))
+    return {"Output": r.numpy()}
+
+
+case("conv2d_transpose", inputs={"Input": _rnd((1, 3, 4, 4), 102),
+                                 "Filter": _rnd((3, 2, 3, 3), 103, 0.3)},
+     attrs={"strides": [2, 2], "paddings": [0, 0]},
+     ref=_conv_transpose_ref, grad_atol=1e-2, grad_rtol=1e-2)
+# grouped + padded + output_padding variant (review regression: groups and
+# output_padding were silently ignored)
+CASES["conv2d_transpose_grouped"] = OpCase(
+    "conv2d_transpose",
+    inputs={"Input": _rnd((2, 4, 5, 5), 124),
+            "Filter": _rnd((4, 3, 3, 3), 125, 0.3)},
+    attrs={"strides": [2, 2], "paddings": [1, 1], "groups": 2,
+           "output_padding": [1, 1], "dilations": [1, 1]},
+    ref=_conv_transpose_ref, grad_atol=1e-2, grad_rtol=1e-2)
+case("pool2d", inputs={"X": _rnd((1, 2, 4, 4), 104)},
+     attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]})
+case("layer_norm", inputs={"X": _rnd((3, 8), 105),
+                           "Scale": _pos((8,), seed=106),
+                           "Bias": _rnd((8,), 107)},
+     attrs={"begin_norm_axis": 1})
+case("group_norm", inputs={"X": _rnd((2, 4, 3, 3), 108),
+                           "Scale": _pos((4,), seed=109),
+                           "Bias": _rnd((4,), 110)},
+     attrs={"groups": 2})
+case("instance_norm", inputs={"X": _rnd((2, 3, 4, 4), 111),
+                              "Scale": _pos((3,), seed=112),
+                              "Bias": _rnd((3,), 113)})
+case("batch_norm", inputs={"X": _rnd((4, 3, 2, 2), 114),
+                           "Scale": _pos((3,), seed=115),
+                           "Bias": _rnd((3,), 116),
+                           "Mean": np.zeros(3, "float32"),
+                           "Variance": np.ones(3, "float32")},
+     attrs={"is_test": True, "use_global_stats": True},
+     grad_slots=["X", "Scale", "Bias"])
+case("interp_nearest", inputs={"X": _rnd((1, 2, 3, 3), 117)},
+     attrs={"out_h": 6, "out_w": 6, "data_layout": "NCHW"})
+case("dropout", inputs={"X": _pos((4, 4), seed=118)},
+     attrs={"dropout_prob": 0.0},
+     ref=lambda ins, attrs: {"Out": ins["X"]},
+     skip_grad=True, reason="stochastic (p=0 output identity checked)")
+case("segment_pool", inputs={"X": _rnd((4, 3), 119),
+                             "SegmentIds": np.array([0, 0, 1, 1])},
+     attrs={"pooltype": "SUM", "num_segments": 2})
+case("sequence_pool", inputs={"X": _rnd((2, 3, 2), 120),
+                              "Length": np.array([2, 3])},
+     attrs={"pooltype": "AVERAGE"})
+case("sequence_softmax", inputs={"X": _rnd((2, 4), 121),
+                                 "Length": np.array([2, 4])})
+case("sequence_reverse", inputs={"X": _rnd((2, 4, 2), 122),
+                                 "Length": np.array([3, 4])})
+case("sequence_pad", inputs={"X": _rnd((5, 2), 123),
+                             "Length": np.array([2, 3])},
+     attrs={"padded_length": 4})
+
+# ---------------------------------------------------------------------------
+# exemptions (reference unittests/white_list/ spirit): ops whose gradient
+# path is exercised elsewhere or that have no meaningful numeric check
+# ---------------------------------------------------------------------------
+EXEMPT = {
+    # collectives: need a mesh axis; covered by tests/test_data_parallel,
+    # test_hybrid_parallel, fixtures/dist_worker
+    "c_allgather", "c_allreduce_max", "c_allreduce_min", "c_allreduce_sum",
+    "c_broadcast", "c_concat", "c_identity", "c_reducescatter", "c_split",
+    # control flow: sub-block semantics; covered by tests/test_backward +
+    # test_executor control-flow tests
+    "cond", "while",
+    # full-network ops covered by dedicated suites
+    "rnn",              # tests/test_sequence_rnn (masking/parity/grad)
+    "fused_attention",  # tests/test_pallas_kernels + test_transformer_bert
+    # debug/identity
+    "print",
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_case(name):
+    c = CASES[name]
+    check_output(c)
+    opdef = registry.require(c.op)
+    if opdef.grad is None or c.skip_grad:
+        return
+    check_grad(c)
+
+
+def test_sweep_coverage():
+    """>=80% of grad-bearing registered ops are swept or exempted with a
+    reason (VERDICT r2 task 6)."""
+    gb = {k for k, v in registry._REGISTRY.items()
+          if v.grad is not None and not k.endswith("_grad")}
+    covered = (set(CASES) | EXEMPT) & gb
+    missing = sorted(gb - covered)
+    ratio = len(covered) / len(gb)
+    assert ratio >= 0.8, (
+        f"op sweep covers {ratio:.0%} of {len(gb)} grad-bearing ops; "
+        f"missing: {missing}")
