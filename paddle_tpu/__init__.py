@@ -69,3 +69,13 @@ def in_dynamic_mode():
 
 # commonly used aliases at top level (reference python/paddle/__init__.py)
 version = __version__
+
+
+def __getattr__(name):
+    if name == "Model":  # lazy: hapi pulls in io/callbacks
+        from .hapi import Model
+        return Model
+    if name == "hapi":
+        from . import hapi
+        return hapi
+    raise AttributeError(name)

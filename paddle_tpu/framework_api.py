@@ -91,16 +91,8 @@ def is_grad_enabled():
 
 def summary(net, input_size=None, dtypes=None, input=None):
     """Model summary (reference hapi/model_summary.py)."""
-    rows = []
-    total = 0
-    for name, p in net.named_parameters():
-        n = int(np.prod(p.shape))
-        total += n
-        rows.append(f"  {name:40s} {str(tuple(p.shape)):20s} {n}")
-    txt = "\n".join(["-" * 75] + rows +
-                    ["-" * 75, f"Total params: {total}"])
-    print(txt)
-    return {"total_params": total}
+    from .hapi.summary import summary as _hapi_summary
+    return _hapi_summary(net, input_size, dtypes)
 
 
 def flops(net, input_size, custom_ops=None, print_detail=False):

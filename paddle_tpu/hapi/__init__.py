@@ -1,0 +1,6 @@
+"""paddle.hapi — high-level Model API (reference python/paddle/hapi/)."""
+from . import callbacks
+from .model import Model
+from .summary import summary
+
+__all__ = ["Model", "callbacks", "summary"]

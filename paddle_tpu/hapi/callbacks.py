@@ -1,0 +1,179 @@
+"""hapi callbacks (reference python/paddle/hapi/callbacks.py):
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler."""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model=None):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_train_begin(self, logs=None):
+        self._call("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        self._call("on_train_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._call("on_train_batch_begin", step, logs)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._call("on_train_batch_end", step, logs)
+
+
+class ProgBarLogger(Callback):
+    """Prints step metrics every `log_freq` steps + an epoch summary."""
+
+    def __init__(self, log_freq=10, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = 0
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        self.steps += 1
+        self._last = logs or {}
+        if self.verbose >= 2 and self.log_freq and \
+                (step + 1) % self.log_freq == 0:
+            msg = " - ".join(f"{k}: {float(v):.4f}"
+                             for k, v in (logs or {}).items()
+                             if np.isscalar(v))
+            print(f"Epoch {self.epoch} step {step + 1}: {msg}",
+                  flush=True)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            flat = {}
+            for k, v in (logs or {}).items():
+                if isinstance(v, dict):
+                    flat.update({f"eval_{k2}": v2 for k2, v2 in v.items()})
+                elif np.isscalar(v):
+                    flat[k] = v
+            msg = " - ".join(f"{k}: {float(v):.4f}"
+                             for k, v in flat.items())
+            print(f"Epoch {epoch} done ({self.steps} steps, {dt:.1f}s): "
+                  f"{msg}", flush=True)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir="checkpoint"):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (epoch + 1) % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="min", patience=0,
+                 min_delta=0.0, baseline=None, save_best_model=False,
+                 save_dir="best_model"):
+        super().__init__()
+        self.monitor = monitor
+        self.sign = -1.0 if mode == "min" else 1.0
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.best = baseline
+        self.wait = 0
+        self.save_best_model = save_best_model
+        self.save_dir = save_dir
+
+    def _value(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if v is None and isinstance((logs or {}).get("eval"), dict):
+            v = logs["eval"].get(self.monitor)
+        return v
+
+    def on_epoch_end(self, epoch, logs=None):
+        v = self._value(logs)
+        if v is None:
+            return
+        if self.best is None or \
+                self.sign * (v - self.best) > self.min_delta:
+            self.best = v
+            self.wait = 0
+            if self.save_best_model:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    """Steps an lr scheduler attached to the optimizer each epoch."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        lr = getattr(self.model._optimizer, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s:
+            s.step()

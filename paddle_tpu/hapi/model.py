@@ -1,0 +1,255 @@
+"""hapi Model — high-level fit/evaluate/predict
+(reference python/paddle/hapi/model.py:788 fit, :1243 evaluate, :1443
+predict, :1539 save).
+
+One code path serves dygraph networks: train_batch runs the eager tape
+(every op kernel is a jax fn, so XLA still fuses the per-op graphs), and
+`prepare` wires a 2.0 optimizer + loss + paddle.metric metrics. Callbacks
+mirror hapi/callbacks.py (ProgBarLogger, ModelCheckpoint, EarlyStopping).
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..fluid.dygraph.varbase import Tensor
+
+__all__ = ["Model"]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x), stop_gradient=True)
+
+
+def _as_batch_list(data):
+    return list(data) if isinstance(data, (list, tuple)) else [data]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- setup ----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = metrics or []
+        self._metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        return self
+
+    # -- per-batch ------------------------------------------------------
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        ins = [_to_tensor(v) for v in _as_batch_list(inputs)]
+        outs = self.network(*ins)
+        outs_l = _as_batch_list(outs)
+        metrics = {}
+        if labels is not None:
+            labs = [_to_tensor(v) for v in _as_batch_list(labels)]
+            loss = self._loss(*outs_l, *labs) if self._loss else outs_l[0]
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            metrics["loss"] = float(np.ravel(loss.numpy())[0])
+            self._update_metrics(outs_l, labs, metrics)
+        return metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..fluid.dygraph.base import no_grad
+        with no_grad():
+            ins = [_to_tensor(v) for v in _as_batch_list(inputs)]
+            outs = _as_batch_list(self.network(*ins))
+            metrics = {}
+            if labels is not None:
+                labs = [_to_tensor(v) for v in _as_batch_list(labels)]
+                if self._loss:
+                    loss = self._loss(*outs, *labs)
+                    metrics["loss"] = float(np.ravel(loss.numpy())[0])
+                self._update_metrics(outs, labs, metrics)
+        return metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..fluid.dygraph.base import no_grad
+        with no_grad():
+            ins = [_to_tensor(v) for v in _as_batch_list(inputs)]
+            outs = _as_batch_list(self.network(*ins))
+        return [o.numpy() for o in outs]
+
+    def _update_metrics(self, outs, labs, metrics):
+        for m in self._metrics:
+            r = m.compute(*outs, *labs)
+            m.update(*[np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                       for v in _as_batch_list(r)])
+            names, vals = m.name(), m.accumulate()
+            if isinstance(names, (list, tuple)):  # e.g. Accuracy topk
+                for k, v in zip(names, _as_batch_list(vals)):
+                    metrics[k] = v
+            else:
+                metrics[names] = vals
+
+    # -- loops ----------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle):
+        from ..io import DataLoader
+        if data is None or hasattr(data, "batch_sampler") or \
+                hasattr(data, "__next__"):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, shuffle=True, callbacks=None):
+        from .callbacks import CallbackList, ModelCheckpoint, ProgBarLogger
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose=verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint)
+                                for c in cbs):
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        if hasattr(loader, "__next__"):
+            # one-shot iterator: materialise so every epoch sees data
+            # (else epochs after the first would silently train nothing)
+            loader = list(loader)
+        cblist = CallbackList(cbs, model=self)
+        self.stop_training = False
+        cblist.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            n_batches = 0
+            for step, batch in enumerate(loader):
+                ins, labs = self._split_batch(batch)
+                cblist.on_train_batch_begin(step)
+                logs = self.train_batch(ins, labs)
+                cblist.on_train_batch_end(step, logs)
+                n_batches += 1
+            if n_batches == 0:
+                raise ValueError("fit() got an empty data source")
+            logs = dict(logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs["eval"] = self.evaluate(eval_data, batch_size,
+                                             verbose=0)
+            cblist.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cblist.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 callbacks=None):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        n = 0
+        loss_sum = 0.0
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            logs = self.eval_batch(ins, labs)
+            if "loss" in logs:
+                bs = len(np.asarray(
+                    ins[0].numpy() if hasattr(ins[0], "numpy")
+                    else ins[0]))  # sample-weighted mean: a partial tail
+                # batch must not be overweighted
+                loss_sum += logs["loss"] * bs
+                n += bs
+        if n:
+            logs["loss"] = loss_sum / n
+        if verbose:
+            print("Eval:", {k: round(float(v), 4)
+                            for k, v in logs.items()})
+        return logs
+
+    def predict(self, test_data, batch_size=1, stack_outputs=False,
+                callbacks=None):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outs = []
+        for batch in loader:
+            # labeled datasets work too: trailing label slots are split
+            # off and ignored (reference predict honors the _labels spec)
+            ins, _ = self._split_batch(batch)
+            outs.append(self.predict_batch(ins))
+        if not outs:
+            return []
+        n_out = len(outs[0])
+        per_slot = [[b[i] for b in outs] for i in range(n_out)]
+        if stack_outputs:
+            per_slot = [np.concatenate(s, axis=0) for s in per_slot]
+        return per_slot
+
+    def _split_batch(self, batch, has_label=True):
+        batch = _as_batch_list(batch)
+        if not has_label or len(batch) == 1:
+            return batch, None
+        # the inputs/labels specs passed to Model(...) take precedence;
+        # otherwise convention (reference model.py _update_inputs):
+        # inputs first, one label last
+        if self._inputs is not None:
+            n_in = len(_as_batch_list(self._inputs))
+            return batch[:n_in], (batch[n_in:] or None)
+        n_lab = len(_as_batch_list(self._labels)) if self._labels else 1
+        return batch[:-n_lab], batch[-n_lab:]
+
+    # -- save/load ------------------------------------------------------
+    def save(self, path, training=True):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        state = self.network.state_dict()
+        np.savez(path + ".pdparams",
+                 **{k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                    for k, v in state.items()})
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            opt = self._optimizer.state_dict()
+            import json
+            arrs = {k: np.asarray(v) for k, v in opt.items()
+                    if v is not None and not isinstance(v, dict)}
+            dicts = {k: v for k, v in opt.items() if isinstance(v, dict)}
+            if dicts:  # e.g. LR_Scheduler state
+                arrs["__json__"] = np.frombuffer(
+                    json.dumps(dicts).encode(), dtype=np.uint8)
+            np.savez(path + ".pdopt", **arrs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        blob = np.load(path + ".pdparams.npz", allow_pickle=False)
+        state = self.network.state_dict()
+        missing = [k for k in state if k not in blob.files]
+        if missing and not skip_mismatch:
+            raise KeyError(f"parameters {missing[:5]} missing from {path}")
+        self.network.set_state_dict(
+            {k: blob[k] for k in blob.files})
+        opt_path = path + ".pdopt.npz"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path) and \
+                hasattr(self._optimizer, "set_state_dict"):
+            oblob = np.load(opt_path, allow_pickle=False)
+            sd = {k: oblob[k] for k in oblob.files if k != "__json__"}
+            if "__json__" in oblob.files:
+                import json
+                sd.update(json.loads(bytes(oblob["__json__"]).decode()))
+            self._optimizer.set_state_dict(sd)
+        return self
+
+    # -- introspection --------------------------------------------------
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size)

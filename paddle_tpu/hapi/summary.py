@@ -1,0 +1,44 @@
+"""Model summary (reference python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None):
+    """Print a per-layer parameter table; returns
+    {'total_params': N, 'trainable_params': M}."""
+    rows = []
+    total = trainable = 0
+    seen: set[int] = set()  # tied/shared params count once
+
+    def tally(name, layer):
+        nonlocal total, trainable
+        own = [p for p in layer._parameters.values() if p is not None]
+        fresh = [p for p in own if id(p) not in seen]
+        seen.update(id(p) for p in fresh)
+        if not own:
+            return
+        n = sum(int(np.prod(p.shape)) for p in fresh)
+        trainable_n = sum(int(np.prod(p.shape)) for p in fresh
+                          if p.trainable)
+        shapes = ", ".join(str(tuple(p.shape)) for p in own)
+        tag = "" if len(fresh) == len(own) else " (shared)"
+        rows.append((name, type(layer).__name__ + tag, shapes, n))
+        total += n
+        trainable += trainable_n
+
+    tally("(root)", net)
+    for name, layer in net.named_sublayers():
+        tally(name, layer)
+    if rows and rows[0][3] == 0 and rows[0][0] == "(root)":
+        rows.pop(0)
+    w = max([len(r[0]) for r in rows] + [10])
+    print(f"{'Layer':<{w}}  {'Type':<18} {'Param shapes':<32} {'#Params'}")
+    print("-" * (w + 62))
+    for name, ty, shapes, n in rows:
+        print(f"{name:<{w}}  {ty:<18} {shapes[:32]:<32} {n}")
+    print("-" * (w + 62))
+    print(f"Total params: {total}  Trainable: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -27,10 +27,13 @@ class _SyntheticImages(Dataset):
         self.mode = mode
         self.transform = transform
         self.n = n or (512 if mode == "train" else 128)
-        rng = np.random.RandomState(42 if mode == "train" else 43)
+        # class patterns are split-independent (train and test draw from
+        # the SAME distribution; only sampling differs) — else eval
+        # accuracy is chance by construction
+        base = np.random.RandomState(42).randn(
+            self.n_classes, *self.shape).astype("float32")
+        rng = np.random.RandomState(7 if mode == "train" else 8)
         self.labels = rng.randint(0, self.n_classes, self.n).astype("int64")
-        # class-dependent means so models can actually learn
-        base = rng.randn(self.n_classes, *self.shape).astype("float32")
         noise = rng.randn(self.n, *self.shape).astype("float32") * 0.3
         self.images = base[self.labels] + noise
 

@@ -1,0 +1,163 @@
+"""hapi Model.fit/evaluate/predict + callbacks + summary (reference
+python/paddle/hapi/model.py:788,1243,1443, python/paddle/tests/
+test_model.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model, callbacks, summary
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+
+
+class _SepDataset(Dataset):
+    """Linearly separable 4-class set (book-test style convergence).
+    Prototypes are fixed (seed only varies sampling) so train/test share
+    the distribution."""
+
+    def __init__(self, n=256, dim=16, classes=4, seed=0):
+        self.protos = (np.random.RandomState(42)
+                       .randn(classes, dim).astype("float32") * 3)
+        rng = np.random.RandomState(seed)
+        self.labels = rng.randint(0, classes, n).astype("int64")
+        self.x = (self.protos[self.labels]
+                  + rng.randn(n, dim).astype("float32") * 0.3)
+
+    def __getitem__(self, i):
+        return self.x[i], np.array([self.labels[i]], "int64")
+
+    def __len__(self):
+        return len(self.labels)
+
+
+def _mlp(dim=16, classes=4):
+    return nn.Sequential(nn.Linear(dim, 32), nn.ReLU(),
+                         nn.Linear(32, classes))
+
+
+def _prepared_model():
+    net = _mlp()
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+def test_fit_evaluate_predict(capsys):
+    train = _SepDataset(seed=0)
+    test = _SepDataset(n=64, seed=1)
+    model = _prepared_model()
+    model.fit(train, epochs=2, batch_size=32, log_freq=4, verbose=2)
+    out = capsys.readouterr().out
+    assert "Epoch 0" in out and "loss" in out  # ProgBarLogger printed
+    ev = model.evaluate(test, batch_size=32, verbose=0)
+    assert ev["acc"] > 0.9, ev
+    preds = model.predict(test, batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (64, 4)
+    acc = (np.argmax(preds[0], 1) == test.labels).mean()
+    assert acc > 0.9
+
+
+def test_model_checkpoint_and_load(tmp_path):
+    train = _SepDataset(n=64)
+    model = _prepared_model()
+    model.fit(train, epochs=2, batch_size=32, verbose=0,
+              save_dir=str(tmp_path))
+    assert (tmp_path / "final.pdparams.npz").exists()
+    assert (tmp_path / "1.pdparams.npz").exists()
+    # fresh model + load = same predictions
+    model2 = _prepared_model()
+    model2.load(str(tmp_path / "final"))
+    x = _SepDataset(n=8, seed=3)
+    p1 = model.predict(x, batch_size=8, stack_outputs=True)[0]
+    p2 = model2.predict(x, batch_size=8, stack_outputs=True)[0]
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_early_stopping():
+    train = _SepDataset(n=64)
+    model = _prepared_model()
+    es = callbacks.EarlyStopping(monitor="loss", patience=0,
+                                 baseline=-1.0)  # nothing beats baseline
+    model.fit(train, epochs=10, batch_size=32, verbose=0, callbacks=[es])
+    assert model.stop_training  # stopped well before 10 epochs
+
+
+def test_summary_counts():
+    net = _mlp()
+    info = summary(net)
+    # 16*32+32 + 32*4+4 = 676
+    assert info["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]
+    m = Model(net)
+    assert m.summary()["total_params"] == info["total_params"]
+
+
+def test_lenet_fit_convergence():
+    """LeNet through Model.fit on synthetic MNIST (reference
+    tests/test_model.py LeNet path)."""
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    train = MNIST(mode="train")
+    net = LeNet()
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=3e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(train, epochs=3, batch_size=64, verbose=0)
+    ev = model.evaluate(MNIST(mode="test"), batch_size=64, verbose=0)
+    assert ev["acc"] > 0.85, ev
+
+
+def test_bert_finetune_through_fit():
+    """BERT fine-tune (tiny) through Model.fit — encoder + classifier
+    head; loss decreases on a token-signal classification set."""
+    from paddle_tpu.models.bert import BertConfig, BertModel
+
+    cfg = BertConfig.tiny()
+
+    class BertCls(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bert = BertModel(cfg)
+            self.cls = nn.Linear(cfg.hidden_size, 2)
+
+        def forward(self, ids):
+            seq, pooled = self.bert(ids)
+            return self.cls(pooled)
+
+    class DS(Dataset):
+        def __init__(self, n=96, seed=0):
+            rng = np.random.RandomState(seed)
+            self.labels = rng.randint(0, 2, n).astype("int64")
+            ids = rng.randint(4, cfg.vocab_size, (n, 24))
+            sig = rng.randint(4, 100, (n, 24))
+            mask = rng.rand(n, 24) < 0.3
+            ids = np.where(mask, sig + 200 * self.labels[:, None], ids)
+            self.ids = ids.astype("int64")
+
+        def __getitem__(self, i):
+            return self.ids[i], np.array([self.labels[i]], "int64")
+
+        def __len__(self):
+            return len(self.labels)
+
+    net = BertCls()
+    model = Model(net)
+    model.prepare(paddle.optimizer.AdamW(learning_rate=5e-4,
+                                         parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    losses = []
+
+    class Rec(callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            losses.append(logs["loss"])
+
+    model.fit(DS(), epochs=3, batch_size=32, verbose=0, callbacks=[Rec()])
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.8, losses

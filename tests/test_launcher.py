@@ -99,14 +99,18 @@ def test_fleetrun_ps_mode_env(tmp_path):
     p1, p2, p3 = _free_port(), _free_port(), _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    logd = tmp_path / "logs"
     res = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.fleet.launch",
          f"--servers=127.0.0.1:{p1}",
          f"--workers=127.0.0.1:{p2},127.0.0.1:{p3}",
-         str(probe)],
+         "--log_dir", str(logd), str(probe)],
         env=env, capture_output=True, text=True, timeout=60,
         cwd=str(tmp_path))
     assert res.returncode == 0, res.stderr
-    out = res.stdout
-    assert "PSERVER 0" in out
-    assert out.count("TRAINER") == 2
+    # per-child log files (concurrent children interleave a shared stdout)
+    logs = {f: open(logd / f).read() for f in os.listdir(logd)}
+    assert "PSERVER 0" in logs["server.0.log"]
+    workers = [v for k, v in logs.items() if k.startswith("worker.")]
+    assert len(workers) == 2
+    assert all("TRAINER" in w for w in workers)
