@@ -15,7 +15,8 @@ The reference publishes no in-repo numbers (BASELINE.md), so vs_baseline is
 Configs (BENCH_CONFIG=...): bert_base (default, seq 128; also records the
 secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
 | bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | widedeep |
-flash_attn (pallas-vs-jnp microbench) | allreduce.
+infer (BERT predictor latency) | flash_attn (pallas-vs-jnp microbench) |
+allreduce.
 """
 from __future__ import annotations
 
@@ -316,6 +317,44 @@ def bench_widedeep(batch=4096, steps=20, warmup=3):
             "slots": cfg.num_slots}
 
 
+def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
+    """BERT-base inference latency through the Predictor (analysis
+    predictor parity path): save -> load -> timed run()."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.static import InputSpec
+
+    cfg = BertConfig.base()
+    model = BertForPretraining(cfg)
+    model.eval()
+    d = tempfile.mkdtemp()
+    try:
+        paddle.jit.save(model, d,
+                        input_spec=[InputSpec([-1, seq], "int64", "ids")])
+        c = Config(model_dir=d)
+        c.enable_bf16()
+        pred = Predictor(c)
+        ids = np.random.RandomState(0).randint(
+            4, cfg.vocab_size, (batch, seq)).astype("int64")
+        for _ in range(warmup):
+            out = pred.run([ids])
+        _sync(out[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = pred.run([ids])
+        _sync(out[0])
+        dt = (time.perf_counter() - t0) / steps
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return {"metric": "bert_base_infer_latency_ms",
+            "value": round(dt * 1e3, 3), "unit": "ms", "batch": batch,
+            "seq": seq}
+
+
 def bench_allreduce(mb=64, steps=30, warmup=5):
     """Achieved allreduce bandwidth over the device mesh (BASELINE config 2
     companion metric). Algorithmic bandwidth: 2·(n-1)/n · bytes / time."""
@@ -367,6 +406,8 @@ def main():
         rec = bench_resnet50()
     elif which == "widedeep":
         rec = bench_widedeep()
+    elif which == "infer":
+        rec = bench_infer_latency()
     else:
         # batch 32 is the measured sweet spot on v5e (24.1% MFU; batch 64
         # regresses to 18.6% — memory pressure)
@@ -383,6 +424,8 @@ def main():
                     ("resnet50", lambda: bench_resnet50(steps=8, warmup=2)),
                     ("widedeep", lambda: bench_widedeep(steps=10,
                                                         warmup=2)),
+                    ("infer_latency",
+                     lambda: bench_infer_latency(steps=15, warmup=3)),
                     ("flash_attn", bench_flash_attn),
             ]:
                 try:

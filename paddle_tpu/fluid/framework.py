@@ -260,10 +260,18 @@ class Operator:
     __str__ = __repr__
 
 
+def _name_of(x) -> str:
+    # Variables AND eager Tensors (jit.save's static re-trace passes layer
+    # params as eager Tensors) resolve by their .name; str(x) would embed
+    # the whole repr as the "name"
+    n = getattr(x, "name", None)
+    return n if isinstance(n, str) else str(x)
+
+
 def _as_name_list(v) -> list[str]:
     if isinstance(v, (list, tuple)):
-        return [x.name if isinstance(x, Variable) else str(x) for x in v]
-    return [v.name if isinstance(v, Variable) else str(v)]
+        return [_name_of(x) for x in v]
+    return [_name_of(v)]
 
 
 # ---------------------------------------------------------------------------

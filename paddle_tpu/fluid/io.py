@@ -95,6 +95,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     from .proto import serialize_program
     program = main_program or default_main_program()
     program = program.clone(for_test=True)
+    # prune to the feed->fetch slice (reference framework/prune.h via
+    # io.py:1164): ops outside the path — e.g. the loss/metric branch
+    # reading labels — must not survive into the deployed model
+    from .executor import _prune_to_fetch
+    gb = program.global_block()
+    keep = _prune_to_fetch(program, [v.name for v in target_vars])
+    gb.ops[:] = keep
+    # prune vars too: optimizer accumulators are persistable and would
+    # otherwise ship (and triple) the deployed params file
+    referenced = set(feeded_var_names) | \
+        {n for op in keep for n in op.input_arg_names} | \
+        {n for op in keep for n in op.output_arg_names}
+    for name in [n for n in gb.vars if n not in referenced]:
+        del gb.vars[name]
+    program._bump_version()
     os.makedirs(dirname, exist_ok=True)
     meta = {
         "feed_names": list(feeded_var_names),

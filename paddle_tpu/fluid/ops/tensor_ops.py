@@ -499,9 +499,51 @@ def _linspace(ctx, ins, attrs):
                             num, dtype=np_dtype(attrs["dtype"])))
 
 
-@register("range", grad=None)
+def _range_infer(op):
+    """Static length when Start/End/Step are fill_constant-produced (the
+    common arange(0, seq_len, 1) pattern) — without this the whole
+    downstream graph loses shapes."""
+    def const_of(name):
+        # fold only when the SOLE producer so far is an attr-valued
+        # fill_constant (a later assign/increment or a ValueTensor-fed
+        # fill would make the attr stale)
+        val = None
+        for p in op.block.ops:
+            if name not in p.output_arg_names:
+                continue
+            if p.type == "fill_constant" and not p.input("ValueTensor"):
+                val = p.attr("value")
+            else:
+                return None
+        return val
+
+    vals = [const_of(op.input(slot)[0])
+            for slot in ("Start", "End", "Step")]
+    if any(v is None for v in vals):
+        return
+    n = len(np.arange(vals[0], vals[1], vals[2]))
+    # fold for the jitted compute: under trace the inputs are tracers and
+    # arange needs static bounds
+    op.attrs["_folded_range"] = [float(v) for v in vals]
+    dv = op.block._var_recursive(op.input("Start")[0])
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=(n,),
+                            dtype=dv.dtype if dv is not None else "int64")
+
+
+@register("range", grad=None, infer_shape=_range_infer,
+          attrs={"_folded_range": []})
 def _range(ctx, ins, attrs):
-    s = np.asarray(x(ins, "Start")).item()
+    sv = x(ins, "Start")
+    if isinstance(sv, jax.core.Tracer):
+        folded = attrs.get("_folded_range")
+        if not folded:
+            raise ValueError(
+                "range with non-constant bounds under jit — the output "
+                "shape would be dynamic")
+        s, e, st = folded
+        return out(jnp.arange(s, e, st).astype(sv.dtype))
+    s = np.asarray(sv).item()
     e = np.asarray(x(ins, "End")).item()
     st = np.asarray(x(ins, "Step")).item()
     return out(jnp.arange(s, e, st))

@@ -75,15 +75,25 @@ def save(layer, path, input_spec=None, **configs):
 
 def _bind_eager_params_static(layer):
     """Copy eager parameter values into the global scope so the saved model
-    has weights, and patch layers to reuse existing names."""
+    has weights, and create persistable static Variable mirrors so shape
+    inference sees param shapes during the re-trace."""
+    from ..fluid import framework
     from ..fluid.executor import global_scope
     import jax.numpy as jnp
-    for name, p in layer.named_parameters():
-        if hasattr(p, "_value"):
-            global_scope().set(p.name, p._value)
-    for name, b in layer.named_buffers():
-        if hasattr(b, "_value"):
-            global_scope().set(b.name, b._value)
+    block = framework.default_main_program().global_block()
+
+    def bind(t):
+        if not hasattr(t, "_value"):
+            return
+        global_scope().set(t.name, t._value)
+        if block._var_recursive(t.name) is None:
+            block.create_var(name=t.name, shape=tuple(t._value.shape),
+                             dtype=str(t._value.dtype), persistable=True)
+
+    for _, p in layer.named_parameters():
+        bind(p)
+    for _, b in layer.named_buffers():
+        bind(b)
 
 
 class TranslatedLayer:

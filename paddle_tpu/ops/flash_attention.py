@@ -13,7 +13,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-from ..fluid.registry import register
+from ..fluid.registry import register, same_shape_as
 from ..fluid.ops.common import x
 
 __all__ = ["scaled_dot_product_attention"]
@@ -40,6 +40,7 @@ def sdpa_reference(q, k, v, mask=None, scale=None, causal=False,
 
 
 @register("fused_attention", stochastic=True,
+          infer_shape=same_shape_as("Q"),
           attrs={"causal": False, "dropout_p": 0.0, "scale": 0.0},
           no_grad_slots=("Mask",))
 def _fused_attention(ctx, ins, attrs):

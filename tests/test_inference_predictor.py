@@ -1,0 +1,138 @@
+"""Inference Predictor (reference analysis_predictor.h:82 + ZeroCopy API):
+save -> load -> predict roundtrips on LeNet and BERT."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, Predictor, create_predictor
+
+
+def _save_lenet(dirname):
+    from paddle_tpu.fluid import Executor, framework, unique_name
+    from paddle_tpu.fluid import io as fio
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    from paddle_tpu.models import build_lenet_program
+
+    paddle.enable_static()
+    scope = Scope()
+    rng = np.random.RandomState(0)
+    img = rng.randn(4, 1, 28, 28).astype("float32")
+    with unique_name.guard(), scope_guard(scope):
+        main, startup, feeds, fetches = build_lenet_program()
+        exe = Executor()
+        exe.run(startup)
+        ref, = exe.run(main, feed={"img": img,
+                                   "label": np.zeros((4, 1), "int64")},
+                       fetch_list=[fetches["logits"]])
+        fio.save_inference_model(dirname, ["img"], [fetches["logits"]],
+                                 exe, main_program=main)
+    paddle.disable_static()
+    return img, ref
+
+
+def test_lenet_predictor_roundtrip(tmp_path):
+    d = str(tmp_path / "lenet")
+    img, ref = _save_lenet(d)
+    cfg = Config(model_dir=d)
+    cfg.disable_glog_info()
+    cfg.enable_memory_optim()
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["img"]
+    assert len(pred.get_output_names()) == 1
+    out, = pred.run([img])
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # handle-style API
+    h = pred.get_input_handle("img")
+    h.copy_from_cpu(img)
+    pred.run()
+    out2 = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out2, ref, atol=1e-5)
+    # clone shares nothing but the files
+    out3, = pred.clone().run([img])
+    np.testing.assert_allclose(out3, ref, atol=1e-5)
+
+
+def test_predictor_missing_input_error(tmp_path):
+    d = str(tmp_path / "lenet2")
+    _save_lenet(d)
+    pred = Predictor(Config(model_dir=d))
+    with pytest.raises(ValueError, match="img"):
+        pred.run()
+
+
+def test_saved_model_excludes_optimizer_state(tmp_path):
+    """Pruning drops the loss/optimizer branch AND its persistable vars —
+    Adam moments must not ship in the deployed params."""
+    from paddle_tpu.fluid import Executor, framework, layers, optimizer
+    from paddle_tpu.fluid import io as fio
+    from paddle_tpu.fluid import unique_name
+    from paddle_tpu.fluid.proto import deserialize_program
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+
+    paddle.enable_static()
+    d = str(tmp_path / "m")
+    with unique_name.guard(), scope_guard(Scope()):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            pred_v = layers.fc(x, 1)
+            d_v = layers.elementwise_sub(pred_v, y)
+            loss = layers.mean(layers.elementwise_mul(d_v, d_v))
+            optimizer.Adam(learning_rate=0.01).minimize(loss)
+        exe = Executor()
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((2, 4), "float32"),
+                            "y": np.zeros((2, 1), "float32")},
+                fetch_list=[loss])
+        fio.save_inference_model(d, ["x"], [pred_v], exe,
+                                 main_program=main)
+    paddle.disable_static()
+    import os
+    with open(os.path.join(d, "__model__"), "rb") as f:
+        prog, meta = deserialize_program(f.read())
+    names = [v.name for v in prog.list_vars()]
+    assert not any("beta1_pow" in n or "moment" in n for n in names), names
+    out, = Predictor(Config(model_dir=d)).run(
+        [np.ones((2, 4), "float32")])
+    assert out.shape == (2, 1)
+
+
+def test_bert_predictor_roundtrip(tmp_path):
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.static import InputSpec
+
+    cfg = BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (2, 16)).astype("int64")
+    ref = model(paddle.to_tensor(ids))[0].numpy()
+
+    d = str(tmp_path / "bert")
+    paddle.jit.save(model, d,
+                    input_spec=[InputSpec([-1, 16], "int64", "ids")])
+    pred = Predictor(Config(model_dir=d))
+    out = pred.run([ids])[0]
+    np.testing.assert_allclose(out, ref, atol=2e-4)
+
+
+def test_bert_predictor_bf16(tmp_path):
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.static import InputSpec
+
+    cfg = BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(4, cfg.vocab_size, (2, 16)).astype("int64")
+    ref = model(paddle.to_tensor(ids))[0].numpy()
+    d = str(tmp_path / "bert16")
+    paddle.jit.save(model, d,
+                    input_spec=[InputSpec([-1, 16], "int64", "ids")])
+    c = Config(model_dir=d)
+    c.enable_bf16()
+    out = Predictor(c).run([ids])[0]
+    assert out.dtype == np.float32
+    # bf16 compute: close but not bit-equal
+    assert np.mean(np.abs(out - ref)) / (np.mean(np.abs(ref)) + 1e-9) < 0.1
