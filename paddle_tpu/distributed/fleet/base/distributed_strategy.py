@@ -75,7 +75,7 @@ _DEFAULTS = {
     # hybrid dp x pp x tp for the functional engine
     # (parallel.HybridParallelTrainStep via fleet.hybrid_train_step)
     "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-                       "micro_batches": None},
+                       "sp_degree": 1, "micro_batches": None},
     "sharding": False,
     "sharding_configs": {"sharding_degree": 1, "stage": 1},
     "sequence_parallel": False,

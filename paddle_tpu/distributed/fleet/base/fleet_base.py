@@ -141,6 +141,10 @@ class Fleet:
         dp, pp, tp = hc["dp_degree"], hc["pp_degree"], hc["mp_degree"]
         if st.tensor_parallel and tp == 1:
             tp = st.tensor_parallel_configs["tensor_parallel_degree"]
+        sp = hc.get("sp_degree", 1)
+        if st.sequence_parallel and sp == 1:
+            sp = st.sequence_parallel_configs["sp_degree"]
+        kwargs.setdefault("sp", sp)
         micro = hc["micro_batches"]
         if st.pipeline and micro is None:
             # accumulate_steps defaults to 1 in the strategy bag; only an

@@ -70,14 +70,15 @@ def _conv2d(ctx, ins, attrs):
     pad = _conv_pad(attrs.get("paddings", [0, 0]),
                     attrs.get("padding_algorithm", "EXPLICIT"),
                     flt.shape[2:], dilations)
+    # no preferred_element_type: the MXU accumulates bf16 convs in f32 by
+    # hardware, and jax's conv transpose rule can't mix a f32 cotangent
+    # with bf16 operands (broke amp O1 ResNet backward)
     r = jax.lax.conv_general_dilated(
         inp, flt, window_strides=strides, padding=pad,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        feature_group_count=attrs.get("groups", 1) or 1,
-        preferred_element_type=jnp.float32
-        if inp.dtype == jnp.bfloat16 else None)
-    return {"Output": [r.astype(inp.dtype)]}
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [r]}
 
 
 register("conv2d", _conv2d, infer_shape=_conv2d_infer,

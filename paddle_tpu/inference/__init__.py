@@ -38,9 +38,15 @@ class Config:
         self._warmup = True
 
     # -- reference switches (recorded; XLA owns the machinery) ----------
-    def set_model(self, model_dir, params_file=None):
-        self._model_dir = model_dir
-        self._params_filename = params_file
+    def set_model(self, model_path, params_file=None):
+        """set_model(dir) or set_model(prog_file, params_file) — the
+        two-argument reference form passes FILE paths."""
+        if params_file is not None:
+            self._model_dir = os.path.dirname(model_path) or "."
+            self._model_filename = os.path.basename(model_path)
+            self._params_filename = os.path.basename(params_file)
+        else:
+            self._model_dir = model_path
 
     def model_dir(self):
         return self._model_dir

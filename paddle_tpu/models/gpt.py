@@ -42,7 +42,11 @@ class GPTConfig:
     initializer_range: float = 0.02
     dropout: float = 0.0
     amp_dtype: str | None = None  # "bfloat16" casts block compute
-    attn_impl: str = "xla"  # "xla" | "flash" (Pallas kernel)
+    attn_impl: str = "xla"  # "xla" | "flash" (Pallas) | "ring" (sp mesh)
+    # rematerialise each block in backward: the lax.scan over layers would
+    # otherwise stash every layer's attention probs ([L,B,H,T,T] — OOM at
+    # 350M/seq-1024 on one v5e chip)
+    remat: bool = True
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -157,10 +161,28 @@ def _causal_attention(q, k, v, n_heads, impl="xla"):
     q = q.reshape(B, T, n_heads, hd)
     k = k.reshape(B, T, n_heads, hd)
     v = v.reshape(B, T, n_heads, hd)
+    if impl == "ring":
+        # sequence-parallel ring attention over the ambient sp mesh axis
+        # (parallel/sequence_parallel.py); T here is the LOCAL shard
+        from ..parallel.sequence_parallel import current_ring, \
+            ring_attention
+        ctx = current_ring()
+        if ctx is None:
+            raise RuntimeError(
+                "attn_impl='ring' needs an enclosing ring_context(mesh, "
+                "axis)")
+        mesh, axis = ctx
+        o = ring_attention(q.transpose(0, 2, 1, 3),
+                           k.transpose(0, 2, 1, 3),
+                           v.transpose(0, 2, 1, 3), mesh, axis,
+                           causal=True)
+        return o.transpose(0, 2, 1, 3).reshape(B, T, D)
     if impl == "flash":
         from ..ops.pallas_attention import flash_attention
-        o = flash_attention(q, k, v, causal=True)
-        return o.reshape(B, T, D)
+        o = flash_attention(q.transpose(0, 2, 1, 3),
+                            k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), causal=True)
+        return o.transpose(0, 2, 1, 3).reshape(B, T, D)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
     mask = jnp.tril(jnp.ones((T, T), bool))
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
@@ -187,6 +209,10 @@ def gpt_block_fn(p: dict, x, cfg: GPTConfig):
 
 def _embed(params, ids, cfg: GPTConfig):
     T = ids.shape[-1]
+    if T > params["wpe"].shape[0]:
+        raise ValueError(
+            f"sequence length {T} exceeds max_position_embeddings="
+            f"{params['wpe'].shape[0]}")
     x = jnp.take(params["wte"], ids, axis=0) + params["wpe"][:T]
     if cfg.amp_dtype:
         x = x.astype(jnp.dtype(cfg.amp_dtype))
@@ -199,15 +225,24 @@ def _head(params, x, cfg: GPTConfig):
     return x.astype(jnp.float32) @ params["wte"].T.astype(jnp.float32)
 
 
+def block_body(cfg: GPTConfig):
+    """Scan body over stacked block params, rematerialised per layer when
+    cfg.remat (jax.checkpoint — reference RecomputeOptimizer semantics at
+    layer granularity)."""
+    def body(h, blk):
+        return gpt_block_fn(blk, h, cfg), None
+
+    if cfg.remat:
+        ck = jax.checkpoint(lambda blk, h: gpt_block_fn(blk, h, cfg))
+        return lambda h, blk: (ck(blk, h), None)
+    return body
+
+
 def gpt_forward(params: dict, ids, cfg: GPTConfig):
     """ids [B, T] int -> logits [B, T, V]. Blocks run under lax.scan over
     the stacked [L, ...] leaves."""
     x = _embed(params, ids, cfg)
-
-    def body(h, blk):
-        return gpt_block_fn(blk, h, cfg), None
-
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x, _ = jax.lax.scan(block_body(cfg), x, params["blocks"])
     return _head(params, x, cfg)
 
 

@@ -13,9 +13,11 @@ TPU-native replacement for the reference's parallelism mechanisms:
 - data parallel    -> batch-dim sharding; grad psum is implicit in XLA's
   sharded autodiff.
 """
-from . import pipeline, sharding
+from . import pipeline, sequence_parallel, sharding
 from .hybrid import HybridParallelTrainStep
 from .embedding import ShardedEmbedding, sharded_embedding_lookup
+from .sequence_parallel import ring_attention
 
-__all__ = ["pipeline", "sharding", "HybridParallelTrainStep",
-           "ShardedEmbedding", "sharded_embedding_lookup"]
+__all__ = ["pipeline", "sharding", "sequence_parallel",
+           "HybridParallelTrainStep", "ShardedEmbedding",
+           "sharded_embedding_lookup", "ring_attention"]

@@ -35,16 +35,18 @@ __all__ = ["HybridParallelTrainStep", "make_hybrid_mesh"]
 _DECAY = {"wte", "wpe", "wq", "wk", "wv", "wo", "w_up", "w_down"}
 
 
-def make_hybrid_mesh(dp: int = 1, pp: int = 1, tp: int = 1,
+def make_hybrid_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
                      devices=None) -> Mesh:
-    """("pp","dp","tp") mesh — tp innermost so its collectives ride the
-    fastest ICI links; pp outermost (cheapest traffic: one activation per
-    microbatch tick)."""
+    """("pp","dp","sp","tp") mesh — tp innermost so its collectives ride
+    the fastest ICI links; sp next (ring attention's ppermute hops);
+    pp outermost (cheapest traffic: one activation per microbatch
+    tick)."""
     devs = np.array(devices if devices is not None else jax.devices())
-    n = dp * pp * tp
+    n = dp * pp * tp * sp
     if devs.size < n:
         raise ValueError(f"need {n} devices, have {devs.size}")
-    return Mesh(devs[:n].reshape(pp, dp, tp), ("pp", "dp", "tp"))
+    return Mesh(devs[:n].reshape(pp, dp, sp, tp),
+                ("pp", "dp", "sp", "tp"))
 
 
 class HybridParallelTrainStep:
@@ -52,17 +54,26 @@ class HybridParallelTrainStep:
     n_microbatches*dp when pp>1)."""
 
     def __init__(self, cfg: G.GPTConfig, mesh: Mesh | None = None,
-                 dp: int = 1, pp: int = 1, tp: int = 1,
+                 dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
                  n_microbatches: int | None = None, lr=1e-4,
                  weight_decay: float = 0.01, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
                  grad_clip_norm: float | None = 1.0, seed: int = 0,
                  devices=None):
         if mesh is None:
-            mesh = make_hybrid_mesh(dp, pp, tp, devices)
+            mesh = make_hybrid_mesh(dp, pp, tp, sp, devices)
+        self.sp = mesh.shape.get("sp", 1)
+        self.pp = mesh.shape.get("pp", 1)
+        if self.sp > 1:
+            if self.pp > 1:  # judged off the MESH, not the ctor args
+                raise NotImplementedError(
+                    "sp x pp nests two manual mesh axes — shard the "
+                    "sequence OR the layers, not both (yet)")
+            # sequence parallel => ring attention over the sp axis
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, attn_impl="ring")
         self.cfg = cfg
         self.mesh = mesh
-        self.pp = mesh.shape.get("pp", 1)
         self.n_micro = n_microbatches or max(2 * self.pp, 1)
         if self.pp > 1 and cfg.dropout:
             raise NotImplementedError(
@@ -105,12 +116,19 @@ class HybridParallelTrainStep:
         repl = NamedSharding(mesh, P())
         self._pows = (jax.device_put(jnp.ones((1,), jnp.float32), repl),
                       jax.device_put(jnp.ones((1,), jnp.float32), repl))
-        self._batch_sharding = NamedSharding(mesh, P("dp"))
+        self._batch_sharding = NamedSharding(
+            mesh, P("dp", "sp") if self.sp > 1 else P("dp"))
         self._jit_step = self._build(mesh)
 
     # ------------------------------------------------------------------
     def loss_fn(self, params, ids):
         cfg, mesh = self.cfg, self.mesh
+        if self.sp > 1:
+            from .sequence_parallel import ring_context
+            ids = jax.lax.with_sharding_constraint(
+                ids, NamedSharding(mesh, P("dp", "sp")))
+            with ring_context(mesh, "sp"):
+                return G.gpt_loss(params, ids, cfg)
         if self.pp == 1:
             return G.gpt_loss(params, ids, cfg)
         M = self.n_micro
@@ -121,12 +139,8 @@ class HybridParallelTrainStep:
         x = x.reshape(M, B // M, T, cfg.hidden_size)
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, P(None, "dp")))
-        lps = cfg.num_layers // self.pp
-
         def stage_fn(blk, h):
-            def body(hh, one):
-                return G.gpt_block_fn(one, hh, cfg), None
-            out, _ = jax.lax.scan(body, h, blk)
+            out, _ = jax.lax.scan(G.block_body(cfg), h, blk)
             return out
 
         out = pipeline_apply(stage_fn, params["blocks"], x, mesh, "pp")
