@@ -89,4 +89,10 @@ def __getattr__(name):
     if name == "hapi":
         from . import hapi
         return hapi
+    if name == "distribution":
+        from . import distribution
+        return distribution
+    if name == "inference":
+        from . import inference
+        return inference
     raise AttributeError(name)

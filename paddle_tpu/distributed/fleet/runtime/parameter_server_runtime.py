@@ -25,18 +25,27 @@ __all__ = ["ParameterServerRuntime", "LargeScaleKV", "PSServer", "PSClient"]
 
 
 class LargeScaleKV:
-    """In-memory sparse table, vectorized (reference large_scale_kv.h).
+    """In-memory sparse table (reference large_scale_kv.h).
 
-    Rows live in one growing [cap, dim] array; an id->slot dict indexes it.
-    pull/push touch numpy once per batch (no per-row RNG or loops)."""
+    Hot path: the C++ open-addressing core in paddle_tpu/native/kv_store.cc
+    (id->slot hash + contiguous row arena, no Python per row). Falls back
+    to the vectorized numpy implementation when no toolchain is available
+    or PADDLE_TPU_DISABLE_NATIVE is set."""
 
     def __init__(self, dim: int, init_std: float = 0.01, seed: int = 0):
         self.dim = dim
         self.init_std = init_std
+        self.seed = seed
         self._rng = np.random.RandomState(seed)
         self._index: dict[int, int] = {}
         self._data = np.empty((0, dim), np.float32)
         self._lock = threading.Lock()
+        self._native = None
+        import os
+        if not os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+            from ....native import available, NativeKV
+            if available():
+                self._native = NativeKV(dim, init_std, seed)
 
     def _ensure(self, keys: np.ndarray) -> np.ndarray:
         """Slots for keys, creating missing rows in one batched init."""
@@ -65,33 +74,56 @@ class LargeScaleKV:
 
     def pull(self, keys: np.ndarray) -> np.ndarray:
         with self._lock:
+            if self._native is not None:
+                return self._native.pull(keys)
             slots = self._ensure(np.asarray(keys).ravel())
             return self._data[slots].copy()
 
     def push(self, keys: np.ndarray, grads: np.ndarray, lr: float = 1.0):
         """SGD apply (reference async PS applies grads on arrival);
-        duplicate keys accumulate via np.add.at."""
+        duplicate keys accumulate."""
         with self._lock:
+            if self._native is not None:
+                self._native.push(keys, grads, lr)
+                return
             slots = self._ensure(np.asarray(keys).ravel())
             np.add.at(self._data, slots,
                       (-lr * np.asarray(grads)).astype(np.float32))
 
     def size(self) -> int:
-        return len(self._index)
+        with self._lock:
+            if self._native is not None:
+                return self._native.size()
+            return len(self._index)
 
     def save(self, path: str):
-        with self._lock, open(path, "wb") as f:
-            keys = np.fromiter(self._index, np.int64, len(self._index))
-            slots = np.fromiter(self._index.values(), np.int64,
-                                len(self._index))
-            pickle.dump({"dim": self.dim, "keys": keys,
-                         "rows": self._data[slots]}, f, protocol=4)
+        with self._lock:
+            if self._native is not None:
+                keys, rows = self._native.export()
+            else:
+                keys = np.fromiter(self._index, np.int64,
+                                   len(self._index))
+                slots = np.fromiter(self._index.values(), np.int64,
+                                    len(self._index))
+                rows = self._data[slots]
+            with open(path, "wb") as f:
+                pickle.dump({"dim": self.dim, "keys": keys,
+                             "rows": rows}, f, protocol=4)
 
     def load(self, path: str):
         with open(path, "rb") as f:
             blob = pickle.load(f)
         with self._lock:
             self.dim = blob["dim"]
+            if self._native is not None:
+                from ....native import NativeKV
+                # keep the instance seed so fresh rows created after a
+                # restore stay reproducible
+                self._native = NativeKV(self.dim, self.init_std,
+                                        self.seed)
+                if len(blob["keys"]):
+                    self._native.import_(blob["keys"], blob["rows"])
+                return
             self._data = np.ascontiguousarray(blob["rows"])
             self._index = {int(k): i for i, k in enumerate(blob["keys"])}
 

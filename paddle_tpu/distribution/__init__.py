@@ -1,0 +1,171 @@
+"""paddle.distribution (reference python/paddle/fluid/layers/
+distributions.py: Normal, Uniform, Categorical, MultivariateNormalDiag).
+
+Distributions compose eager Tensor ops, so log_prob/entropy/kl are
+tape-differentiable (policy-gradient losses backprop through them);
+sampling draws from the framework RNG (RBG by default on TPU).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical"]
+
+
+def _p():
+    import paddle_tpu as paddle
+    return paddle
+
+
+def _to_tensor(v, dtype="float32"):
+    paddle = _p()
+    from ..fluid.dygraph.varbase import Tensor
+    if isinstance(v, Tensor):
+        return v
+    return paddle.to_tensor(np.asarray(v, dtype))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_tensor(loc)
+        self.scale = _to_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        paddle = _p()
+        base_shape = tuple(shape) + tuple(self.loc.shape)
+        eps = paddle.randn(list(base_shape))
+        return paddle.add(self.loc, paddle.multiply(self.scale, eps))
+
+    def entropy(self):
+        paddle = _p()
+        # 0.5 + 0.5 log(2 pi) + log sigma
+        c = 0.5 + 0.5 * math.log(2 * math.pi)
+        return paddle.add(paddle.log(self.scale),
+                          paddle.full_like(self.scale, c))
+
+    def log_prob(self, value):
+        paddle = _p()
+        value = _to_tensor(value)
+        var = paddle.multiply(self.scale, self.scale)
+        d = paddle.subtract(value, self.loc)
+        return paddle.subtract(
+            paddle.scale(paddle.divide(paddle.multiply(d, d), var), -0.5),
+            paddle.add(paddle.log(self.scale),
+                       paddle.full_like(self.scale,
+                                        0.5 * math.log(2 * math.pi))))
+
+    def probs(self, value):
+        paddle = _p()
+        return paddle.exp(self.log_prob(value))
+
+    def kl_divergence(self, other: "Normal"):
+        paddle = _p()
+        # log(s2/s1) + (s1^2 + (m1-m2)^2) / (2 s2^2) - 1/2
+        var1 = paddle.multiply(self.scale, self.scale)
+        var2 = paddle.multiply(other.scale, other.scale)
+        d = paddle.subtract(self.loc, other.loc)
+        t = paddle.divide(paddle.add(var1, paddle.multiply(d, d)),
+                          paddle.scale(var2, 2.0))
+        return paddle.add(
+            paddle.subtract(paddle.log(other.scale),
+                            paddle.log(self.scale)),
+            paddle.add(t, paddle.full_like(t, -0.5)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _to_tensor(low)
+        self.high = _to_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        paddle = _p()
+        base_shape = tuple(shape) + tuple(self.low.shape)
+        u = paddle.rand(list(base_shape))
+        return paddle.add(self.low, paddle.multiply(
+            paddle.subtract(self.high, self.low), u))
+
+    def entropy(self):
+        paddle = _p()
+        return paddle.log(paddle.subtract(self.high, self.low))
+
+    def log_prob(self, value):
+        paddle = _p()
+        value = _to_tensor(value)
+        inside = paddle.logical_and(
+            paddle.greater_equal(value, self.low),
+            paddle.less_than(value, self.high))
+        lp = paddle.scale(paddle.log(
+            paddle.subtract(self.high, self.low)), -1.0)
+        neg_inf = paddle.full_like(lp, -1e30)
+        return paddle.where(inside, lp, neg_inf)
+
+    def probs(self, value):
+        paddle = _p()
+        return paddle.exp(self.log_prob(value))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _to_tensor(logits)
+
+    def _log_pmf(self):
+        paddle = _p()
+        import paddle_tpu.nn.functional as F
+        return F.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        paddle = _p()
+        import paddle_tpu.nn.functional as F
+        p = F.softmax(self.logits, axis=-1)
+        n = int(np.prod(shape)) if shape else 1
+        s = paddle.multinomial(p, num_samples=n, replacement=True)
+        if shape:  # [batch..., n] -> [*shape, batch...]
+            batch = tuple(s.shape[:-1])
+            s = paddle.reshape(
+                paddle.transpose(
+                    paddle.reshape(s, list(batch) + [n]),
+                    [len(batch)] + list(range(len(batch)))),
+                list(shape) + list(batch))
+        return s
+
+    def entropy(self):
+        paddle = _p()
+        lp = self._log_pmf()
+        p = paddle.exp(lp)
+        return paddle.scale(paddle.sum(paddle.multiply(p, lp), axis=-1),
+                            -1.0)
+
+    def log_prob(self, value):
+        paddle = _p()
+        lp = self._log_pmf()
+        value = _to_tensor(np.asarray(value, "int64"), "int64")
+        import paddle_tpu.nn.functional as F
+        onehot = F.one_hot(value, lp.shape[-1])
+        return paddle.sum(paddle.multiply(lp, onehot), axis=-1)
+
+    def probs(self, value):
+        paddle = _p()
+        return paddle.exp(self.log_prob(value))
+
+    def kl_divergence(self, other: "Categorical"):
+        paddle = _p()
+        lp, lq = self._log_pmf(), other._log_pmf()
+        p = paddle.exp(lp)
+        return paddle.sum(paddle.multiply(p, paddle.subtract(lp, lq)),
+                          axis=-1)

@@ -1,0 +1,62 @@
+"""Op version registry (reference framework/op_version_registry.h +
+op_compatible_info.h + framework.proto:184-211): per-op version numbers
+saved with every serialized Program; loading checks compatibility so old
+binaries fail loudly on programs from newer frameworks."""
+from __future__ import annotations
+
+import logging
+
+__all__ = ["register_op_version", "get_op_version", "get_op_version_map",
+           "check_compatibility"]
+
+logger = logging.getLogger(__name__)
+
+_VERSIONS: dict[str, list[tuple[int, str]]] = {}
+
+
+def register_op_version(op_type: str, version: int, note: str = ""):
+    """Record a behavior change of `op_type` at `version` (monotonic)."""
+    hist = _VERSIONS.setdefault(op_type, [])
+    if hist and version <= hist[-1][0]:
+        raise ValueError(
+            f"op {op_type!r} version {version} must exceed "
+            f"{hist[-1][0]}")
+    hist.append((version, note))
+
+
+def get_op_version(op_type: str) -> int:
+    hist = _VERSIONS.get(op_type)
+    return hist[-1][0] if hist else 0
+
+
+def get_op_version_map() -> dict[str, int]:
+    return {op: hist[-1][0] for op, hist in _VERSIONS.items()}
+
+
+def check_compatibility(saved: dict[str, int],
+                        strict: bool = False) -> list[str]:
+    """Compare a loaded program's op-version map against this build.
+    Newer-than-us versions are incompatible (the saved program may rely
+    on semantics we don't have); older ones are fine (we keep
+    backward-compatible kernels). Returns the incompatibility list."""
+    problems = []
+    for op, v in (saved or {}).items():
+        have = get_op_version(op)
+        if v > have:
+            problems.append(
+                f"op {op!r} saved at version {v}, this build has {have}")
+    if problems:
+        msg = "; ".join(problems)
+        if strict:
+            raise RuntimeError(f"incompatible program: {msg}")
+        logger.warning("op version mismatch: %s", msg)
+    return problems
+
+
+# --- version history of ops whose behavior changed across rounds --------
+register_op_version("dropout", 1, "rng stream switched to RBG default")
+register_op_version(
+    "conv2d_transpose", 1,
+    "groups/output_padding honored; explicit-padding semantics fixed")
+register_op_version(
+    "lookup_table_v2", 1, "is_sparse emits SelectedRows gradients")

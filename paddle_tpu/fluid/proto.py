@@ -85,7 +85,16 @@ def program_from_dict(d: dict) -> Program:
 
 
 def serialize_program(program: Program, meta: dict | None = None) -> bytes:
-    payload = {"program": program_to_dict(program), "meta": meta or {}}
+    # stamp current op versions so old binaries can detect programs that
+    # rely on newer op semantics (reference op_version_registry.h via
+    # framework.proto:184-211)
+    from .op_version import get_op_version_map
+    meta = dict(meta or {})
+    used = {op.type for b in program.blocks for op in b.ops}
+    meta.setdefault("op_versions",
+                    {k: v for k, v in get_op_version_map().items()
+                     if k in used})
+    payload = {"program": program_to_dict(program), "meta": meta}
     return MAGIC + pickle.dumps(payload, protocol=4)
 
 
@@ -93,4 +102,7 @@ def deserialize_program(data: bytes):
     if not data.startswith(MAGIC):
         raise ValueError("not a paddle_tpu program blob")
     payload = pickle.loads(data[len(MAGIC):])
-    return program_from_dict(payload["program"]), payload.get("meta", {})
+    meta = payload.get("meta", {})
+    from .op_version import check_compatibility
+    check_compatibility(meta.get("op_versions"))
+    return program_from_dict(payload["program"]), meta

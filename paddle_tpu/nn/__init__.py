@@ -8,6 +8,8 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           TransformerDecoder, Transformer)
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
                   SimpleRNN, LSTM, GRU)
+from . import decode
+from .decode import beam_search
 from ..fluid.dygraph.layers import Layer
 from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
                           ClipGradByGlobalNorm)

@@ -1,0 +1,81 @@
+"""Beam-search decoding (reference operators/beam_search_op.cc +
+beam_search_decode_op.cc + python BeamSearchDecoder in
+fluid/layers/rnn.py).
+
+TPU redesign: the reference threads LoD beams through per-step ops; here
+the whole decode is ONE `lax.scan` with static [batch, beam] state —
+jit-able, MXU-batched, no ragged tensors. Finished beams are frozen by
+masking their continuation scores so only the EOS row survives.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["beam_search"]
+
+_NEG = -1e9
+
+
+def beam_search(step_fn: Callable, batch_size: int, beam_size: int,
+                max_len: int, bos_id: int, eos_id: int, init_state=None,
+                length_penalty: float = 0.0):
+    """Decode `max_len` steps of width-`beam_size` beam search.
+
+    step_fn(tokens [B*K] int32, state) -> (log_probs [B*K, V], new_state)
+      state leaves must keep their shapes across steps (scan carry);
+      row i of the batch dim corresponds to beam (i // K, i % K).
+
+    Returns (sequences [B, K, max_len] int32, scores [B, K]) sorted best
+    beam first, where sequences hold post-BOS tokens padded with eos_id.
+    """
+    B, K = batch_size, beam_size
+
+    tokens0 = jnp.full((B * K,), bos_id, jnp.int32)
+    # only beam 0 is live at t=0 (all beams start identical)
+    scores0 = jnp.tile(
+        jnp.asarray([0.0] + [_NEG] * (K - 1), jnp.float32), (B,))
+    finished0 = jnp.zeros((B * K,), bool)
+    seqs0 = jnp.full((B * K, max_len), eos_id, jnp.int32)
+
+    def step(carry, t):
+        tokens, scores, finished, seqs, state = carry
+        logp, state = step_fn(tokens, state)
+        V = logp.shape[-1]
+        # frozen beams may only "emit" EOS at no cost
+        eos_only = jnp.full((V,), _NEG).at[eos_id].set(0.0)
+        logp = jnp.where(finished[:, None], eos_only[None, :], logp)
+        cand = scores[:, None] + logp                     # [B*K, V]
+        cand = cand.reshape(B, K * V)
+        top_s, top_i = jax.lax.top_k(cand, K)             # [B, K]
+        src_beam = top_i // V                             # beam index
+        tok = (top_i % V).astype(jnp.int32)
+        flat_src = (jnp.arange(B)[:, None] * K + src_beam).reshape(-1)
+        seqs = seqs[flat_src].at[:, t].set(tok.reshape(-1))
+        finished = finished[flat_src] | (tok.reshape(-1) == eos_id)
+        carry = (tok.reshape(-1), top_s.reshape(-1), finished, seqs,
+                 jax.tree_util.tree_map(lambda s: s[flat_src]
+                                        if hasattr(s, "shape") and
+                                        s.shape[:1] == (B * K,) else s,
+                                        state))
+        return carry, None
+
+    if init_state is None:
+        init_state = ()
+    (tokens, scores, finished, seqs, _), _ = jax.lax.scan(
+        step, (tokens0, scores0, finished0, seqs0, init_state),
+        jnp.arange(max_len))
+
+    scores = scores.reshape(B, K)
+    seqs = seqs.reshape(B, K, max_len)
+    if length_penalty:
+        lengths = jnp.sum(seqs != eos_id, axis=-1).astype(jnp.float32)
+        scores = scores / jnp.power(jnp.maximum(lengths, 1.0),
+                                    length_penalty)
+    order = jnp.argsort(-scores, axis=-1)
+    seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    return seqs, scores

@@ -1,0 +1,164 @@
+"""Distributions (reference fluid/layers/distributions.py), beam search
+(operators/beam_search_op.cc), op version registry
+(framework/op_version_registry.h)."""
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+def test_normal_log_prob_entropy_kl():
+    n = Normal([0.0, 1.0], [1.0, 2.0])
+    v = np.array([0.5, 0.0], "float32")
+    lp = np.ravel(n.log_prob(v).numpy())
+    np.testing.assert_allclose(
+        lp, [sps.norm(0, 1).logpdf(0.5), sps.norm(1, 2).logpdf(0.0)],
+        rtol=1e-5)
+    ent = np.ravel(n.entropy().numpy())
+    np.testing.assert_allclose(
+        ent, [sps.norm(0, 1).entropy(), sps.norm(1, 2).entropy()],
+        rtol=1e-5)
+    other = Normal([0.0, 1.0], [1.0, 2.0])
+    np.testing.assert_allclose(np.ravel(n.kl_divergence(other).numpy()),
+                               0.0, atol=1e-6)
+    s = n.sample((10000,)).numpy()
+    assert abs(s[:, 0].mean()) < 0.05 and abs(s[:, 1].std() - 2) < 0.1
+
+
+def test_normal_log_prob_differentiable():
+    loc = paddle.to_tensor(np.array([0.5], "float32"))
+    loc.stop_gradient = False
+    n = Normal(loc, paddle.to_tensor(np.array([1.0], "float32")))
+    lp = n.log_prob(np.array([2.0], "float32"))
+    lp.backward()
+    # d/dmu logpdf = (x-mu)/sigma^2 = 1.5
+    np.testing.assert_allclose(np.ravel(np.asarray(loc.grad._value)),
+                               [1.5], rtol=1e-5)
+
+
+def test_uniform():
+    u = Uniform(0.0, 2.0)
+    np.testing.assert_allclose(float(np.ravel(u.entropy().numpy())[0]),
+                               np.log(2), rtol=1e-6)
+    lp = np.ravel(u.log_prob(np.array([1.0], "float32")).numpy())
+    np.testing.assert_allclose(lp, [np.log(0.5)], rtol=1e-6)
+    out = np.ravel(u.log_prob(np.array([3.0], "float32")).numpy())
+    assert out[0] < -1e20
+    s = u.sample((5000,)).numpy()
+    assert 0 <= s.min() and s.max() <= 2 and abs(s.mean() - 1) < 0.05
+
+
+def test_categorical():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], "float32"))
+    c = Categorical(logits)
+    lp = c.log_prob(np.array([2], "int64")).numpy()
+    np.testing.assert_allclose(np.ravel(lp), [np.log(0.5)], rtol=1e-5)
+    ent = float(np.ravel(c.entropy().numpy())[0])
+    np.testing.assert_allclose(
+        ent, -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)),
+        rtol=1e-5)
+    other = Categorical(np.log(np.array([[1 / 3] * 3], "float32")))
+    kl = float(np.ravel(c.kl_divergence(other).numpy())[0])
+    assert kl > 0
+    s = c.sample((4000,))
+    assert tuple(s.shape) == (4000, 1)  # [*shape, *batch]
+    s = np.asarray(s.numpy()).ravel()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.05)
+    s2 = c.sample((2, 3))
+    assert tuple(s2.shape) == (2, 3, 1)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def test_beam_search_greedy_path():
+    """Deterministic chain LM: argmax transition i -> i+1; beam search
+    must recover the chain and then EOS."""
+    from paddle_tpu.nn import beam_search
+    V, EOS, BOS = 6, 5, 0
+
+    T = np.full((V, V), -10.0, "float32")
+    for i in range(4):
+        T[i, i + 1] = 0.0
+    T[4, EOS] = 0.0
+    T[EOS, EOS] = 0.0
+    Tm = jnp.asarray(T)
+
+    def step_fn(tokens, state):
+        return Tm[tokens], state
+
+    seqs, scores = beam_search(step_fn, batch_size=2, beam_size=3,
+                               max_len=6, bos_id=BOS, eos_id=EOS)
+    assert seqs.shape == (2, 3, 6)
+    np.testing.assert_array_equal(np.asarray(seqs)[0, 0],
+                                  [1, 2, 3, 4, 5, 5])
+    # best beam outscores the rest
+    assert float(scores[0, 0]) > float(scores[0, 1])
+
+
+def test_beam_search_beats_greedy():
+    """Classic trap: greedy takes the locally-best first token, beam
+    search keeps the globally-best two-step path."""
+    from paddle_tpu.nn import beam_search
+    V, BOS, EOS = 4, 0, 3
+    # from BOS: token1 logp -0.3, token2 logp -1.2
+    # from 1: best continuation is weak (-3); from 2: strong (-0.05)
+    step0 = np.full((V,), -20.0, "float32")
+    step0[1], step0[2] = -0.3, -1.2
+    from1 = np.full((V,), -20.0, "float32"); from1[EOS] = -3.0
+    from2 = np.full((V,), -20.0, "float32"); from2[EOS] = -0.05
+    fromE = np.full((V,), -20.0, "float32"); fromE[EOS] = 0.0
+    Tm = jnp.asarray(np.stack([step0, from1, from2, fromE]))
+
+    def step_fn(tokens, state):
+        return Tm[tokens], state
+
+    seqs, scores = beam_search(step_fn, 1, 2, 3, BOS, EOS)
+    np.testing.assert_array_equal(np.asarray(seqs)[0, 0], [2, 3, 3])
+
+
+def test_beam_search_carries_state():
+    """Per-beam state rows follow their beam through reordering."""
+    from paddle_tpu.nn import beam_search
+    V, BOS, EOS = 4, 0, 3
+
+    def step_fn(tokens, state):
+        # state counts steps per beam; logits prefer token == (count % 2)+1
+        count = state
+        logits = jnp.full((tokens.shape[0], V), -5.0)
+        tgt = (count % 2) + 1
+        logits = logits.at[jnp.arange(tokens.shape[0]), tgt].set(0.0)
+        return logits, count + 1
+
+    seqs, _ = beam_search(step_fn, 1, 2, 4, BOS, EOS,
+                          init_state=jnp.zeros((2,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(seqs)[0, 0], [1, 2, 1, 2])
+
+
+# ---------------------------------------------------------------------------
+# op version registry
+# ---------------------------------------------------------------------------
+
+def test_op_version_registry_roundtrip(fresh_programs):
+    from paddle_tpu.fluid import layers, op_version
+    from paddle_tpu.fluid.proto import (deserialize_program,
+                                        serialize_program)
+    main, startup, scope = fresh_programs
+    x = layers.data("x", [-1, 4], "float32")
+    y = layers.dropout(x, 0.5)
+    blob = serialize_program(main)
+    prog, meta = deserialize_program(blob)
+    assert meta["op_versions"]["dropout"] == \
+        op_version.get_op_version("dropout")
+    # a future version triggers the incompatibility report
+    problems = op_version.check_compatibility({"dropout": 999})
+    assert problems and "dropout" in problems[0]
+    with pytest.raises(RuntimeError, match="dropout"):
+        op_version.check_compatibility({"dropout": 999}, strict=True)
