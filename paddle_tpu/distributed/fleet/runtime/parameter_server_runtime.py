@@ -162,10 +162,16 @@ class PSServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, worker_timeout: float = 60.0):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
+        # worker liveness (reference operators/distributed/
+        # heart_beat_monitor.h:54): last-seen stamp per worker id;
+        # lost_workers() reports ids silent past the timeout
+        self.worker_timeout = worker_timeout
+        self._beats: dict[int, float] = {}
+        self._beats_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -206,7 +212,22 @@ class PSServer(socketserver.ThreadingTCPServer):
             return 0 if t is None else t.size()
         if op == "ping":
             return "pong"
+        if op == "heartbeat":
+            import time
+            with self._beats_lock:
+                self._beats[int(req["worker"])] = time.time()
+            return True
+        if op == "lost_workers":
+            return self.lost_workers()
         raise ValueError(f"unknown PS op {op!r}")
+
+    def lost_workers(self) -> list[int]:
+        import time
+        now = time.time()
+        with self._beats_lock:  # handler threads insert concurrently
+            beats = list(self._beats.items())
+        return sorted(w for w, t in beats
+                      if now - t > self.worker_timeout)
 
     def serve_in_thread(self) -> threading.Thread:
         th = threading.Thread(target=self.serve_forever, daemon=True)
@@ -284,6 +305,20 @@ class PSClient:
     def size(self, table: str) -> int:
         return sum(self._call(i, {"op": "size", "table": table})
                    for i in range(len(self.endpoints)))
+
+    def heartbeat(self, worker_id: int):
+        """Liveness ping to every shard (reference HeartBeatMonitor's
+        worker-side UPDATE)."""
+        self._fanout([
+            (lambda i=i: self._call(i, {"op": "heartbeat",
+                                        "worker": worker_id}))
+            for i in range(len(self.endpoints))])
+
+    def lost_workers(self) -> list[int]:
+        lost: set[int] = set()
+        for i in range(len(self.endpoints)):
+            lost.update(self._call(i, {"op": "lost_workers"}))
+        return sorted(lost)
 
     def save(self, dirname: str):
         for i in range(len(self.endpoints)):

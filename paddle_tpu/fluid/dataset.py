@@ -1,0 +1,232 @@
+"""Dataset / DataFeed tier (reference python/paddle/fluid/dataset.py +
+framework/data_feed.h:108,293,650 + data_set.h:43,284).
+
+The reference streams slot-format text files through C++ DataFeed channels
+into per-thread Hogwild workers. TPU redesign: reader THREADS parse and
+batch on the host into a bounded queue, while ONE device loop consumes
+batches into the jitted step (per-op interpreters scale by threads; one
+fused XLA computation doesn't need them — the threads keep the input
+pipeline ahead of the device instead).
+
+File format ("MultiSlot" equivalent): one sample per line, slots separated
+by ';', values space-separated, slot order = `set_use_var` order. Slots
+are padded/truncated to the declared var shape.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import random
+import threading
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset"]
+
+
+class DatasetFactory:
+    """reference dataset.py:22 — create_dataset("InMemoryDataset")."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError(f"unknown dataset class {datafeed_class!r}")
+
+
+class DatasetBase:
+    def __init__(self):
+        self.batch_size = 1
+        self.thread_num = 1
+        self.filelist: list[str] = []
+        self.use_vars = []
+        self.pipe_command = None
+        self._generator = None
+
+    # -- reference config surface ---------------------------------------
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, fs_name="", fs_ugi="", **kw):
+        self.set_batch_size(batch_size)
+        self.set_thread(thread_num)
+        if use_var:
+            self.set_use_var(use_var)
+        self.pipe_command = pipe_command
+        return self
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = max(1, int(thread_num))
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_pipe_command(self, cmd):
+        self.pipe_command = cmd
+
+    def set_sample_generator(self, generator):
+        """Python-side samples instead of files (reference
+        data_generator/): generator() yields per-sample tuples matching
+        use_var order."""
+        self._generator = generator
+
+    # -- parsing ---------------------------------------------------------
+    def _var_spec(self, v):
+        shape = [abs(int(s)) if s and int(s) > 0 else 1
+                 for s in (v.shape or [1])[1:]] or [1]
+        n = int(np.prod(shape))
+        dtype = np.dtype(v.dtype or "float32")
+        return n, shape, dtype
+
+    def _parse_line(self, line):
+        parts = line.rstrip("\n").split(";")
+        if len(parts) != len(self.use_vars):
+            raise ValueError(
+                f"line has {len(parts)} slots, use_var declares "
+                f"{len(self.use_vars)}")
+        sample = []
+        for v, txt in zip(self.use_vars, parts):
+            n, shape, dtype = self._var_spec(v)
+            vals = np.asarray(txt.split(), dtype=dtype)
+            if len(vals) < n:  # pad (ragged slot -> dense, SURVEY §7)
+                vals = np.concatenate(
+                    [vals, np.zeros(n - len(vals), dtype)])
+            sample.append(vals[:n].reshape(shape))
+        return tuple(sample)
+
+    def _iter_samples(self):
+        if self._generator is not None:
+            yield from self._generator()
+            return
+        import subprocess
+        for path in self.filelist:
+            if self.pipe_command:
+                with open(path, "rb") as f:
+                    out = subprocess.run(
+                        self.pipe_command, shell=True, stdin=f,
+                        capture_output=True, check=True)
+                lines = out.stdout.decode().splitlines()
+            else:
+                with open(path) as f:
+                    lines = f.read().splitlines()
+            for line in lines:
+                if line.strip():
+                    yield self._parse_line(line)
+
+    def _batches_from(self, samples, drop_last=False):
+        buf = []
+        for s in samples:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf and not drop_last:
+            yield self._collate(buf)
+
+    def _collate(self, samples):
+        feed = {}
+        for i, v in enumerate(self.use_vars):
+            feed[v.name] = np.stack([s[i] for s in samples])
+        return feed
+
+    def batch_iter(self):
+        raise NotImplementedError
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:328: load everything, shuffle, iterate."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: list | None = None
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_samples())
+        return self
+
+    def release_memory(self):
+        self._samples = None
+
+    def get_memory_data_size(self):
+        return len(self._samples or [])
+
+    def local_shuffle(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Single-process world: global == local (the reference shuffles
+        across trainers via fleet RPC)."""
+        self.local_shuffle()
+
+    def batch_iter(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        yield from self._batches_from(self._samples)
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py:852: streaming — reader threads parse files
+    into a bounded queue; the consumer drains batches as they arrive."""
+
+    _CHUNK = 256  # samples per queue item (amortises queue overhead)
+
+    def batch_iter(self):
+        if self._generator is not None or len(self.filelist) <= 1 or \
+                self.thread_num <= 1:
+            yield from self._batches_from(self._iter_samples())
+            return
+        # reader threads emit SAMPLE chunks; batching happens at the
+        # single consumer so batch sizes don't depend on thread_num /
+        # per-file tails (only the streaming order does)
+        q: queue.Queue = queue.Queue(maxsize=64)
+        files = list(self.filelist)
+        lock = threading.Lock()
+        errors = []
+
+        def worker():
+            while True:
+                with lock:
+                    if not files:
+                        break
+                    path = files.pop()
+                sub = QueueDataset()
+                sub.use_vars = self.use_vars
+                sub.pipe_command = self.pipe_command
+                sub.filelist = [path]
+                try:
+                    chunk = []
+                    for s in sub._iter_samples():
+                        chunk.append(s)
+                        if len(chunk) >= self._CHUNK:
+                            q.put(chunk)
+                            chunk = []
+                    if chunk:
+                        q.put(chunk)
+                except Exception as e:  # surfaced by the consumer
+                    errors.append(e)
+            q.put(None)
+
+        n = min(self.thread_num, len(files))
+        for _ in range(n):
+            threading.Thread(target=worker, daemon=True).start()
+
+        def samples():
+            done = 0
+            while done < n:
+                item = q.get()
+                if item is None:
+                    done += 1
+                    continue
+                yield from item
+            if errors:
+                raise errors[0]
+
+        yield from self._batches_from(samples())

@@ -401,6 +401,53 @@ class Executor:
             self._cache[sig] = fn
         return fn
 
+    # -- dataset training ---------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Run the program over every Dataset batch (reference
+        executor.py:1597 → C++ MultiTrainer/HogwildWorker loop,
+        trainer.h:85, device_worker.h:215). Here the dataset's reader
+        threads keep the input queue full while one device loop feeds the
+        single fused XLA step; `thread` is accepted for API parity and
+        routed to the dataset's reader pool."""
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [getattr(v, "name", str(v))
+                                    for v in fetch_list]
+        last = None
+        for step_i, feed in enumerate(dataset.batch_iter()):
+            res = self.run(program, feed=feed, fetch_list=fetch_list,
+                           scope=scope)
+            last = res
+            if print_period and (step_i + 1) % print_period == 0:
+                if fetch_list:
+                    msg = ", ".join(
+                        f"{n}={np.ravel(np.asarray(v))[0]:.6f}"
+                        for n, v in zip(fetch_info, res))
+                    print(f"[train_from_dataset] step {step_i + 1}: "
+                          f"{msg}", flush=True)
+                # fetch_handler fires on the period regardless of
+                # fetch_list (reference FetchHandler runs independently
+                # of printing)
+                if fetch_handler is not None:
+                    fetch_handler(res)
+        return last
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100,
+                           fetch_handler=None):
+        """Like train_from_dataset but for test-mode programs (reference
+        executor.py:1476)."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period, fetch_handler)
+
     def close(self):
         self._cache.clear()
 

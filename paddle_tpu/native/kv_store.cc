@@ -83,15 +83,6 @@ struct KvStore {
     return size++;
   }
 
-  int64_t find(int64_t k) const {
-    size_t cap = keys.size();
-    size_t j = hash(k) & (cap - 1);
-    while (keys[j] != kEmpty) {
-      if (keys[j] == k) return slots[j];
-      j = (j + 1) & (cap - 1);
-    }
-    return -1;
-  }
 };
 
 }  // namespace

@@ -1,0 +1,55 @@
+"""Global named stat gauges (reference platform/monitor.h/.cc
+STAT_ADD/STAT_RESET + pybind graph_num/... exposure)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["StatRegistry", "stat_add", "stat_set", "stat_get",
+           "stat_reset", "get_all_stats"]
+
+_lock = threading.Lock()
+_stats: dict[str, float] = {}
+
+
+class StatRegistry:
+    @staticmethod
+    def add(name: str, value=1):
+        return stat_add(name, value)
+
+    @staticmethod
+    def set(name: str, value):
+        return stat_set(name, value)
+
+    @staticmethod
+    def get(name: str):
+        return stat_get(name)
+
+
+def stat_add(name: str, value=1):
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + value
+        return _stats[name]
+
+
+def stat_set(name: str, value):
+    with _lock:
+        _stats[name] = value
+        return value
+
+
+def stat_get(name: str):
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str | None = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def get_all_stats() -> dict[str, float]:
+    with _lock:
+        return dict(_stats)

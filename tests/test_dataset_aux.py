@@ -1,0 +1,159 @@
+"""Dataset/train_from_dataset tier + aux subsystems: stat gauges,
+per-op profiler report, PS heartbeat (reference data_set.h / executor
+train_from_dataset, platform/monitor.h, profiler.cc,
+heart_beat_monitor.h)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import DatasetFactory
+
+
+def _write_slot_file(path, n, seed, dim=4):
+    rng = np.random.RandomState(seed)
+    w = np.arange(1, dim + 1, dtype=np.float32)
+    with open(path, "w") as f:
+        for _ in range(n):
+            x = rng.randn(dim)
+            y = float(x @ w)
+            f.write(" ".join(f"{v:.6f}" for v in x) + ";" +
+                    f"{y:.6f}\n")
+
+
+def _build_regression(fresh):
+    from paddle_tpu.fluid import framework, layers, optimizer
+    main, startup, scope = fresh
+    x = layers.data("x", [-1, 4], "float32")
+    y = layers.data("y", [-1, 1], "float32")
+    pred = layers.fc(x, 1)
+    d = layers.elementwise_sub(pred, y)
+    loss = layers.mean(layers.elementwise_mul(d, d))
+    optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, scope, x, y, loss
+
+
+def test_inmemory_dataset_train(fresh_programs, tmp_path, capsys):
+    from paddle_tpu.fluid import Executor
+    main, startup, scope, x, y, loss = _build_regression(fresh_programs)
+    f1, f2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    _write_slot_file(f1, 120, 0)
+    _write_slot_file(f2, 120, 1)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.init(batch_size=32, thread_num=2, use_var=[x, y])
+    ds.set_filelist([f1, f2])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 240
+    ds.local_shuffle()
+    exe = Executor()
+    exe.run(startup)
+    first = exe.run(main, feed=next(ds.batch_iter()),
+                    fetch_list=[loss])[0]
+    for _ in range(6):
+        exe.train_from_dataset(main, ds, fetch_list=[loss],
+                               print_period=4)
+    out = capsys.readouterr().out
+    assert "train_from_dataset" in out
+    last = exe.run(main, feed=next(ds.batch_iter()), fetch_list=[loss])[0]
+    assert float(np.ravel(last)[0]) < float(np.ravel(first)[0]) * 0.1
+
+
+def test_queue_dataset_streams_with_threads(fresh_programs, tmp_path):
+    main, startup, scope, x, y, loss = _build_regression(fresh_programs)
+    files = []
+    for i in range(4):
+        p = str(tmp_path / f"part-{i}.txt")
+        _write_slot_file(p, 50, i)
+        files.append(p)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.init(batch_size=25, thread_num=3, use_var=[x, y])
+    ds.set_filelist(files)
+    batches = list(ds.batch_iter())
+    assert sum(b["x"].shape[0] for b in batches) == 200
+    assert all(set(b) == {"x", "y"} for b in batches)
+    # batching is consumer-side: sizes independent of thread_num (only
+    # order may vary) — no ragged per-file tails forcing recompiles
+    assert [b["x"].shape[0] for b in batches] == [25] * 8
+
+
+def test_dataset_sample_generator(fresh_programs):
+    main, startup, scope, x, y, loss = _build_regression(fresh_programs)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.init(batch_size=8, use_var=[x, y])
+
+    def gen():
+        rng = np.random.RandomState(0)
+        for _ in range(20):
+            xv = rng.randn(4).astype("float32")
+            yield xv, np.array([xv.sum()], "float32")
+
+    ds.set_sample_generator(gen)
+    batches = list(ds.batch_iter())
+    assert [b["x"].shape[0] for b in batches] == [8, 8, 4]
+
+
+def test_dataset_pipe_command(fresh_programs, tmp_path):
+    """pipe_command preprocesses each file (reference data_feed pipe)."""
+    main, startup, scope, x, y, loss = _build_regression(fresh_programs)
+    p = str(tmp_path / "raw.txt")
+    _write_slot_file(p, 10, 3)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.init(batch_size=5, use_var=[x, y], pipe_command="head -n 5")
+    ds.set_filelist([p])
+    batches = list(ds.batch_iter())
+    assert sum(b["x"].shape[0] for b in batches) == 5
+
+
+def test_monitor_gauges():
+    from paddle_tpu.utils import monitor
+    monitor.stat_reset()
+    monitor.stat_add("sparse_feature_count", 10)
+    monitor.stat_add("sparse_feature_count", 5)
+    monitor.stat_set("epoch", 3)
+    assert monitor.stat_get("sparse_feature_count") == 15
+    assert monitor.get_all_stats() == {"sparse_feature_count": 15,
+                                       "epoch": 3}
+    monitor.stat_reset("epoch")
+    assert monitor.stat_get("epoch") == 0
+
+
+def test_profiler_op_report(tmp_path):
+    from paddle_tpu.utils import profiler as prof
+    a = paddle.to_tensor(np.ones((8, 8), "float32"))
+    path = str(tmp_path / "profile.txt")
+    prof.start_profiler(trace_dir=str(tmp_path / "trace"))
+    for _ in range(3):
+        b = paddle.matmul(a, a)
+        c = paddle.add(b, a)
+    prof.stop_profiler(sorted_key="total", profile_path=path)
+    report = open(path).read()
+    assert "matmul" in report and "elementwise_add" in report
+    # 3 calls each recorded
+    line = [l for l in report.splitlines() if "matmul" in l][0]
+    assert "3" in line.split()[1]
+    # profiler off -> no recording
+    from paddle_tpu.utils.profiler import _op_stats
+    n = dict(_op_stats)
+    paddle.matmul(a, a)
+    assert dict(_op_stats) == n
+
+
+def test_ps_heartbeat_monitor():
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient, PSServer
+    srv = PSServer("127.0.0.1:0", worker_timeout=0.3)
+    srv.serve_in_thread()
+    try:
+        cl = PSClient([srv.endpoint])
+        cl.heartbeat(0)
+        cl.heartbeat(1)
+        assert cl.lost_workers() == []
+        time.sleep(0.4)
+        cl.heartbeat(1)  # worker 1 stays alive; worker 0 goes silent
+        assert cl.lost_workers() == [0]
+        cl.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
