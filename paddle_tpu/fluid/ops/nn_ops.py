@@ -691,3 +691,49 @@ def _interp_nearest(ctx, ins, attrs):
         oh = int(v.shape[2] * attrs["scale"])
         ow = int(v.shape[3] * attrs["scale"])
     return out(jax.image.resize(v, v.shape[:2] + (oh, ow), method="nearest"))
+
+
+# ---------------------------------------------------------------------------
+# quantization simulation ops (reference operators/fake_quantize_op.cc;
+# used by the slim post-training pass — SURVEY §2.6 contrib slim)
+# ---------------------------------------------------------------------------
+
+def _fq_scale(ins, attrs, v):
+    """Calibrated scale: InScale tensor (reference op layout) beats the
+    scale attr; 0/absent falls back to per-batch abs_max."""
+    in_scale = x(ins, "InScale")
+    if in_scale is not None:
+        return in_scale.reshape(())
+    scale = attrs.get("scale", 0.0)
+    if scale:
+        return jnp.asarray(scale, jnp.float32)
+    return jnp.maximum(jnp.max(jnp.abs(v)), 1e-8)
+
+
+def _fake_quant_dequant(ctx, ins, attrs):
+    v = x(ins)
+    bits = attrs.get("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = _fq_scale(ins, attrs, v)
+    q = jnp.clip(jnp.round(v / scale * qmax), -qmax, qmax)
+    return {"Out": [(q * scale / qmax).astype(v.dtype)],
+            "OutScale": [scale.reshape((1,))]}
+
+
+def _fake_quant_grad(ctx, ins, attrs):
+    """Straight-through estimator (reference fake_quantize grad):
+    gradient passes through where |x| <= scale, zero where clipped."""
+    v, og = x(ins, "X"), x(ins, "Out@GRAD")
+    scale = _fq_scale(ins, attrs, v)
+    return {"X@GRAD": [jnp.where(jnp.abs(v) <= scale, og, 0.0)
+                       .astype(og.dtype)]}
+
+
+for _fq_name in ("fake_quantize_dequantize_abs_max",
+                 "fake_quantize_dequantize_moving_average_abs_max"):
+    register(_fq_name, _fake_quant_dequant,
+             infer_shape=same_shape_as("X"),
+             no_grad_slots=("InScale",), no_grad_out_slots=("OutScale",),
+             attrs={"scale": 0.0, "bit_length": 8, "moving_rate": 0.9})
+    register(_fq_name + "_grad", _fake_quant_grad, grad=None,
+             no_grad_slots=("X", "InScale", "Out@GRAD"))

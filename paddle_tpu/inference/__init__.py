@@ -119,6 +119,10 @@ class Predictor:
         self._feed_names = list(feeds)
         self._fetch_vars = fetch_vars
         self._fetch_names = [v.name for v in fetch_vars]
+        # int8-stored weights (slim post-training quantization) are
+        # reconstructed into the scope on load
+        from ..slim.quantization import load_quantized_weights
+        load_quantized_weights(config.model_dir(), self._scope)
         self._inputs = {n: PredictorTensor(n) for n in self._feed_names}
         self._outputs = {n: PredictorTensor(n) for n in self._fetch_names}
         if config._use_bf16:

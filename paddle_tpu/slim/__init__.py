@@ -1,0 +1,7 @@
+"""paddle.slim — model compression (reference
+python/paddle/fluid/contrib/slim/)."""
+from .quantization import (PostTrainingQuantization, load_quantized_weights,
+                           quant_dequant, QUANTIZABLE_OP_TYPES)
+
+__all__ = ["PostTrainingQuantization", "load_quantized_weights",
+           "quant_dequant", "QUANTIZABLE_OP_TYPES"]

@@ -1,0 +1,158 @@
+"""slim post-training quantization (reference contrib/slim/quantization/
+post_training_quantization.py:120 + fake_quantize_op.cc)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.slim import (PostTrainingQuantization, quant_dequant)
+
+
+def _save_model(dirname, seed=0):
+    """Train a small static classifier and save its inference model."""
+    from paddle_tpu.fluid import (Executor, framework, layers, optimizer,
+                                  unique_name)
+    from paddle_tpu.fluid import io as fio
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+
+    paddle.enable_static()
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(4, 16).astype("float32") * 3
+    scope = Scope()
+    with unique_name.guard(), scope_guard(scope):
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 16], "float32")
+            y = layers.data("y", [-1, 1], "int64")
+            h = layers.fc(x, 32, act="relu")
+            logits = layers.fc(h, 4)
+            loss = layers.mean(
+                layers.softmax_with_cross_entropy(logits, y))
+            optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(60):
+            lab = rng.randint(0, 4, (32,))
+            xb = (protos[lab]
+                  + rng.randn(32, 16).astype("float32") * .2)
+            exe.run(main, feed={"x": xb, "y": lab[:, None]
+                                .astype("int64")}, fetch_list=[loss])
+        fio.save_inference_model(dirname, ["x"], [logits], exe,
+                                 main_program=main)
+    paddle.disable_static()
+    return protos
+
+
+def _calib_batches(protos, n=6, seed=1):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        lab = rng.randint(0, 4, (32,))
+        yield {"x": protos[lab]
+               + rng.randn(32, 16).astype("float32") * .2}
+
+
+def test_quant_dequant_math():
+    x = np.array([-1.0, -0.5, 0.0, 0.5, 1.0], np.float32)
+    q = quant_dequant(x, 1.0, bits=8)
+    np.testing.assert_allclose(q, x, atol=1.0 / 127 + 1e-6)
+    # clipping beyond scale
+    q2 = quant_dequant(np.array([5.0], np.float32), 1.0)
+    np.testing.assert_allclose(q2, [1.0], atol=1e-6)
+
+
+def test_post_training_quantization_roundtrip(tmp_path):
+    from paddle_tpu.fluid import Executor
+    from paddle_tpu.inference import Config, Predictor
+
+    src = str(tmp_path / "fp32")
+    dst = str(tmp_path / "int8")
+    protos = _save_model(src)
+
+    paddle.enable_static()
+    ptq = PostTrainingQuantization(
+        Executor(), src, sample_generator=_calib_batches(protos),
+        batch_nums=6, algo="abs_max")
+    program = ptq.quantize()
+    # fake-quant ops inserted before each quantizable op's activation
+    types = [op.type for op in program.global_block().ops]
+    assert "fake_quantize_dequantize_abs_max" in types
+    ptq.save_quantized_model(dst)
+    paddle.disable_static()
+
+    # int8 payloads exist; fp32 copies of those weights are gone
+    qblob = np.load(os.path.join(dst, "__quant_weights__.npz"))
+    int8_names = {k[:-5] for k in qblob.files if k.endswith(".int8")}
+    assert len(int8_names) == 2  # two fc weights
+    with open(os.path.join(dst, "__all__.pdparams"), "rb") as f:
+        params = pickle.load(f)
+    assert not (int8_names & set(params))
+    for k in qblob.files:
+        if k.endswith(".int8"):
+            assert qblob[k].dtype == np.int8
+
+    # quantized predictor agrees with the fp32 predictor on argmax
+    rng = np.random.RandomState(9)
+    lab = rng.randint(0, 4, (64,))
+    xb = protos[lab] + rng.randn(64, 16).astype("float32") * .2
+    ref = Predictor(Config(model_dir=src)).run([xb])[0]
+    out = Predictor(Config(model_dir=dst)).run([xb])[0]
+    agree = (np.argmax(ref, 1) == np.argmax(out, 1)).mean()
+    assert agree > 0.95, agree
+    # and outputs are close but not identical (int8 rounding is real)
+    assert 0 < np.abs(ref - out).max() < np.abs(ref).max() * 0.2
+
+
+def test_fake_quant_straight_through_gradient(fresh_programs):
+    """STE: gradient passes through unclipped entries, zero where the
+    input exceeds the scale (code-review regression — auto-vjp of round
+    gave identically-zero grads)."""
+    from paddle_tpu.fluid import Executor, backward, framework, layers
+    main, startup, scope = fresh_programs
+    gb = main.global_block()
+    xv = layers.data("x", [4], "float32")
+    xv.stop_gradient = False
+    qn = gb.create_var(name="q")
+    gb.append_op(type="fake_quantize_dequantize_abs_max",
+                 inputs={"X": [xv]}, outputs={"Out": [qn]},
+                 attrs={"scale": 1.0, "bit_length": 8})
+    loss = layers.reduce_sum(qn)
+    with framework.program_guard(main, startup):
+        backward.append_backward(loss)
+    exe = Executor()
+    exe.run(startup)
+    g, = exe.run(main, feed={"x": np.array([0.5, -0.9, 2.0, -3.0],
+                                           "float32")},
+                 fetch_list=["x@GRAD"])
+    np.testing.assert_allclose(np.asarray(g), [1, 1, 0, 0], atol=1e-6)
+
+
+def test_fake_quant_in_scale_input():
+    """InScale tensor (reference op layout) overrides the attr/dynamic
+    scale."""
+    from paddle_tpu.fluid import registry
+    import jax.numpy as jnp
+    op = registry.require(
+        "fake_quantize_dequantize_moving_average_abs_max")
+    v = jnp.asarray([0.5, 4.0], jnp.float32)
+    outs = op.compute(None, {"X": [v],
+                             "InScale": [jnp.asarray([1.0])]},
+                      {"scale": 0.0, "bit_length": 8})
+    got = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(got, [0.5, 1.0], atol=1e-2)  # clipped at 1
+    np.testing.assert_allclose(np.asarray(outs["OutScale"][0]), [1.0])
+
+
+def test_ptq_requires_calibration_data(tmp_path):
+    from paddle_tpu.fluid import Executor
+    src = str(tmp_path / "m")
+    _save_model(src)
+    paddle.enable_static()
+    try:
+        ptq = PostTrainingQuantization(Executor(), src,
+                                       sample_generator=None)
+        with pytest.raises(ValueError, match="sample_generator"):
+            ptq.quantize()
+    finally:
+        paddle.disable_static()
