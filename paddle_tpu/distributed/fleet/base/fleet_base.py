@@ -153,6 +153,10 @@ class Fleet:
             acc = st.pipeline_configs.get("accumulate_steps") or 0
             micro = acc if acc > 1 else None
         kwargs.setdefault("n_microbatches", micro)
+        ep = hc.get("ep_degree", 1)
+        if st.expert_parallel and ep == 1:
+            ep = st.expert_parallel_configs["ep_degree"]
+        kwargs.setdefault("ep", ep)
         return HybridParallelTrainStep(cfg, dp=dp, pp=pp, tp=tp, **kwargs)
 
 

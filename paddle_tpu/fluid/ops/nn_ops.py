@@ -737,3 +737,29 @@ for _fq_name in ("fake_quantize_dequantize_abs_max",
              attrs={"scale": 0.0, "bit_length": 8, "moving_rate": 0.9})
     register(_fq_name + "_grad", _fake_quant_grad, grad=None,
              no_grad_slots=("X", "InScale", "Out@GRAD"))
+
+
+# ---------------------------------------------------------------------------
+# Mixture-of-Experts fused feed-forward (parallel/moe.py kernel; the
+# reference's strategy bag ships the expert_parallel flag with no op tier —
+# SURVEY §2.9 mandates the fresh EP design). Grad is auto-vjp.
+# ---------------------------------------------------------------------------
+
+def _moe_infer(op):
+    v = op.invar("X")
+    if v is not None:
+        for name in op.output("Out"):
+            op.block.create_var(name=name, shape=v.shape, dtype=v.dtype)
+    for name in op.output("AuxLoss"):
+        op.block.create_var(name=name, shape=(1,), dtype="float32")
+
+
+@register("moe_ffn", infer_shape=_moe_infer,
+          attrs={"top_k": 1, "capacity_factor": 1.25})
+def _moe_ffn(ctx, ins, attrs):
+    from ...parallel.moe import moe_ffn
+    y, aux = moe_ffn(
+        x(ins, "X"), x(ins, "Gate"), x(ins, "WUp"), x(ins, "BUp"),
+        x(ins, "WDown"), x(ins, "BDown"),
+        capacity_factor=attrs["capacity_factor"], top_k=attrs["top_k"])
+    return {"Out": [y], "AuxLoss": [aux.reshape((1,))]}

@@ -43,6 +43,12 @@ class GPTConfig:
     dropout: float = 0.0
     amp_dtype: str | None = None  # "bfloat16" casts block compute
     attn_impl: str = "xla"  # "xla" | "flash" (Pallas) | "ring" (sp mesh)
+    # Mixture-of-Experts (num_experts > 0 replaces every block's dense FFN
+    # with a routed expert bank — parallel/moe.py, "ep" mesh axis)
+    num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
     # rematerialise each block in backward: the lax.scan over layers would
     # otherwise stash every layer's attention probs ([L,B,H,T,T] — OOM at
     # 350M/seq-1024 on one v5e chip)
@@ -101,10 +107,22 @@ def init_gpt_params(cfg: GPTConfig, seed: int = 0) -> dict:
         "bo": np.zeros((L, D), np.float32),
         "ln2_s": np.ones((L, D), np.float32),
         "ln2_b": np.zeros((L, D), np.float32),
-        "w_up": norm(L, D, F), "b_up": np.zeros((L, F), np.float32),
-        "w_down": norm(L, F, D) / math.sqrt(2 * L),
-        "b_down": np.zeros((L, D), np.float32),
     }
+    E = cfg.num_experts
+    if E > 0:
+        blocks.update({
+            "wg": norm(L, D, E),
+            "we_up": norm(L, E, D, F),
+            "be_up": np.zeros((L, E, F), np.float32),
+            "we_down": norm(L, E, F, D) / math.sqrt(2 * L),
+            "be_down": np.zeros((L, E, D), np.float32),
+        })
+    else:
+        blocks.update({
+            "w_up": norm(L, D, F), "b_up": np.zeros((L, F), np.float32),
+            "w_down": norm(L, F, D) / math.sqrt(2 * L),
+            "b_down": np.zeros((L, D), np.float32),
+        })
     return {
         "wte": norm(cfg.vocab_size, D),
         "wpe": norm(cfg.max_position_embeddings, D),
@@ -114,10 +132,11 @@ def init_gpt_params(cfg: GPTConfig, seed: int = 0) -> dict:
     }
 
 
-def gpt_param_specs(pp_stacked: bool = False) -> dict:
+def gpt_param_specs(pp_stacked: bool = False, moe: bool = False) -> dict:
     """PartitionSpec pytree (megatron-style tp; blocks get a leading "pp"
-    dim when stacked per-stage). Axes not present in the mesh are dropped by
-    ShardingRules._restrict-like resolution in hybrid.py."""
+    dim when stacked per-stage; expert banks shard E over "ep"). Axes not
+    present in the mesh are dropped by ShardingRules._restrict-like
+    resolution in hybrid.py."""
     from jax.sharding import PartitionSpec as P
 
     def blk(*entries):
@@ -130,9 +149,18 @@ def gpt_param_specs(pp_stacked: bool = False) -> dict:
         "wv": blk(None, "tp"), "bv": blk("tp"),
         "wo": blk("tp", None), "bo": blk(None),
         "ln2_s": blk(None), "ln2_b": blk(None),
-        "w_up": blk(None, "tp"), "b_up": blk("tp"),
-        "w_down": blk("tp", None), "b_down": blk(None),
     }
+    if moe:
+        blocks.update({
+            "wg": blk(None, None),
+            "we_up": blk("ep", None, "tp"), "be_up": blk("ep", "tp"),
+            "we_down": blk("ep", "tp", None), "be_down": blk("ep", None),
+        })
+    else:
+        blocks.update({
+            "w_up": blk(None, "tp"), "b_up": blk("tp"),
+            "w_down": blk("tp", None), "b_down": blk(None),
+        })
     return {
         "wte": P("tp", None),
         "wpe": P(),
@@ -192,7 +220,10 @@ def _causal_attention(q, k, v, n_heads, impl="xla"):
 
 
 def gpt_block_fn(p: dict, x, cfg: GPTConfig):
-    """One pre-LN decoder block; p leaves are unstacked ([D,...])."""
+    """One pre-LN decoder block; p leaves are unstacked ([D,...]).
+
+    Returns (x, aux): aux is the MoE load-balance loss of this block's
+    routed FFN (0.0 for the dense FFN)."""
     cdt = jnp.dtype(cfg.amp_dtype) if cfg.amp_dtype else x.dtype
     c = lambda a: a.astype(cdt)
     h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
@@ -202,9 +233,16 @@ def gpt_block_fn(p: dict, x, cfg: GPTConfig):
     a = _causal_attention(q, k, v, cfg.num_heads, cfg.attn_impl)
     x = x + (a @ c(p["wo"]) + c(p["bo"])).astype(x.dtype)
     h = _ln(x, p["ln2_s"], p["ln2_b"], cfg.layer_norm_eps)
+    if cfg.num_experts > 0:
+        from ..parallel.moe import moe_ffn
+        y, aux = moe_ffn(
+            c(h), p["wg"], p["we_up"], p["be_up"], p["we_down"],
+            p["be_down"], capacity_factor=cfg.moe_capacity_factor,
+            top_k=cfg.moe_top_k)
+        return x + y.astype(x.dtype), aux
     u = jax.nn.gelu(c(h) @ c(p["w_up"]) + c(p["b_up"]), approximate=True)
     x = x + (u @ c(p["w_down"]) + c(p["b_down"])).astype(x.dtype)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def _embed(params, ids, cfg: GPTConfig):
@@ -228,33 +266,44 @@ def _head(params, x, cfg: GPTConfig):
 def block_body(cfg: GPTConfig):
     """Scan body over stacked block params, rematerialised per layer when
     cfg.remat (jax.checkpoint — reference RecomputeOptimizer semantics at
-    layer granularity)."""
+    layer granularity). ys is the per-layer MoE aux loss."""
     def body(h, blk):
-        return gpt_block_fn(blk, h, cfg), None
+        return gpt_block_fn(blk, h, cfg)
 
     if cfg.remat:
         ck = jax.checkpoint(lambda blk, h: gpt_block_fn(blk, h, cfg))
-        return lambda h, blk: (ck(blk, h), None)
+        return lambda h, blk: ck(blk, h)
     return body
+
+
+def gpt_forward_aux(params: dict, ids, cfg: GPTConfig):
+    """(logits [B, T, V], aux): aux = summed MoE load-balance loss over
+    layers (0.0 for dense models)."""
+    x = _embed(params, ids, cfg)
+    x, auxs = jax.lax.scan(block_body(cfg), x, params["blocks"])
+    return _head(params, x, cfg), jnp.sum(auxs)
 
 
 def gpt_forward(params: dict, ids, cfg: GPTConfig):
     """ids [B, T] int -> logits [B, T, V]. Blocks run under lax.scan over
     the stacked [L, ...] leaves."""
-    x = _embed(params, ids, cfg)
-    x, _ = jax.lax.scan(block_body(cfg), x, params["blocks"])
-    return _head(params, x, cfg)
+    return gpt_forward_aux(params, ids, cfg)[0]
 
 
 def gpt_loss(params: dict, ids, cfg: GPTConfig, logits=None):
-    """Mean next-token cross entropy; predicts ids[:,1:] from ids[:,:-1]."""
+    """Mean next-token cross entropy; predicts ids[:,1:] from ids[:,:-1].
+    MoE models add cfg.moe_aux_weight * load-balance aux."""
+    aux = None
     if logits is None:
-        logits = gpt_forward(params, ids, cfg)
+        logits, aux = gpt_forward_aux(params, ids, cfg)
     logits = logits[:, :-1]
     labels = ids[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
-    return jnp.mean(logz - gold)
+    loss = jnp.mean(logz - gold)
+    if aux is not None and cfg.num_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------

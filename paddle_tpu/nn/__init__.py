@@ -10,6 +10,7 @@ from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
                   SimpleRNN, LSTM, GRU)
 from . import decode
 from .decode import beam_search
+from .moe import MoELayer
 from ..fluid.dygraph.layers import Layer
 from ..fluid.clip import (ClipGradByValue, ClipGradByNorm,
                           ClipGradByGlobalNorm)
@@ -20,4 +21,4 @@ __all__ = ["Layer", "functional", "initializer", "ClipGradByValue",
            "TransformerDecoderLayer", "TransformerDecoder",
            "Transformer", "RNNCellBase", "SimpleRNNCell", "LSTMCell",
            "GRUCell", "RNN", "BiRNN", "SimpleRNN", "LSTM",
-           "GRU"] + list(_common_all)
+           "GRU", "MoELayer"] + list(_common_all)

@@ -1,0 +1,65 @@
+"""nn.MoELayer — eager/static Mixture-of-Experts feed-forward layer.
+
+API parity target: paddle.incubate's MoE layer family (absent at the
+reference's vintage; the fleet strategy bag already carries the
+`expert_parallel` flag). Built on the fused `moe_ffn` op (fluid/ops/
+nn_ops.py) whose kernel is parallel/moe.py — so the tape differentiates it
+(auto-vjp) and the same layer works in dygraph and static graphs. Under a
+mesh with an "ep" axis (parallel.moe.moe_context), the expert buffers
+shard over ep and dispatch rides all_to_all.
+"""
+from __future__ import annotations
+
+import math
+
+from ..common_ops import run_op_multi
+from ..fluid.dygraph.layers import Layer
+from ..fluid.initializer import XavierInitializer
+
+__all__ = ["MoELayer"]
+
+
+class MoELayer(Layer):
+    """Routed FFN: y, aux = moe(x) with x: [..., d_model].
+
+    Args:
+      d_model: token width.
+      d_hidden: per-expert hidden width.
+      num_experts: expert count E (shardable over the "ep" mesh axis).
+      top_k: experts per token (1 = Switch, 2 = GShard).
+      capacity_factor: static buffer slack; overflow tokens are dropped to
+        keep shapes static (their residual path still carries them).
+    """
+
+    def __init__(self, d_model: int, num_experts: int, d_hidden: int = None,
+                 top_k: int = 1, capacity_factor: float = 1.25,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        d_hidden = d_hidden or 4 * d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        init = XavierInitializer()
+        self.gate_weight = self.create_parameter(
+            [d_model, num_experts], attr=weight_attr,
+            default_initializer=init)
+        self.w_up = self.create_parameter(
+            [num_experts, d_model, d_hidden], attr=weight_attr,
+            default_initializer=init)
+        self.b_up = self.create_parameter(
+            [num_experts, d_hidden], attr=bias_attr, is_bias=True)
+        self.w_down = self.create_parameter(
+            [num_experts, d_hidden, d_model], attr=weight_attr,
+            default_initializer=init)
+        self.b_down = self.create_parameter(
+            [num_experts, d_model], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        outs = run_op_multi(
+            "moe_ffn",
+            {"X": x, "Gate": self.gate_weight, "WUp": self.w_up,
+             "BUp": self.b_up, "WDown": self.w_down, "BDown": self.b_down},
+            attrs={"top_k": self.top_k,
+                   "capacity_factor": self.capacity_factor},
+            out_slots={"Out": "float32", "AuxLoss": "float32"})
+        return outs["Out"][0], outs["AuxLoss"][0]

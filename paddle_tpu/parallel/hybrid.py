@@ -32,21 +32,22 @@ from .sharding import _restrict
 
 __all__ = ["HybridParallelTrainStep", "make_hybrid_mesh"]
 
-_DECAY = {"wte", "wpe", "wq", "wk", "wv", "wo", "w_up", "w_down"}
+_DECAY = {"wte", "wpe", "wq", "wk", "wv", "wo", "w_up", "w_down",
+          "we_up", "we_down"}
 
 
 def make_hybrid_mesh(dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
-                     devices=None) -> Mesh:
-    """("pp","dp","sp","tp") mesh — tp innermost so its collectives ride
-    the fastest ICI links; sp next (ring attention's ppermute hops);
-    pp outermost (cheapest traffic: one activation per microbatch
-    tick)."""
+                     ep: int = 1, devices=None) -> Mesh:
+    """("pp","dp","sp","ep","tp") mesh — tp innermost so its collectives
+    ride the fastest ICI links; ep next (MoE all_to_all dispatch); sp next
+    (ring attention's ppermute hops); pp outermost (cheapest traffic: one
+    activation per microbatch tick)."""
     devs = np.array(devices if devices is not None else jax.devices())
-    n = dp * pp * tp * sp
+    n = dp * pp * tp * sp * ep
     if devs.size < n:
         raise ValueError(f"need {n} devices, have {devs.size}")
-    return Mesh(devs[:n].reshape(pp, dp, sp, tp),
-                ("pp", "dp", "sp", "tp"))
+    return Mesh(devs[:n].reshape(pp, dp, sp, ep, tp),
+                ("pp", "dp", "sp", "ep", "tp"))
 
 
 class HybridParallelTrainStep:
@@ -55,15 +56,27 @@ class HybridParallelTrainStep:
 
     def __init__(self, cfg: G.GPTConfig, mesh: Mesh | None = None,
                  dp: int = 1, pp: int = 1, tp: int = 1, sp: int = 1,
-                 n_microbatches: int | None = None, lr=1e-4,
+                 ep: int = 1, n_microbatches: int | None = None, lr=1e-4,
                  weight_decay: float = 0.01, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
                  grad_clip_norm: float | None = 1.0, seed: int = 0,
                  devices=None):
         if mesh is None:
-            mesh = make_hybrid_mesh(dp, pp, tp, sp, devices)
+            mesh = make_hybrid_mesh(dp, pp, tp, sp, ep, devices)
         self.sp = mesh.shape.get("sp", 1)
         self.pp = mesh.shape.get("pp", 1)
+        self.ep = mesh.shape.get("ep", 1)
+        if self.ep > 1 and cfg.num_experts <= 0:
+            raise ValueError("ep>1 needs a MoE model (cfg.num_experts>0)")
+        if cfg.num_experts > 0:
+            if self.pp > 1:
+                raise NotImplementedError(
+                    "MoE x pipeline: the stage scan drops the per-layer "
+                    "load-balance aux — shard experts OR layers (yet)")
+            if self.ep > 1 and cfg.num_experts % self.ep:
+                raise ValueError(
+                    f"num_experts={cfg.num_experts} not divisible by "
+                    f"ep={self.ep}")
         if self.sp > 1:
             if self.pp > 1:  # judged off the MESH, not the ctor args
                 raise NotImplementedError(
@@ -94,7 +107,8 @@ class HybridParallelTrainStep:
             params["blocks"] = {
                 k: v.reshape(self.pp, lps, *v.shape[1:])
                 for k, v in params["blocks"].items()}
-        specs = G.gpt_param_specs(pp_stacked=self.pp > 1)
+        specs = G.gpt_param_specs(pp_stacked=self.pp > 1,
+                                  moe=cfg.num_experts > 0)
         self._specs = jax.tree_util.tree_map(
             lambda s: _restrict(s, mesh), specs,
             is_leaf=lambda s: isinstance(s, P))
@@ -122,6 +136,14 @@ class HybridParallelTrainStep:
 
     # ------------------------------------------------------------------
     def loss_fn(self, params, ids):
+        cfg, mesh = self.cfg, self.mesh
+        if cfg.num_experts > 0:
+            from .moe import moe_context
+            with moe_context(mesh, "ep"):
+                return self._loss_inner(params, ids)
+        return self._loss_inner(params, ids)
+
+    def _loss_inner(self, params, ids):
         cfg, mesh = self.cfg, self.mesh
         if self.sp > 1:
             from .sequence_parallel import ring_context

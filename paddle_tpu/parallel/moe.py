@@ -1,0 +1,155 @@
+"""Mixture-of-Experts with expert parallelism over an "ep" mesh axis.
+
+The reference ships the `expert_parallel` strategy flag in fleet's
+DistributedStrategy but (at its vintage) no MoE runtime; SURVEY §2.9 lists
+EP/MoE among the parallelism strategies the TPU build must design fresh.
+Design follows GShard/Switch-Transformer, shaped for the MXU:
+
+  * top-k routing with a STATIC per-expert capacity (no dynamic shapes —
+    overflow tokens are dropped, their residual path carries them),
+  * dense one-hot dispatch/combine einsums (batched matmuls, not scatters),
+  * experts stacked on a leading E dim; sharding E over the "ep" mesh axis
+    makes GSPMD lower the dispatch/combine einsums to all_to_all over ep,
+  * router maths in float32 regardless of the compute dtype.
+
+`moe_context(mesh, axis)` marks the ambient mesh so `moe_ffn` can pin the
+[E, C, D] expert buffers to the ep axis with a sharding constraint
+(mirrors sequence_parallel.ring_context).
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["moe_capacity", "topk_gating", "moe_ffn", "moe_context",
+           "current_moe_mesh"]
+
+_moe_stack: list[tuple[Mesh, str]] = []
+
+
+@contextlib.contextmanager
+def moe_context(mesh: Mesh, axis: str = "ep"):
+    """Marks the mesh axis expert buffers should shard over (consumed by
+    moe_ffn; models/gpt.py enters it when the hybrid step has an ep axis)."""
+    _moe_stack.append((mesh, axis))
+    try:
+        yield
+    finally:
+        _moe_stack.pop()
+
+
+def current_moe_mesh():
+    return _moe_stack[-1] if _moe_stack else None
+
+
+def moe_capacity(n_tokens: int, n_experts: int,
+                 capacity_factor: float = 1.25, top_k: int = 1,
+                 multiple_of: int = 8) -> int:
+    """Static per-expert buffer length C: tokens beyond it are dropped
+    (their residual connection still carries them forward)."""
+    c = math.ceil(capacity_factor * top_k * n_tokens / n_experts)
+    return max(multiple_of, multiple_of * math.ceil(c / multiple_of))
+
+
+def topk_gating(logits, top_k: int, capacity: int):
+    """GShard-style router.
+
+    Args:
+      logits: [N, E] router scores (any float dtype; softmax runs fp32).
+      top_k: experts per token (1 = Switch, 2 = GShard).
+      capacity: static per-expert buffer length C.
+
+    Returns:
+      dispatch: [N, E, C] 0/1 float32 — token n occupies slot c of expert e.
+      combine:  [N, E, C] float32 — dispatch weighted by (normalised) gates.
+      aux: scalar load-balance loss (Switch eq. 4: E * Σ_e f_e · P_e),
+        differentiable through the router probabilities.
+    """
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    masks, gates = [], []
+    remaining = probs
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)       # [N, E]
+        masks.append(m)
+        gates.append(jnp.sum(probs * m, axis=-1))           # [N]
+        remaining = remaining * (1.0 - m)
+
+    # aux loss on the FIRST choice (Switch definition): fraction routed vs
+    # mean router prob, per expert.
+    f = jnp.mean(masks[0], axis=0)                          # [N,E] -> [E]
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+
+    # normalise kept gates so the combine weights of a token sum to 1
+    denom = sum(gates)
+    gates = [g / jnp.maximum(denom, 1e-9) for g in gates]
+
+    # slot positions: k-th choices queue up after all earlier choices
+    dispatch = jnp.zeros((N, E, capacity), jnp.float32)
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    offset = jnp.zeros((E,), jnp.float32)
+    for m, g in zip(masks, gates):
+        pos = jnp.cumsum(m, axis=0) - 1.0 + offset[None, :]  # [N, E]
+        offset = offset + jnp.sum(m, axis=0)
+        keep = m * (pos < capacity)                          # [N, E]
+        slot = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)      # [N]
+        slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        d = keep[:, :, None] * slot_oh[:, None, :]           # [N, E, C]
+        dispatch = dispatch + d
+        combine = combine + d * g[:, None, None]
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, wg, we_up, be_up, we_down, be_down, *,
+            capacity_factor: float = 1.25, top_k: int = 1,
+            act=None):
+    """MoE feed-forward: route, dispatch, expert FFN, combine.
+
+    Args:
+      x: [B, T, D] (or [N, D]) activations.
+      wg: [D, E] router weights.
+      we_up/be_up: [E, D, F] / [E, F] expert up-projections.
+      we_down/be_down: [E, F, D] / [E, D] expert down-projections.
+
+    Returns (y, aux): y shaped like x; aux the load-balance scalar.
+    """
+    if act is None:
+        act = lambda u: jax.nn.gelu(u, approximate=True)
+    shape = x.shape
+    D = shape[-1]
+    E = we_up.shape[0]
+    xf = x.reshape(-1, D)
+    N = xf.shape[0]
+    C = moe_capacity(N, E, capacity_factor, top_k)
+
+    logits = xf.astype(jnp.float32) @ wg.astype(jnp.float32)
+    dispatch, combine, aux = topk_gating(logits, top_k, C)
+
+    ctx = current_moe_mesh()
+
+    def pin(a, spec):
+        if ctx is None:
+            return a
+        mesh, axis = ctx
+        if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+            return a
+        named = P(*[axis if s == "ep" else None for s in spec])
+        return jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, named))
+
+    xin = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xf)
+    xin = pin(xin, ("ep", None, None))            # all_to_all over ep
+    h = act(jnp.einsum("ecd,edf->ecf", xin, we_up.astype(x.dtype))
+            + be_up[:, None, :].astype(x.dtype))
+    out = (jnp.einsum("ecf,efd->ecd", h, we_down.astype(x.dtype))
+           + be_down[:, None, :].astype(x.dtype))
+    out = pin(out, ("ep", None, None))
+    y = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out)
+    return y.reshape(shape), aux

@@ -139,7 +139,14 @@ class PostTrainingQuantization:
             wname = op.input(_W_SLOT[op.type])[0]
             if wname in self._weight_int8:
                 continue
-            w = np.asarray(self._scope.find_var(wname), np.float32)
+            wv = self._scope.find_var(wname)
+            if wv is None:
+                # the "weight" slot holds an activation (e.g. attention
+                # scores via matmul(h, h)) — only persistable vars get
+                # weight quantization (reference quantization_pass.py
+                # filters on var.persistable)
+                continue
+            w = np.asarray(wv, np.float32)
             # conv filters quantize per output channel (axis 0); matmul
             # weights per output column (last axis)
             axis = 0 if op.type.endswith("conv2d") else w.ndim - 1

@@ -106,7 +106,8 @@ def test_fleet_strategy_consumes_pipeline_and_tp():
     _fleet.init(is_collective=True, strategy=strategy)
     cfg = GPTConfig.tiny()
     step = _fleet.hybrid_train_step(cfg, seed=0)
-    assert dict(step.mesh.shape) == {"pp": 2, "dp": 2, "sp": 1, "tp": 2}
+    assert dict(step.mesh.shape) == {"pp": 2, "dp": 2, "sp": 1, "ep": 1,
+                                     "tp": 2}
     assert step.n_micro == 4
     loss = step(_ids(cfg))
     assert np.isfinite(float(loss))

@@ -1,0 +1,151 @@
+"""Mixture-of-Experts + expert parallelism over the "ep" mesh axis.
+
+Parity: fleet DistributedStrategy's expert_parallel flag (the reference
+carries the flag without a runtime at its vintage; SURVEY §2.9 mandates the
+fresh EP design). Runs on the virtual 8-device CPU mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel.moe import moe_capacity, topk_gating, moe_ffn
+
+
+def test_gating_dispatch_shapes_and_conservation():
+    rng = np.random.RandomState(0)
+    N, E, C = 64, 4, moe_capacity(64, 4, capacity_factor=2.0)
+    logits = jnp.asarray(rng.randn(N, E).astype(np.float32))
+    dispatch, combine, aux = topk_gating(logits, top_k=1, capacity=C)
+    assert dispatch.shape == (N, E, C) and combine.shape == (N, E, C)
+    # each token occupies at most one slot (top-1), ample capacity => all
+    per_tok = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert per_tok.max() <= 1.0 + 1e-6
+    assert per_tok.sum() == N  # capacity 2x => nothing dropped
+    # no slot double-booked
+    per_slot = np.asarray(jnp.sum(dispatch, axis=0))
+    assert per_slot.max() <= 1.0 + 1e-6
+    # kept tokens' combine weights sum to their (normalised) gate = 1 for k=1
+    cw = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(cw[per_tok > 0], 1.0, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_gating_drops_overflow_tokens():
+    # all tokens want expert 0; capacity 8 => only 8 dispatched
+    logits = jnp.tile(jnp.asarray([[10.0, 0.0]], jnp.float32), (32, 1))
+    dispatch, _, _ = topk_gating(logits, top_k=1, capacity=8)
+    assert float(jnp.sum(dispatch)) == 8.0
+
+
+def test_top2_routes_to_two_experts():
+    rng = np.random.RandomState(1)
+    N, E = 16, 4
+    logits = jnp.asarray(rng.randn(N, E).astype(np.float32))
+    C = moe_capacity(N, E, capacity_factor=2.0, top_k=2)
+    dispatch, combine, _ = topk_gating(logits, top_k=2, capacity=C)
+    per_tok = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    assert (per_tok == 2).all()
+    cw = np.asarray(jnp.sum(combine, axis=(1, 2)))
+    np.testing.assert_allclose(cw, 1.0, atol=1e-5)  # gates renormalised
+
+
+def test_moe_ffn_single_expert_matches_dense():
+    rng = np.random.RandomState(2)
+    B, T, D, F = 2, 8, 16, 32
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    wg = jnp.zeros((D, 1), jnp.float32)
+    wu = jnp.asarray(rng.randn(1, D, F).astype(np.float32) * 0.1)
+    bu = jnp.zeros((1, F), jnp.float32)
+    wd = jnp.asarray(rng.randn(1, F, D).astype(np.float32) * 0.1)
+    bd = jnp.zeros((1, D), jnp.float32)
+    y, aux = moe_ffn(x, wg, wu, bu, wd, bd, capacity_factor=2.0)
+    ref = jax.nn.gelu(x @ wu[0] + bu[0], approximate=True) @ wd[0] + bd[0]
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(float(aux), 1.0, atol=1e-5)  # E*f*p = 1
+
+
+def test_moe_ffn_differentiable():
+    rng = np.random.RandomState(3)
+    B, T, D, F, E = 2, 8, 8, 16, 4
+    x = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+    params = dict(
+        wg=jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.1),
+        wu=jnp.asarray(rng.randn(E, D, F).astype(np.float32) * 0.1),
+        bu=jnp.zeros((E, F), jnp.float32),
+        wd=jnp.asarray(rng.randn(E, F, D).astype(np.float32) * 0.1),
+        bd=jnp.zeros((E, D), jnp.float32))
+
+    def loss(p):
+        y, aux = moe_ffn(x, p["wg"], p["wu"], p["bu"], p["wd"], p["bd"],
+                         capacity_factor=2.0)
+        return jnp.mean(y * y) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for k, v in g.items():
+        assert np.isfinite(np.asarray(v)).all(), k
+    # the router receives gradient (through combine weights + aux)
+    assert float(jnp.max(jnp.abs(g["wg"]))) > 0
+
+
+def test_moe_layer_eager_tape_grad():
+    import paddle_tpu as paddle
+    paddle.disable_static()
+    layer = paddle.nn.MoELayer(d_model=8, num_experts=4, d_hidden=16,
+                               capacity_factor=2.0)
+    x = paddle.to_tensor(
+        np.random.RandomState(4).randn(2, 8, 8).astype("float32"),
+        stop_gradient=False)
+    y, aux = layer(x)
+    assert tuple(y.shape) == (2, 8, 8)
+    loss = paddle.mean(y * y) + 0.01 * aux
+    loss.backward()
+    for p in layer.parameters():
+        assert p.grad is not None, p.name
+        assert np.isfinite(np.asarray(p.grad._value)).all()
+
+
+def test_gpt_moe_trains_with_expert_parallel():
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel.hybrid import HybridParallelTrainStep
+    cfg = GPTConfig.tiny(num_experts=4)
+    ids = np.random.RandomState(5).randint(
+        0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    s1 = HybridParallelTrainStep(cfg, dp=1, seed=0,
+                                 devices=jax.devices()[:1])
+    s8 = HybridParallelTrainStep(cfg, dp=2, ep=2, tp=2, seed=0)
+    # expert bank sharded over ep (dim0) and tp (last dim)
+    wu = s8.params["blocks"]["we_up"]
+    assert wu.sharding.spec == P(None, "ep", None, "tp")
+    losses1, losses8 = [], []
+    for _ in range(3):
+        losses1.append(float(s1(ids)))
+        losses8.append(float(s8(ids)))
+    np.testing.assert_allclose(losses1, losses8, atol=5e-4)
+    assert losses8[-1] < losses8[0]  # it trains
+
+
+def test_ep_requires_moe_model():
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel.hybrid import HybridParallelTrainStep
+    with pytest.raises(ValueError, match="num_experts"):
+        HybridParallelTrainStep(GPTConfig.tiny(), dp=4, ep=2)
+
+
+def test_fleet_strategy_consumes_expert_parallel():
+    import paddle_tpu.distributed.fleet as fleet
+    from paddle_tpu.distributed.fleet.base.fleet_base import _fleet
+    from paddle_tpu.models.gpt import GPTConfig
+    strategy = fleet.DistributedStrategy()
+    strategy.expert_parallel = True
+    strategy.expert_parallel_configs = {"ep_degree": 2}
+    strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 1,
+                               "mp_degree": 2}
+    _fleet.init(is_collective=True, strategy=strategy)
+    cfg = GPTConfig.tiny(num_experts=4)
+    step = _fleet.hybrid_train_step(cfg, seed=0)
+    assert step.ep == 2 and step.mesh.shape["ep"] == 2
+    loss = step(np.random.RandomState(6).randint(
+        0, cfg.vocab_size, (8, 32)).astype(np.int32))
+    assert np.isfinite(float(loss))
