@@ -20,12 +20,34 @@ def apply_meta_optimizers(optimizer, strategy, role_maker):
         opt = fopt.LambOptimizer(
             learning_rate=opt._learning_rate,
             lamb_weight_decay=cfg["lamb_weight_decay"])
+    if strategy.lars and hasattr(opt, "_learning_rate"):
+        cfg = strategy.lars_configs
+        opt = fopt.LarsMomentumOptimizer(
+            learning_rate=opt._learning_rate,
+            momentum=getattr(opt, "_momentum", 0.9),
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 0.0),
+            parameter_list=getattr(opt, "_parameter_list", None))
+    if strategy.dgc and hasattr(opt, "_learning_rate"):
+        cfg = strategy.dgc_configs
+        opt = fopt.DGCMomentumOptimizer(
+            learning_rate=opt._learning_rate,
+            momentum=getattr(opt, "_momentum", 0.9),
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=tuple(cfg.get("sparsity", (0.999,))),
+            parameter_list=getattr(opt, "_parameter_list", None))
     if strategy.recompute:
         opt = fopt.RecomputeOptimizer(opt)
         opt._set_checkpoints(strategy.recompute_configs.get("checkpoints"))
     if strategy.gradient_merge:
         cfg = strategy.gradient_merge_configs
         opt = fopt.GradientMergeOptimizer(opt, cfg["k_steps"], cfg["avg"])
+    if strategy.localsgd:
+        cfg = strategy.localsgd_configs
+        opt = fopt.LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 1),
+                                     begin_step=cfg.get("begin_step", 1))
     if strategy.amp:
         from ....amp.static_decorator import decorate_static
         opt = decorate_static(opt, strategy.amp_configs)

@@ -358,3 +358,83 @@ def _average_accumulates(ctx, ins, attrs):
             "out_num_accumulates": [(n + 1).reshape((1,))],
             "out_old_num_accumulates": [x(ins, "in_old_num_accumulates")],
             "out_num_updates": [x(ins, "in_num_updates")]}
+
+
+@register("lars_momentum", grad=None,
+          attrs={"mu": 0.9, "lars_coeff": 0.001,
+                 "lars_weight_decay": 0.0005, "epsilon": 0.0})
+def _lars_momentum(ctx, ins, attrs):
+    """Layer-wise adaptive rate scaling (reference
+    operators/optimizers/lars_momentum_op.cc): the local lr of each param
+    scales with ||param|| / (||grad|| + wd*||param||)."""
+    p, g, v = x(ins, "Param"), x(ins, "Grad"), x(ins, "Velocity")
+    lr = _lr(ins)
+    mu, coeff = attrs["mu"], attrs["lars_coeff"]
+    wd, eps = attrs["lars_weight_decay"], attrs["epsilon"]
+    g = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps), lr)
+    v_new = mu * v + local_lr * (g + wd * p32)
+    return {"ParamOut": [(p32 - v_new).astype(p.dtype)],
+            "VelocityOut": [v_new]}
+
+
+@register("dgc_momentum", grad=None,
+          attrs={"mu": 0.9, "ratio": 0.001, "rampup_begin_step": 0.0,
+                 "use_nesterov": False})
+def _dgc_momentum(ctx, ins, attrs):
+    """Deep Gradient Compression (reference operators/dgc_op.h +
+    dgc_momentum_op.h, fused): momentum correction (u), local residual
+    accumulation (v), top-ratio selection by |v| — the selected slice
+    updates the param, the rest stays local. The reference sends the
+    selected values through a sparse allgather; under GSPMD the grads
+    arriving here are already mesh-reduced, so the selection keeps DGC's
+    *convergence semantics* (its bandwidth saving is an artifact of the
+    NCCL transport the TPU build replaces)."""
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    u, v = x(ins, "U"), x(ins, "V")
+    step = x(ins, "CurrentStep").reshape(())
+    lr = _lr(ins)
+    mu, ratio = attrs["mu"], attrs["ratio"]
+    g = g.astype(jnp.float32)
+    # momentum correction: momentum accumulates BEFORE compression
+    u_new = mu * u + g
+    v_acc = v + u_new
+    flat = jnp.abs(v_acc.reshape(-1))
+    thr = jnp.quantile(flat, jnp.clip(1.0 - ratio, 0.0, 1.0)) \
+        if flat.size > 1 else jnp.zeros((), jnp.float32)
+    mask = (jnp.abs(v_acc) >= thr).astype(jnp.float32)
+    encoded = v_acc * mask
+    in_rampup = step < attrs["rampup_begin_step"]
+    # pre-rampup: vanilla momentum (no compression, no residual)
+    p_dgc = p.astype(jnp.float32) - lr * encoded
+    p_mom = p.astype(jnp.float32) - lr * u_new
+    p_new = jnp.where(in_rampup, p_mom, p_dgc)
+    v_new = jnp.where(in_rampup, v, v_acc * (1.0 - mask))
+    return {"ParamOut": [p_new.astype(p.dtype)], "UOut": [u_new],
+            "VOut": [v_new],
+            "CurrentStepOut": [(step + 1.0).reshape((1,))]}
+
+
+@register("localsgd_sync", grad=None,
+          attrs={"k_steps": 1, "begin_step": 1})
+def _localsgd_sync(ctx, ins, attrs):
+    """LocalSGD parameter averaging tick (reference fleet
+    meta_optimizers/localsgd_optimizer.py inserted c_allreduce block):
+    on every k-th step blend the param to its data-parallel world
+    average. Under traced mesh execution the average rides lax.pmean over
+    the dp axis when one is ambient; otherwise (params replicated /
+    single process) it is the identity and only the mask logic runs."""
+    p = x(ins, "Param")
+    step = x(ins, "Step").reshape(())
+    k, begin = attrs["k_steps"], attrs["begin_step"]
+    try:
+        avg = jax.lax.pmean(p, "dp")
+    except NameError:  # no ambient dp axis: replicated params, identity
+        avg = p
+    do_sync = (step >= begin) & (jnp.mod(step, float(k)) == 0.0)
+    return {"ParamOut": [jnp.where(do_sync, avg, p)]}

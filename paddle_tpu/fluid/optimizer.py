@@ -6,6 +6,8 @@ donates the old param/accumulator buffers, so updates are in-place on device.
 """
 from __future__ import annotations
 
+import contextlib
+
 import numpy as np
 
 from . import layers, unique_name
@@ -23,6 +25,8 @@ __all__ = [
     "AdadeltaOptimizer", "Lamb", "LambOptimizer", "Ftrl", "FtrlOptimizer",
     "DecayedAdagrad", "DecayedAdagradOptimizer", "ExponentialMovingAverage",
     "RecomputeOptimizer", "GradientMergeOptimizer", "LookaheadOptimizer",
+    "LarsMomentumOptimizer", "DGCMomentumOptimizer", "LocalSGDOptimizer",
+    "ModelAverage",
 ]
 
 
@@ -754,15 +758,297 @@ class GradientMergeOptimizer(Optimizer):
 
 
 class LookaheadOptimizer:
-    """Lookahead wrapper (reference optimizer.py:4828)."""
+    """Lookahead (reference optimizer.py:4828): the inner ("fast")
+    optimizer steps normally; every k steps the slow weights move
+    slow += alpha*(fast - slow) and the fast weights reset to them.
+    Dygraph-mode wrapper (slow weights live host-side per param)."""
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not (0.0 <= alpha <= 1.0):
+            raise ValueError("alpha should be in [0, 1]")
         self.inner_optimizer = inner_optimizer
         self.alpha = alpha
-        self.k = k
+        self.k = int(k)
+        self._slow: dict[str, object] = {}
+        self._step = 0
 
-    def minimize(self, loss, startup_program=None):
-        return self.inner_optimizer.minimize(loss, startup_program)
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not in_dygraph_mode():
+            raise NotImplementedError(
+                "static-graph Lookahead: wrap the train loop with "
+                "ExponentialMovingAverage or run dygraph")
+        res = self.inner_optimizer.minimize(
+            loss, parameter_list=parameter_list, no_grad_set=no_grad_set)
+        import jax.numpy as jnp
+        params = parameter_list or \
+            self.inner_optimizer._parameter_list or []
+        for p in params:
+            if p.name not in self._slow:
+                self._slow[p.name] = jnp.asarray(p._value)
+        self._step += 1
+        if self._step % self.k == 0:
+            for p in params:
+                slow = self._slow[p.name] + self.alpha * (
+                    p._value - self._slow[p.name])
+                self._slow[p.name] = slow
+                p._set_value(slow)
+        return res
+
+    def step(self):
+        self.minimize(None)
+
+    def clear_grad(self):
+        if hasattr(self.inner_optimizer, "clear_grad"):
+            self.inner_optimizer.clear_grad()
+
+
+class LarsMomentumOptimizer(Optimizer):
+    """LARS (reference optimizer.py:1272 LarsMomentumOptimizer /
+    operators/optimizers/lars_momentum_op.cc): per-layer lr scaled by
+    ||param|| / (||grad|| + wd*||param||)."""
+    type = "lars_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _attrs(self):
+        return {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                "epsilon": self._epsilon}
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            type="lars_momentum",
+            inputs={"Param": [p], "Grad": [g], "Velocity": [v],
+                    "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs=self._attrs())
+
+    def _eager_acc_specs(self):
+        return (("Velocity", "VelocityOut", 0.0, False),)
+
+    def _eager_attrs(self):
+        return self._attrs()
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression momentum (reference optimizer.py:1355
+    DGCMomentumOptimizer + operators/dgc_op.h): top-`1-sparsity` residual
+    selection with momentum correction; vanilla momentum during rampup.
+    The dgc_momentum op keeps DGC's convergence semantics; the sparse
+    transport it implied is subsumed by XLA's dense mesh collectives."""
+    type = "dgc_momentum"
+
+    def __init__(self, learning_rate, momentum=0.9,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity=(0.999,), use_nesterov=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._momentum = momentum
+        self._rampup_begin_step = float(rampup_begin_step)
+        # keep-ratio = 1 - sparsity (reference ramps through the tuple;
+        # the terminal sparsity governs steady state)
+        self._ratio = 1.0 - float(sparsity[-1])
+        self._use_nesterov = use_nesterov
+
+    def _attrs(self):
+        return {"mu": self._momentum, "ratio": self._ratio,
+                "rampup_begin_step": self._rampup_begin_step,
+                "use_nesterov": self._use_nesterov}
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("u_acc", p)
+            self._add_accumulator("v_acc", p)
+            self._add_accumulator("dgc_step", p, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        u = self._get_accumulator("u_acc", p)
+        v = self._get_accumulator("v_acc", p)
+        st = self._get_accumulator("dgc_step", p)
+        return block.append_op(
+            type="dgc_momentum",
+            inputs={"Param": [p], "Grad": [g], "U": [u], "V": [v],
+                    "CurrentStep": [st], "LearningRate": [self._lr_var]},
+            outputs={"ParamOut": [p.name], "UOut": [u.name],
+                     "VOut": [v.name], "CurrentStepOut": [st.name]},
+            attrs=self._attrs())
+
+    def _eager_acc_specs(self):
+        return (("U", "UOut", 0.0, False), ("V", "VOut", 0.0, False),
+                ("CurrentStep", "CurrentStepOut", 0.0, True))
+
+    def _eager_attrs(self):
+        return self._attrs()
+
+
+class LocalSGDOptimizer(Optimizer):
+    """Local SGD (reference fleet meta_optimizers/localsgd_optimizer.py):
+    workers step independently for k_steps, then average parameters
+    across the data-parallel world. The averaging runs through the eager
+    collective tier (multi-process regime); in single-process mesh DP
+    params are replicated and the average is an identity — gradients are
+    already synced every step, so plain training semantics hold."""
+
+    def __init__(self, inner_optimizer, k_steps=1, begin_step=1):
+        inner = inner_optimizer
+        super().__init__(getattr(inner, "_learning_rate", 0.001),
+                         parameter_list=getattr(inner, "_parameter_list",
+                                                None))
+        self.inner_optimizer = inner
+        self.k_steps = int(k_steps)
+        self.begin_step = int(begin_step)
+        self._step_count = 0
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        res = self.inner_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        if in_dygraph_mode():
+            self._step_count += 1
+            if (self._step_count >= self.begin_step
+                    and self._step_count % self.k_steps == 0):
+                self._sync_params(parameter_list
+                                  or self.inner_optimizer._parameter_list)
+        else:
+            self._append_sync_ops(res[1] if isinstance(res, tuple)
+                                  else None)
+        return res
+
+    def step(self):
+        self.minimize(None)
+
+    def clear_grad(self):
+        if hasattr(self.inner_optimizer, "clear_grad"):
+            self.inner_optimizer.clear_grad()
+
+    def _sync_params(self, params):
+        from ..distributed import collective as C
+        from ..distributed.env import get_world_size
+        world = get_world_size()
+        if world <= 1 or not params:
+            return
+        import jax.numpy as jnp
+        for p in params:
+            avg = C.all_reduce(p._value)  # eager multi-process allreduce
+            val = avg._value if hasattr(avg, "_value") else avg
+            p._set_value(jnp.asarray(val) / float(world))
+
+    def _append_sync_ops(self, params_grads):
+        """Static path: blend each param toward the world average on every
+        k-th step (mask computed from a step counter; the allreduce is an
+        identity when params are mesh-replicated)."""
+        if not params_grads:
+            return
+        block = default_main_program().current_block()
+        helper = LayerHelper("localsgd")
+        step = helper.create_global_variable(
+            name=unique_name.generate("localsgd_step"), shape=[1],
+            dtype="float32", persistable=True, value=0.0)
+        block.append_op(type="increment", inputs={"X": [step]},
+                        outputs={"Out": [step.name]}, attrs={"step": 1.0})
+        for p, _g in params_grads:
+            block.append_op(
+                type="localsgd_sync", inputs={"Param": [p], "Step": [step]},
+                outputs={"ParamOut": [p.name]},
+                attrs={"k_steps": self.k_steps,
+                       "begin_step": self.begin_step})
+
+
+class ModelAverage:
+    """Parameter averaging (reference optimizer.py:4228 ModelAverage +
+    operators/optimizers/average_accumulates_op): every executor step the
+    in-graph average_accumulates ops add the current params into running
+    sums; `apply(exe)` swaps params to sum/num_accumulates inside the
+    scope (with restore on exit). Construct AFTER the training optimizer's
+    minimize so the accumulate ops land behind the update ops.
+
+    The reference rotates three window sums (sum_1..3) on
+    max_average_window; this build keeps one running window — the
+    average over the whole accumulation span."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000):
+        if in_dygraph_mode():
+            raise NotImplementedError(
+                "ModelAverage is a static-graph tool; dygraph training "
+                "uses ExponentialMovingAverage")
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._avg_vars = []  # (param, sums..., counters...)
+        block = default_main_program().global_block()
+        helper = LayerHelper("model_average")
+
+        def gvar(pname, suffix, shape, value=0.0):
+            return helper.create_global_variable(
+                name=unique_name.generate(f"{pname}_{suffix}"),
+                shape=shape, dtype="float32", persistable=True,
+                value=value)
+
+        for p in block.all_parameters():
+            s1 = gvar(p.name, "sum_1", list(p.shape))
+            s2 = gvar(p.name, "sum_2", list(p.shape))
+            s3 = gvar(p.name, "sum_3", list(p.shape))
+            na = gvar(p.name, "num_accumulates", [1])
+            ona = gvar(p.name, "old_num_accumulates", [1])
+            nu = gvar(p.name, "num_updates", [1])
+            block.append_op(
+                type="average_accumulates",
+                inputs={"param": [p], "in_sum_1": [s1], "in_sum_2": [s2],
+                        "in_sum_3": [s3], "in_num_accumulates": [na],
+                        "in_old_num_accumulates": [ona],
+                        "in_num_updates": [nu]},
+                outputs={"out_sum_1": [s1.name], "out_sum_2": [s2.name],
+                         "out_sum_3": [s3.name],
+                         "out_num_accumulates": [na.name],
+                         "out_old_num_accumulates": [ona.name],
+                         "out_num_updates": [nu.name]},
+                attrs={"average_window": float(average_window_rate),
+                       "min_average_window": int(min_average_window),
+                       "max_average_window": int(max_average_window)})
+            self._avg_vars.append((p, s1, s2, s3, na, ona))
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap params to their accumulated average inside the scope."""
+        from .executor import global_scope
+        import jax.numpy as jnp
+        scope = global_scope()
+        backup = {}
+        for p, s1, s2, s3, na, ona in self._avg_vars:
+            cur = scope.find_var(p.name)
+            if cur is None:
+                continue
+            backup[p.name] = cur
+            sums = (jnp.asarray(scope.find_var(s1.name))
+                    + jnp.asarray(scope.find_var(s2.name))
+                    + jnp.asarray(scope.find_var(s3.name)))
+            n = (float(np.ravel(np.asarray(scope.find_var(na.name)))[0])
+                 + float(np.ravel(np.asarray(scope.find_var(ona.name)))[0]))
+            if n > 0:
+                scope.set(p.name, (sums / n).astype(jnp.asarray(cur).dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                for name, val in backup.items():
+                    scope.set(name, val)
+
+    def restore(self, executor=None):
+        pass  # restore happens on apply() context exit
 
 
 # 2.0-style short aliases

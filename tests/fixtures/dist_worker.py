@@ -45,6 +45,23 @@ def main():
     if rank == 0:
         assert float(np.asarray(rd._value)[0]) == 3.0
 
+    # LocalSGD: ranks diverge for k_steps, then params sync to the mean
+    from paddle_tpu.fluid import optimizer as fopt
+    lin = paddle.nn.Linear(2, 1)
+    lin.weight._set_value(np.full((2, 1), float(rank), "float32"))
+    lin.bias._set_value(np.zeros((1,), "float32"))
+    opt = fopt.LocalSGDOptimizer(
+        fopt.SGD(learning_rate=0.0,
+                 parameter_list=list(lin.parameters())), k_steps=2)
+    for _ in range(2):  # lr=0 => params only move at the sync tick
+        loss = paddle.mean(lin(paddle.to_tensor(
+            np.ones((4, 2), "float32"))))
+        loss.backward()
+        opt.minimize(loss, parameter_list=list(lin.parameters()))
+        lin.clear_gradients()
+    wsync = np.asarray(lin.weight._value)
+    assert np.allclose(wsync, 0.5), f"localsgd sync got {wsync}"
+
     dist.barrier()
     print(f"worker {rank} OK", flush=True)
 
