@@ -15,7 +15,8 @@ __all__ = [
     "matmul", "label_smooth", "clip_by_norm", "l2_normalize", "pad", "pad2d",
     "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
     "sequence_softmax", "sequence_reverse", "sequence_expand",
-    "segment_pool", "dynamic_rnn",
+    "segment_pool", "dynamic_rnn", "warpctc", "linear_chain_crf",
+    "crf_decoding", "nce", "hsigmoid", "conv3d", "pool3d",
 ]
 
 
@@ -457,3 +458,168 @@ def dynamic_rnn(input, hidden_size, mode="LSTM", num_layers=1,
                             "num_layers": num_layers,
                             "is_bidirec": is_bidirec, "dropout_prob": 0.0})
     return out, h_n
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss on padded-dense inputs (reference layers/loss.py warpctc;
+    the op subsumes warp-ctc). input: [B, T, C] raw logits;
+    label: [B, L]; lengths: [B]. Returns [B, 1] loss."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference("float32")
+    grad = helper.create_variable_for_type_inference("float32", True)
+    ins = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        ins["LogitsLength"] = [input_length]
+    if label_length is not None:
+        ins["LabelLength"] = [label_length]
+    helper.append_op(type="warpctc", inputs=ins,
+                     outputs={"Loss": [loss], "WarpCTCGrad": [grad]},
+                     attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF negative training objective (reference layers/nn.py
+    linear_chain_crf): creates the [num_tags+2, num_tags] transition
+    param; returns the per-sequence log likelihood [B, 1]."""
+    helper = LayerHelper("linear_chain_crf")
+    num_tags = input.shape[-1]
+    trans = helper.create_parameter(
+        param_attr, shape=[num_tags + 2, num_tags], dtype="float32")
+    ll = helper.create_variable_for_type_inference("float32")
+    alpha = helper.create_variable_for_type_inference("float32", True)
+    ee = helper.create_variable_for_type_inference("float32", True)
+    te = helper.create_variable_for_type_inference("float32", True)
+    ins = {"Emission": [input], "Transition": [trans], "Label": [label]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(
+        type="linear_chain_crf", inputs=ins,
+        outputs={"LogLikelihood": [ll], "Alpha": [alpha],
+                 "EmissionExps": [ee], "TransitionExps": [te]},
+        attrs={})
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi path [B, T] (reference layers/nn.py crf_decoding). Pass
+    `transition` to reuse the training CRF's parameter."""
+    helper = LayerHelper("crf_decoding")
+    if transition is None:
+        num_tags = input.shape[-1]
+        transition = helper.create_parameter(
+            param_attr, shape=[num_tags + 2, num_tags], dtype="float32")
+    path = helper.create_variable_for_type_inference("int64")
+    ins = {"Emission": [input], "Transition": [transition]}
+    if length is not None:
+        ins["Length"] = [length]
+    helper.append_op(type="crf_decoding", inputs=ins,
+                     outputs={"ViterbiPath": [path]}, attrs={})
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation (reference layers/nn.py nce)."""
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype="float32")
+    b = helper.create_parameter(bias_attr, shape=[num_total_classes],
+                                dtype="float32", is_bias=True)
+    cost = helper.create_variable_for_type_inference("float32")
+    slog = helper.create_variable_for_type_inference("float32", True)
+    slab = helper.create_variable_for_type_inference("int64", True)
+    ins = {"Input": [input], "Label": [label], "Weight": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op(
+        type="nce", inputs=ins,
+        outputs={"Cost": [cost], "SampleLogits": [slog],
+                 "SampleLabels": [slab]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples,
+               "sampler": {"uniform": 0, "log_uniform": 1}.get(sampler, 0),
+               "seed": seed, "is_sparse": is_sparse})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference layers/nn.py hsigmoid)."""
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, shape=[num_classes - 1, dim],
+                                dtype="float32")
+    b = helper.create_parameter(bias_attr, shape=[num_classes - 1],
+                                dtype="float32", is_bias=True)
+    cost = helper.create_variable_for_type_inference("float32")
+    pre = helper.create_variable_for_type_inference("float32", True)
+    wo = helper.create_variable_for_type_inference("float32", True)
+    ins = {"X": [input], "Label": [label], "W": [w]}
+    if b is not None:
+        ins["Bias"] = [b]
+    helper.append_op(type="hierarchical_sigmoid", inputs=ins,
+                     outputs={"Out": [cost], "PreOut": [pre],
+                              "W_Out": [wo]},
+                     attrs={"num_classes": num_classes,
+                            "is_sparse": is_sparse})
+    return cost
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    """3D convolution, NCDHW (reference layers/nn.py conv3d)."""
+    helper = LayerHelper("conv3d", act=act, name=name)
+    dtype = input.dtype or "float32"
+    to3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    filter_size, stride = to3(filter_size), to3(stride)
+    padding, dilation = to3(padding), to3(dilation)
+    num_channels = input.shape[1]
+    import math
+    std = math.sqrt(2.0 / (filter_size[0] * filter_size[1]
+                           * filter_size[2] * num_channels))
+    w = helper.create_parameter(
+        param_attr,
+        shape=[num_filters, num_channels // groups] + filter_size,
+        dtype=dtype, default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d", inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": groups,
+               "data_format": data_format})
+    b = helper.create_parameter(bias_attr, shape=[num_filters],
+                                dtype=dtype, is_bias=True)
+    if b is not None:
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out], "Y": [b]},
+                         outputs={"Out": [tmp]}, attrs={"axis": 1})
+        out = tmp
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    """3D pooling, NCDHW (reference layers/nn.py pool3d)."""
+    helper = LayerHelper("pool3d", name=name)
+    to3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    out = helper.create_variable_for_type_inference(
+        input.dtype or "float32")
+    helper.append_op(
+        type="pool3d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"pooling_type": pool_type, "ksize": to3(pool_size),
+               "strides": to3(pool_stride), "paddings": to3(pool_padding),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive, "data_format": data_format})
+    return out

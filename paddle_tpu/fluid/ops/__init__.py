@@ -5,4 +5,5 @@ a jax compute fn registered in paddle_tpu.fluid.registry; XLA compiles and
 fuses them (no per-device kernel files, no Eigen/cuBLAS dispatch).
 """
 from . import (math_ops, nn_ops, tensor_ops, random_ops, optimizer_ops,
-               control_ops, metric_ops, sequence_ops)  # noqa: F401
+               control_ops, metric_ops, sequence_ops,
+               structured_loss_ops)  # noqa: F401

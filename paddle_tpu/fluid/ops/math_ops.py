@@ -103,9 +103,10 @@ def _mul(ctx, ins, attrs):
     # reference mul_op: flatten x to 2-D at x_num_col_dims, same for y
     a, b = x(ins, "X"), x(ins, "Y")
     xn, yn = attrs["x_num_col_dims"], attrs["y_num_col_dims"]
-    a2 = a.reshape((int(jnp.prod(jnp.array(a.shape[:xn]))), -1)) \
+    import math as _math
+    a2 = a.reshape((_math.prod(a.shape[:xn]), -1)) \
         if a.ndim > 2 or xn != 1 else a
-    b2 = b.reshape((int(jnp.prod(jnp.array(b.shape[:yn]))), -1)) \
+    b2 = b.reshape((_math.prod(b.shape[:yn]), -1)) \
         if b.ndim > 2 or yn != 1 else b
     r = a2 @ b2
     out_shape = a.shape[:xn] + b.shape[yn:]

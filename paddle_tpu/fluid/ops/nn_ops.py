@@ -763,3 +763,127 @@ def _moe_ffn(ctx, ins, attrs):
         x(ins, "WDown"), x(ins, "BDown"),
         capacity_factor=attrs["capacity_factor"], top_k=attrs["top_k"])
     return {"Out": [y], "AuxLoss": [aux.reshape((1,))]}
+
+
+# ---------------------------------------------------------------------------
+# 3D convolution / pooling (reference operators/conv_op.cc conv3d kernels,
+# conv_transpose_op.cc, pool_op.cc pool3d) — NCDHW layout
+# ---------------------------------------------------------------------------
+
+def _conv3d_infer(op):
+    iv, fv = op.invar("Input"), op.invar("Filter")
+    if iv is None or iv.shape is None or fv is None or fv.shape is None:
+        return
+    s = op.attr("strides", [1, 1, 1])
+    p = op.attr("paddings", [0, 0, 0])
+    d = op.attr("dilations", [1, 1, 1])
+    n = iv.shape[0]
+    oc = fv.shape[0]
+    sp = []
+    for i, (x_, k_) in enumerate(zip(iv.shape[2:], fv.shape[2:])):
+        ek = (k_ - 1) * d[i] + 1
+        sp.append((x_ + 2 * p[i] - ek) // s[i] + 1 if x_ > 0 else x_)
+    for name in op.output("Output"):
+        op.block.create_var(name=name, shape=(n, oc, *sp), dtype=iv.dtype)
+
+
+@register("conv3d", infer_shape=_conv3d_infer,
+          attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                 "dilations": [1, 1, 1], "groups": 1,
+                 "padding_algorithm": "EXPLICIT", "data_format": "NCDHW",
+                 "use_cudnn": False})
+def _conv3d(ctx, ins, attrs):
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    p = attrs.get("paddings", [0, 0, 0])
+    pad = algo if algo in ("SAME", "VALID") else [(q, q) for q in p]
+    r = jax.lax.conv_general_dilated(
+        inp, flt, window_strides=attrs.get("strides", [1, 1, 1]),
+        padding=pad, rhs_dilation=attrs.get("dilations", [1, 1, 1]),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [r]}
+
+
+@register("conv3d_transpose",
+          attrs={"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                 "dilations": [1, 1, 1], "groups": 1,
+                 "padding_algorithm": "EXPLICIT", "output_padding": [],
+                 "data_format": "NCDHW", "output_size": [],
+                 "use_cudnn": False})
+def _conv3d_transpose(ctx, ins, attrs):
+    """out = (i-1)*s + k_eff - 2p + output_padding, via input-dilated conv
+    with the spatially-flipped swapped-IO kernel (same construction as
+    conv2d_transpose above, one more spatial dim)."""
+    inp, flt = x(ins, "Input"), x(ins, "Filter")
+    strides = attrs.get("strides", [1, 1, 1])
+    dil = attrs.get("dilations", [1, 1, 1])
+    g = attrs.get("groups", 1) or 1
+    out_pad = attrs.get("output_padding") or [0, 0, 0]
+    p = attrs.get("paddings", [0, 0, 0])
+    in_c, opg = flt.shape[0], flt.shape[1]
+    ks = flt.shape[2:]
+    k_eff = [dil[i] * (ks[i] - 1) + 1 for i in range(3)]
+    jpads = [(k_eff[i] - 1 - p[i], k_eff[i] - 1 - p[i] + out_pad[i])
+             for i in range(3)]
+    w = flt.reshape(g, in_c // g, opg, *ks)
+    w = jnp.swapaxes(w, 1, 2).reshape(g * opg, in_c // g, *ks)
+    w = w[:, :, ::-1, ::-1, ::-1]
+    r = jax.lax.conv_general_dilated(
+        inp, w, window_strides=(1, 1, 1), padding=jpads,
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=g)
+    return {"Output": [r]}
+
+
+def _pool3d_infer(op):
+    v = op.invar("X")
+    if v is None or v.shape is None:
+        return
+    n, c = v.shape[:2]
+    if op.attr("global_pooling", False):
+        sp = [1, 1, 1]
+    else:
+        k = op.attr("ksize", [2, 2, 2])
+        s = op.attr("strides", [2, 2, 2])
+        p = op.attr("paddings", [0, 0, 0])
+        sp = [(v.shape[2 + i] + 2 * p[i] - k[i]) // s[i] + 1
+              if v.shape[2 + i] > 0 else v.shape[2 + i] for i in range(3)]
+    for name in op.output("Out"):
+        op.block.create_var(name=name, shape=(n, c, *sp), dtype=v.dtype)
+
+
+@register("pool3d", infer_shape=_pool3d_infer,
+          attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+                 "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                 "global_pooling": False, "ceil_mode": False,
+                 "exclusive": True, "adaptive": False,
+                 "data_format": "NCDHW", "use_cudnn": False})
+def _pool3d(ctx, ins, attrs):
+    v = x(ins)
+    ptype = attrs["pooling_type"]
+    if attrs.get("global_pooling") or (attrs.get("adaptive") and
+                                       list(attrs["ksize"]) == [1, 1, 1]):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return out(fn(v, axis=(2, 3, 4), keepdims=True))
+    k, s, p = (list(attrs["ksize"]), list(attrs["strides"]),
+               list(attrs["paddings"]))
+    dims = (1, 1, *k)
+    strides = (1, 1, *s)
+    pads = ((0, 0), (0, 0), *[(q, q) for q in p])
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo(v.dtype).min
+        r = jax.lax.reduce_window(v, init, jax.lax.max, dims, strides,
+                                  pads)
+    else:
+        ssum = jax.lax.reduce_window(v, 0.0, jax.lax.add, dims, strides,
+                                     pads)
+        if attrs.get("exclusive", True) and any(p):
+            cnt = jax.lax.reduce_window(jnp.ones_like(v), 0.0, jax.lax.add,
+                                        dims, strides, pads)
+            r = ssum / cnt
+        else:
+            r = ssum / (k[0] * k[1] * k[2])
+    return out(r)

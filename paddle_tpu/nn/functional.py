@@ -17,6 +17,7 @@ __all__ = [
     "binary_cross_entropy_with_logits", "smooth_l1_loss", "one_hot", "pad",
     "label_smooth", "normalize", "sigmoid_focal_loss", "square_error_cost",
     "log_loss", "margin_ranking_loss", "unfold", "interpolate", "upsample",
+    "conv3d", "max_pool3d", "avg_pool3d", "ctc_loss", "hsigmoid_loss",
 ]
 
 
@@ -471,3 +472,64 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 
 upsample = interpolate
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCDHW", name=None):
+    to3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    out = run_op("conv3d", {"Input": x, "Filter": weight},
+                 {"strides": to3(stride), "paddings": to3(padding),
+                  "dilations": to3(dilation), "groups": groups,
+                  "data_format": data_format}, out_slot="Output")
+    if bias is not None:
+        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    to3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    return run_op("pool3d", {"X": x},
+                  {"pooling_type": "max", "ksize": to3(kernel_size),
+                   "strides": to3(stride if stride is not None
+                                  else kernel_size),
+                   "paddings": to3(padding), "ceil_mode": ceil_mode,
+                   "data_format": data_format})
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW", name=None):
+    to3 = lambda v: [v] * 3 if isinstance(v, int) else list(v)
+    return run_op("pool3d", {"X": x},
+                  {"pooling_type": "avg", "ksize": to3(kernel_size),
+                   "strides": to3(stride if stride is not None
+                                  else kernel_size),
+                   "paddings": to3(padding), "ceil_mode": ceil_mode,
+                   "exclusive": exclusive, "data_format": data_format})
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference paddle.nn.functional.ctc_loss over warpctc).
+    log_probs: [B, T, C] RAW logits in this build (softmax applied inside
+    the op, warp-ctc convention); labels: [B, L]."""
+    loss = run_op("warpctc",
+                  {"Logits": log_probs, "Label": labels,
+                   "LogitsLength": input_lengths,
+                   "LabelLength": label_lengths},
+                  {"blank": blank, "norm_by_times": norm_by_times},
+                  out_slot="Loss",
+                  extra_outs=("WarpCTCGrad",))
+    return _reduce_loss(loss, reduction)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  reduction="mean", name=None):
+    """Hierarchical softmax loss (reference F.hsigmoid_loss)."""
+    ins = {"X": input, "Label": label, "W": weight}
+    if bias is not None:
+        ins["Bias"] = bias
+    loss = run_op("hierarchical_sigmoid", ins,
+                  {"num_classes": num_classes}, out_slot="Out",
+                  extra_outs=("PreOut", "W_Out"))
+    return _reduce_loss(loss, reduction)

@@ -24,7 +24,8 @@ __all__ = [
     "LayerList", "ParameterList", "CrossEntropyLoss", "MSELoss", "L1Loss",
     "NLLLoss", "BCELoss", "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
     "MarginRankingLoss", "Pad2D", "Upsample", "UpsamplingNearest2D",
-    "Identity",
+    "Identity", "Conv3D", "MaxPool3D", "AvgPool3D", "CTCLoss",
+    "HSigmoidLoss",
 ]
 
 
@@ -572,3 +573,90 @@ class UpsamplingNearest2D(Upsample):
     def __init__(self, size=None, scale_factor=None, data_format="NCHW",
                  name=None):
         super().__init__(size, scale_factor, "nearest")
+
+
+class Conv3D(Layer):
+    """3D convolution, NCDHW (reference nn/layer/conv.py Conv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        k = [kernel_size] * 3 if isinstance(kernel_size, int) \
+            else list(kernel_size)
+        self._stride, self._padding = stride, padding
+        self._dilation, self._groups = dilation, groups
+        self._data_format = data_format
+        import math
+        std = math.sqrt(2.0 / (k[0] * k[1] * k[2] * in_channels))
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups] + k, attr=weight_attr,
+            default_initializer=NormalInitializer(0.0, std))
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil = ceil_mode
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._k, self._s, self._p, self._ceil)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._ceil, self._excl = ceil_mode, exclusive
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self._k, self._s, self._p, self._ceil,
+                            self._excl)
+
+
+class CTCLoss(Layer):
+    """CTC loss layer (reference nn/layer/loss.py CTCLoss). Takes RAW
+    logits [B, T, C] (softmax inside, warp-ctc convention)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self._blank, self._reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self._blank, reduction=self._reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical softmax (reference nn/layer/loss.py HSigmoidLoss)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom trees: pass path tables to F.hsigmoid_loss")
+        self._num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = self.create_parameter([num_classes - 1],
+                                          attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias)

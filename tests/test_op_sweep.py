@@ -397,6 +397,67 @@ CASES["conv2d_transpose_grouped"] = OpCase(
     attrs={"strides": [2, 2], "paddings": [1, 1], "groups": 2,
            "output_padding": [1, 1], "dilations": [1, 1]},
     ref=_conv_transpose_ref, grad_atol=1e-2, grad_rtol=1e-2)
+def _conv3d_ref(ins, attrs):
+    import torch
+    import torch.nn.functional as TF
+    r = TF.conv3d(torch.from_numpy(ins["Input"].copy()),
+                  torch.from_numpy(ins["Filter"].copy()),
+                  stride=attrs["strides"], padding=attrs["paddings"][0],
+                  dilation=attrs.get("dilations", [1, 1, 1]),
+                  groups=attrs.get("groups", 1))
+    return {"Output": r.numpy()}
+
+
+case("conv3d", inputs={"Input": _rnd((1, 2, 4, 5, 5), 130),
+                       "Filter": _rnd((3, 2, 2, 3, 3), 131, 0.3)},
+     attrs={"strides": [1, 1, 1], "paddings": [1, 1, 1]},
+     ref=_conv3d_ref, grad_atol=1e-2, grad_rtol=1e-2)
+
+
+def _conv3d_transpose_ref(ins, attrs):
+    import torch
+    import torch.nn.functional as TF
+    r = TF.conv_transpose3d(
+        torch.from_numpy(ins["Input"].copy()),
+        torch.from_numpy(ins["Filter"].copy()),
+        stride=attrs["strides"], padding=attrs["paddings"][0],
+        output_padding=(attrs.get("output_padding") or [0])[0],
+        groups=attrs.get("groups", 1),
+        dilation=attrs.get("dilations", [1, 1, 1]))
+    return {"Output": r.numpy()}
+
+
+case("conv3d_transpose", inputs={"Input": _rnd((1, 2, 3, 4, 4), 132),
+                                 "Filter": _rnd((2, 2, 2, 3, 3), 133, 0.3)},
+     attrs={"strides": [2, 2, 2], "paddings": [1, 1, 1],
+            "output_padding": [1, 1, 1]},
+     ref=_conv3d_transpose_ref, grad_atol=1e-2, grad_rtol=1e-2)
+
+
+def _pool3d_ref(ins, attrs):
+    import torch
+    import torch.nn.functional as TF
+    t = torch.from_numpy(ins["X"].copy())
+    if attrs["pooling_type"] == "max":
+        r = TF.max_pool3d(t, attrs["ksize"], attrs["strides"],
+                          attrs["paddings"][0])
+    else:
+        r = TF.avg_pool3d(t, attrs["ksize"], attrs["strides"],
+                          attrs["paddings"][0])
+    return {"Out": r.numpy()}
+
+
+case("pool3d", inputs={"X": _rnd((1, 2, 4, 4, 4), 134)},
+     attrs={"pooling_type": "max", "ksize": [2, 2, 2],
+            "strides": [2, 2, 2], "paddings": [0, 0, 0]},
+     ref=_pool3d_ref)
+CASES["pool3d_avg"] = OpCase(
+    "pool3d", inputs={"X": _rnd((1, 2, 4, 4, 4), 135)},
+    attrs={"pooling_type": "avg", "ksize": [2, 2, 2],
+           "strides": [2, 2, 2], "paddings": [0, 0, 0],
+           "global_pooling": False, "ceil_mode": False,
+           "exclusive": True, "adaptive": False},
+    ref=_pool3d_ref)
 case("pool2d", inputs={"X": _rnd((1, 2, 4, 4), 104)},
      attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]})
 case("layer_norm", inputs={"X": _rnd((3, 8), 105),
@@ -452,6 +513,10 @@ EXEMPT = {
     # full-network ops covered by dedicated suites
     "rnn",              # tests/test_sequence_rnn (masking/parity/grad)
     "fused_attention",  # tests/test_pallas_kernels + test_transformer_bert
+    "moe_ffn",          # tests/test_moe (routing/grad/parallel)
+    # structured losses: tests/test_structured_losses (torch oracles +
+    # brute-force CRF enumeration + grad checks)
+    "warpctc", "linear_chain_crf", "nce", "hierarchical_sigmoid",
     # debug/identity
     "print",
 }

@@ -1,0 +1,279 @@
+"""CTC / linear-chain CRF / NCE / hierarchical sigmoid
+(reference operators/warpctc_op.cc, linear_chain_crf_op.cc,
+crf_decoding_op.cc, nce_op.cc, hierarchical_sigmoid_op.cc).
+
+Oracles: torch.nn.functional.ctc_loss for CTC (value + input grad),
+brute-force path enumeration for CRF, probability-normalisation and
+training-descent checks for NCE/hsigmoid."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.registry import require
+
+
+def _run(op, ins, attrs=None):
+    opdef = require(op)
+    a = dict(attrs or {})
+    opdef.fill_default_attrs(a)
+    return opdef.compute(None, {k: [jnp.asarray(v)] for k, v in ins.items()},
+                         a)
+
+
+# ---------------------------------------------------------------------------
+# CTC vs torch
+# ---------------------------------------------------------------------------
+
+def _ctc_torch(logits, labels, llen, tlen, blank=0):
+    import torch
+    import torch.nn.functional as TF
+    lp = TF.log_softmax(torch.from_numpy(logits), dim=-1)
+    lp = lp.transpose(0, 1)  # [T, B, C]
+    return TF.ctc_loss(lp, torch.from_numpy(labels),
+                       torch.from_numpy(llen), torch.from_numpy(tlen),
+                       blank=blank, reduction="none",
+                       zero_infinity=False).numpy()
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_warpctc_matches_torch(seed):
+    rng = np.random.RandomState(seed)
+    B, T, C, L = 3, 12, 6, 4
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, L)).astype(np.int32)
+    llen = np.array([12, 9, 7], np.int32)
+    tlen = np.array([4, 3, 2], np.int32)
+    outs = _run("warpctc", {"Logits": logits, "Label": labels,
+                            "LogitsLength": llen, "LabelLength": tlen})
+    got = np.asarray(outs["Loss"][0]).ravel()
+    want = _ctc_torch(logits, labels.astype(np.int64), llen.astype(np.int64),
+                      tlen.astype(np.int64))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_grad_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(2)
+    B, T, C, L = 2, 8, 5, 3
+    logits = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, L)).astype(np.int32)
+    llen = np.array([8, 6], np.int32)
+    tlen = np.array([3, 2], np.int32)
+
+    def loss_sum(lg):
+        outs = _run("warpctc", {"Logits": lg, "Label": labels,
+                                "LogitsLength": llen, "LabelLength": tlen})
+        return jnp.sum(outs["Loss"][0])
+
+    g = jax.grad(loss_sum)(jnp.asarray(logits))
+
+    t = torch.from_numpy(logits).requires_grad_(True)
+    lp = TF.log_softmax(t, dim=-1).transpose(0, 1)
+    tl = TF.ctc_loss(lp, torch.from_numpy(labels.astype(np.int64)),
+                     torch.from_numpy(llen.astype(np.int64)),
+                     torch.from_numpy(tlen.astype(np.int64)),
+                     blank=0, reduction="sum")
+    tl.backward()
+    np.testing.assert_allclose(np.asarray(g), t.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_ctc_functional_and_layer():
+    paddle.disable_static()
+    rng = np.random.RandomState(3)
+    logits = paddle.to_tensor(rng.randn(2, 6, 4).astype("float32"),
+                              stop_gradient=False)
+    labels = paddle.to_tensor(rng.randint(1, 4, (2, 2)).astype("int32"))
+    ll = paddle.to_tensor(np.array([6, 5], "int64"))
+    tl = paddle.to_tensor(np.array([2, 2], "int64"))
+    loss = paddle.nn.CTCLoss()(logits, labels, ll, tl)
+    assert np.isfinite(float(np.ravel(np.asarray(loss._value))[0]))
+    loss.backward()
+    assert logits.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# CRF vs brute force
+# ---------------------------------------------------------------------------
+
+def _crf_brute(em, trans_full, labels, lens):
+    """Enumerate all tag paths. trans_full: [N+2, N] paddle layout."""
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+    B, T, N = em.shape
+    lls, best_paths = [], []
+    for b in range(B):
+        ln = lens[b]
+        scores = {}
+        for path in itertools.product(range(N), repeat=ln):
+            s = start[path[0]] + em[b, 0, path[0]] + stop[path[ln - 1]]
+            for t in range(1, ln):
+                s += trans[path[t - 1], path[t]] + em[b, t, path[t]]
+            scores[path] = s
+        logz = np.logaddexp.reduce(np.array(list(scores.values())))
+        gold = tuple(labels[b, :ln])
+        lls.append(scores[gold] - logz)
+        best_paths.append(max(scores, key=scores.get))
+    return np.array(lls), best_paths
+
+
+def test_linear_chain_crf_matches_enumeration():
+    rng = np.random.RandomState(4)
+    B, T, N = 3, 5, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N + 2, N).astype(np.float32) * 0.5
+    labels = rng.randint(0, N, (B, T)).astype(np.int32)
+    lens = np.array([5, 4, 2], np.int32)
+    outs = _run("linear_chain_crf",
+                {"Emission": em, "Transition": trans, "Label": labels,
+                 "Length": lens})
+    got = np.asarray(outs["LogLikelihood"][0]).ravel()
+    want, _ = _crf_brute(em, trans, labels, lens)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_enumeration():
+    rng = np.random.RandomState(5)
+    B, T, N = 3, 5, 3
+    em = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N + 2, N).astype(np.float32) * 0.5
+    lens = np.array([5, 3, 4], np.int32)
+    outs = _run("crf_decoding", {"Emission": em, "Transition": trans,
+                                 "Length": lens})
+    path = np.asarray(outs["ViterbiPath"][0])
+    _, best = _crf_brute(em, trans, np.zeros((B, T), np.int32), lens)
+    for b in range(B):
+        assert tuple(path[b, :lens[b]]) == best[b], (b, path[b], best[b])
+        assert (path[b, lens[b]:] == 0).all()
+
+
+def test_crf_layer_trains():
+    """Static linear_chain_crf + crf_decoding: NLL decreases and decoding
+    recovers the majority of training tags on a separable toy task."""
+    paddle.enable_static()
+    from paddle_tpu.fluid import (Executor, framework, layers, optimizer,
+                                  unique_name)
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    B, T, N, D = 8, 6, 3, 4
+    rng = np.random.RandomState(6)
+    proto = rng.randn(N, D).astype("float32") * 2
+    tags = rng.randint(0, N, (B, T)).astype("int32")
+    feats = proto[tags] + rng.randn(B, T, D).astype("float32") * 0.1
+    lens = np.full((B,), T, "int64")
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 7
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, T, D], "float32")
+            y = layers.data("y", [-1, T], "int32")
+            ln = layers.data("len", [-1], "int64")
+            em = layers.fc(x, N, num_flatten_dims=2)
+            ll = layers.linear_chain_crf(em, y, length=ln)
+            from paddle_tpu.fluid.layers import tensor as LT
+            loss = layers.mean(LT.scale(ll, -1.0))
+            optimizer.Adam(learning_rate=0.1).minimize(loss)
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(main, feed={"x": feats, "y": tags, "len": lens},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    paddle.disable_static()
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------------------
+# NCE
+# ---------------------------------------------------------------------------
+
+def test_nce_shapes_and_descent():
+    rng = np.random.RandomState(8)
+    B, D, C = 16, 8, 20
+    inp = jnp.asarray(rng.randn(B, D).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, C, (B,)).astype(np.int64))
+    params = {"w": jnp.asarray(rng.randn(C, D).astype(np.float32) * 0.1),
+              "b": jnp.zeros((C,), jnp.float32)}
+
+    def loss(p, rid):
+        outs = _run("nce", {"Input": inp, "Label": lab, "Weight": p["w"],
+                            "Bias": p["b"]},
+                    {"num_total_classes": C, "num_neg_samples": 5,
+                     "_rng_id": rid})
+        return jnp.mean(outs["Cost"][0])
+
+    outs = _run("nce", {"Input": inp, "Label": lab, "Weight": params["w"],
+                        "Bias": params["b"]},
+                {"num_total_classes": C, "num_neg_samples": 5})
+    assert outs["Cost"][0].shape == (B, 1)
+    assert outs["SampleLabels"][0].shape == (B, 5)
+    first = None
+    for i in range(60):
+        l, g = jax.value_and_grad(loss)(params, i)
+        params = jax.tree_util.tree_map(lambda p, gr: p - 0.1 * gr,
+                                        params, g)
+        first = first if first is not None else float(l)
+    assert float(l) < first * 0.7, (first, float(l))
+
+
+def test_nce_log_uniform_sampler():
+    rng = np.random.RandomState(9)
+    outs = _run("nce", {"Input": rng.randn(4, 3).astype(np.float32),
+                        "Label": np.array([0, 1, 2, 3], np.int64),
+                        "Weight": rng.randn(50, 3).astype(np.float32)},
+                {"num_total_classes": 50, "num_neg_samples": 8,
+                 "sampler": 1})
+    neg = np.asarray(outs["SampleLabels"][0])
+    assert ((neg >= 0) & (neg < 50)).all()
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+def test_hsigmoid_is_normalised_distribution():
+    """exp(-cost(c)) summed over all classes must be 1 — the binary-tree
+    path products form a proper softmax replacement."""
+    rng = np.random.RandomState(10)
+    D, C = 6, 7  # non-power-of-two tree
+    xv = rng.randn(1, D).astype(np.float32)
+    w = rng.randn(C - 1, D).astype(np.float32)
+    b = rng.randn(C - 1).astype(np.float32)
+    total = 0.0
+    for c in range(C):
+        outs = _run("hierarchical_sigmoid",
+                    {"X": xv, "Label": np.array([c], np.int64), "W": w,
+                     "Bias": b}, {"num_classes": C})
+        total += math.exp(-float(np.asarray(outs["Out"][0])[0, 0]))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_hsigmoid_layer_trains():
+    paddle.disable_static()
+    rng = np.random.RandomState(11)
+    D, C, B = 8, 10, 32
+    proto = rng.randn(C, D).astype("float32") * 2
+    lab = rng.randint(0, C, (B,))
+    feats = proto[lab] + rng.randn(B, D).astype("float32") * 0.1
+    layer = paddle.nn.HSigmoidLoss(D, C)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=list(layer.parameters()))
+    first = last = None
+    for _ in range(30):
+        cost = layer(paddle.to_tensor(feats),
+                     paddle.to_tensor(lab.astype("int64")))
+        loss = paddle.mean(cost)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lv = float(np.ravel(np.asarray(loss._value))[0])
+        first = first if first is not None else lv
+        last = lv
+    assert last < first * 0.3, (first, last)
