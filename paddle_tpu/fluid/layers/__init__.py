@@ -1,9 +1,11 @@
 """fluid.layers namespace (reference python/paddle/fluid/layers/)."""
-from . import nn, tensor
+from . import nn, tensor, detection
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 
 from .nn import __all__ as _nn_all
 from .tensor import __all__ as _tensor_all
+from .detection import __all__ as _det_all
 
-__all__ = list(_nn_all) + list(_tensor_all)
+__all__ = list(_nn_all) + list(_tensor_all) + list(_det_all)

@@ -1,0 +1,87 @@
+"""Detection layer functions (reference python/paddle/fluid/layers/
+detection.py) over the detection op tier (fluid/ops/detection_ops.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
+           "roi_align", "multiclass_nms"]
+
+
+def _op(op_type, inputs, attrs, out_slots):
+    helper = LayerHelper(op_type)
+    outs = {s: [helper.create_variable_for_type_inference(dt)]
+            for s, dt in out_slots.items()}
+    helper.append_op(type=op_type,
+                     inputs={k: [v] for k, v in inputs.items()
+                             if v is not None},
+                     outputs=outs, attrs=attrs)
+    vals = [outs[s][0] for s in out_slots]
+    return vals[0] if len(vals) == 1 else tuple(vals)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    return _op("iou_similarity", {"X": x, "Y": y},
+               {"box_normalized": box_normalized}, {"Out": "float32"})
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    ins = {"PriorBox": prior_box, "TargetBox": target_box}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        ins["PriorBoxVar"] = prior_box_var
+    return _op("box_coder", ins, attrs, {"OutputBox": "float32"})
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    return _op("prior_box", {"Input": input, "Image": image},
+               {"min_sizes": [float(v) for v in min_sizes],
+                "max_sizes": [float(v) for v in (max_sizes or [])],
+                "aspect_ratios": [float(v) for v in aspect_ratios],
+                "variances": [float(v) for v in variance], "flip": flip,
+                "clip": clip, "step_w": float(steps[0]),
+                "step_h": float(steps[1]), "offset": offset,
+                "min_max_aspect_ratios_order": min_max_aspect_ratios_order},
+               {"Boxes": "float32", "Variances": "float32"})
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    return _op("yolo_box", {"X": x, "ImgSize": img_size},
+               {"anchors": [int(a) for a in anchors],
+                "class_num": class_num, "conf_thresh": conf_thresh,
+                "downsample_ratio": downsample_ratio,
+                "clip_bbox": clip_bbox, "scale_x_y": scale_x_y},
+               {"Boxes": "float32", "Scores": "float32"})
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None, aligned=False):
+    return _op("roi_align",
+               {"X": input, "ROIs": rois, "RoisNum": rois_num},
+               {"pooled_height": pooled_height, "pooled_width": pooled_width,
+                "spatial_scale": spatial_scale,
+                "sampling_ratio": sampling_ratio, "aligned": aligned},
+               {"Out": "float32"})
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    out, _idx, num = _op(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "nms_eta": nms_eta, "normalized": normalized,
+         "background_label": background_label},
+        {"Out": "float32", "Index": "int32", "NmsRoisNum": "int32"})
+    return out, num

@@ -1,0 +1,327 @@
+"""Detection ops: IoU, box coding, priors, YOLO decode, RoIAlign, NMS.
+
+TPU-native equivalents of the reference's operators/detection/* —
+  iou_similarity_op.cc, box_coder_op.cc, prior_box_op.cc, yolo_box_op.cc,
+  roi_align_op.cc, multiclass_nms_op.cc.
+Everything is dense/vectorized jnp with STATIC output shapes: NMS returns a
+fixed keep_top_k-padded [K, 6] block (invalid rows get label -1) instead of
+the reference's LoD output — the LoD-free design of SURVEY §7 applied to
+detection heads. RoIAlign is differentiable (auto-vjp through the bilinear
+gathers); the decode/NMS tier is inference post-processing (grad=None).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register
+from .common import x
+
+
+def _iou_matrix(a, b, normalized=True):
+    """a [N, 4], b [M, 4] (x1, y1, x2, y2) -> [N, M]."""
+    off = 0.0 if normalized else 1.0
+    area = lambda q: jnp.maximum(q[:, 2] - q[:, 0] + off, 0.0) * \
+        jnp.maximum(q[:, 3] - q[:, 1] + off, 0.0)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register("iou_similarity", grad=None,
+          attrs={"box_normalized": True})
+def _iou_similarity(ctx, ins, attrs):
+    a, b = x(ins, "X").astype(jnp.float32), x(ins, "Y").astype(jnp.float32)
+    return {"Out": [_iou_matrix(a, b, attrs["box_normalized"])]}
+
+
+@register("box_coder", grad=None, no_grad_slots=("PriorBox", "PriorBoxVar"),
+          attrs={"code_type": "encode_center_size", "box_normalized": True,
+                 "axis": 0, "variance": []})
+def _box_coder(ctx, ins, attrs):
+    """SSD box coding (reference box_coder_op.h). encode: corner target
+    boxes [N,4] vs priors [M,4] -> [N,M,4] offsets; decode: offsets
+    [N,M,4] (or [N,1,4] broadcast) + priors -> corner boxes."""
+    prior = x(ins, "PriorBox").astype(jnp.float32)      # [M, 4]
+    pvar = x(ins, "PriorBoxVar")
+    tb = x(ins, "TargetBox").astype(jnp.float32)
+    norm = attrs["box_normalized"]
+    off = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None and attrs.get("variance"):
+        pvar = jnp.asarray(attrs["variance"], jnp.float32)[None, :]
+    if attrs["code_type"] == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out_ = jnp.stack([ox, oy, ow, oh], axis=-1)     # [N, M, 4]
+        if pvar is not None:
+            out_ = out_ / jnp.broadcast_to(pvar.astype(jnp.float32),
+                                           out_.shape)
+        return {"OutputBox": [out_]}
+    # decode_center_size: TargetBox [N, M, 4]
+    t = tb if tb.ndim == 3 else tb[:, None, :]
+    if pvar is not None:
+        t = t * jnp.broadcast_to(pvar.astype(jnp.float32), t.shape)
+    axis = attrs.get("axis", 0)
+    # axis 0: priors broadcast over rows; axis 1: over cols
+    ex = (None, slice(None)) if axis == 0 else (slice(None), None)
+    pw_, ph_, pcx_, pcy_ = (q[ex] for q in (pw, ph, pcx, pcy))
+    cx = t[..., 0] * pw_ + pcx_
+    cy = t[..., 1] * ph_ + pcy_
+    w = jnp.exp(t[..., 2]) * pw_
+    h = jnp.exp(t[..., 3]) * ph_
+    out_ = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+    return {"OutputBox": [out_]}
+
+
+@register("prior_box", grad=None,
+          attrs={"min_sizes": [], "max_sizes": [], "aspect_ratios": [1.0],
+                 "variances": [0.1, 0.1, 0.2, 0.2], "flip": False,
+                 "clip": False, "step_w": 0.0, "step_h": 0.0,
+                 "offset": 0.5, "min_max_aspect_ratios_order": False})
+def _prior_box(ctx, ins, attrs):
+    """SSD anchors (reference prior_box_op.h): one box per
+    (min_size x expanded aspect ratio) + sqrt(min*max) per cell."""
+    feat = x(ins, "Input")
+    img = x(ins, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = img.shape[2], img.shape[3]
+    step_w = attrs["step_w"] or IW / W
+    step_h = attrs["step_h"] or IH / H
+    ars = [1.0]
+    for ar in attrs["aspect_ratios"]:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if attrs["flip"]:
+                ars.append(1.0 / float(ar))
+    whs = []
+    for ms in attrs["min_sizes"]:
+        if attrs.get("min_max_aspect_ratios_order"):
+            whs.append((ms, ms))
+            if attrs["max_sizes"]:
+                mx = attrs["max_sizes"][len(whs) and
+                                        attrs["min_sizes"].index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if attrs["max_sizes"]:
+                mx = attrs["max_sizes"][attrs["min_sizes"].index(ms)]
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = np.asarray(whs, np.float32)                   # [P, 2]
+    P = len(whs)
+    cx = (np.arange(W, dtype=np.float32) + attrs["offset"]) * step_w
+    cy = (np.arange(H, dtype=np.float32) + attrs["offset"]) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                      # [H, W]
+    boxes = np.stack([
+        (cxg[:, :, None] - whs[None, None, :, 0] / 2) / IW,
+        (cyg[:, :, None] - whs[None, None, :, 1] / 2) / IH,
+        (cxg[:, :, None] + whs[None, None, :, 0] / 2) / IW,
+        (cyg[:, :, None] + whs[None, None, :, 1] / 2) / IH,
+    ], axis=-1).astype(np.float32)                      # [H, W, P, 4]
+    if attrs["clip"]:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(attrs["variances"], np.float32),
+                          boxes.shape).copy()
+    return {"Boxes": [jnp.asarray(boxes)], "Variances": [jnp.asarray(var)]}
+
+
+@register("yolo_box", grad=None, no_grad_slots=("ImgSize",),
+          attrs={"anchors": [], "class_num": 1, "conf_thresh": 0.01,
+                 "downsample_ratio": 32, "clip_bbox": True,
+                 "scale_x_y": 1.0})
+def _yolo_box(ctx, ins, attrs):
+    """YOLOv3 head decode (reference yolo_box_op.h): X [N, A*(5+C), H, W]
+    -> Boxes [N, H*W*A, 4] (x1y1x2y2 in image pixels), Scores
+    [N, H*W*A, C]. Boxes under conf_thresh are zeroed like the
+    reference."""
+    v = x(ins, "X").astype(jnp.float32)
+    imgsize = x(ins, "ImgSize").astype(jnp.float32)     # [N, 2] (h, w)
+    anchors = np.asarray(attrs["anchors"], np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+    C = attrs["class_num"]
+    N, _, H, W = v.shape
+    ds = attrs["downsample_ratio"]
+    sxy = attrs.get("scale_x_y", 1.0)
+    bias = -0.5 * (sxy - 1.0)
+    v = v.reshape(N, A, 5 + C, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(v[:, :, 0]) * sxy + bias + gx) / W
+    by = (jax.nn.sigmoid(v[:, :, 1]) * sxy + bias + gy) / H
+    input_w, input_h = W * ds, H * ds
+    bw = jnp.exp(v[:, :, 2]) * anchors[None, :, 0, None, None] / input_w
+    bh = jnp.exp(v[:, :, 3]) * anchors[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(v[:, :, 4])
+    probs = jax.nn.sigmoid(v[:, :, 5:]) * conf[:, :, None]
+    imh = imgsize[:, 0][:, None, None, None]
+    imw = imgsize[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if attrs.get("clip_bbox", True):
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+        x2 = jnp.clip(x2, 0.0, imw - 1)
+        y2 = jnp.clip(y2, 0.0, imh - 1)
+    keep = (conf > attrs["conf_thresh"]).astype(jnp.float32)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+    scores = probs * keep[:, :, None]
+    # [N, A, H, W, .] -> [N, H*W*A, .] (reference iteration order: an
+    # outer, then h, w — kept for parity)
+    boxes = boxes.transpose(0, 1, 2, 3, 4).reshape(N, A * H * W, 4)
+    scores = scores.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, C)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register("roi_align", no_grad_slots=("ROIs", "RoisNum"),
+          attrs={"pooled_height": 1, "pooled_width": 1,
+                 "spatial_scale": 1.0, "sampling_ratio": -1,
+                 "aligned": False})
+def _roi_align(ctx, ins, attrs):
+    """RoIAlign (reference roi_align_op.h): average of bilinear samples on
+    a regular grid inside each bin. Differentiable via vjp through the
+    gathers. ROIs [R, 4] + RoisNum [N] (dense replacement of the LoD
+    batch mapping)."""
+    feat = x(ins, "X").astype(jnp.float32)              # [N, C, H, W]
+    rois = x(ins, "ROIs").astype(jnp.float32)           # [R, 4]
+    rois_num = x(ins, "RoisNum")
+    N, Cc, H, W = feat.shape
+    R = rois.shape[0]
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    scale = attrs["spatial_scale"]
+    sr = attrs["sampling_ratio"]
+    sr = sr if sr > 0 else 2
+    aligned = attrs.get("aligned", False)
+    roi_off = 0.5 if aligned else 0.0
+    if rois_num is not None:
+        rn = rois_num.reshape(-1).astype(jnp.int32)
+        batch_idx = jnp.repeat(jnp.arange(rn.shape[0]), rn,
+                               total_repeat_length=R)
+    else:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+
+    x1 = rois[:, 0] * scale - roi_off
+    y1 = rois[:, 1] * scale - roi_off
+    x2 = rois[:, 2] * scale - roi_off
+    y2 = rois[:, 3] * scale - roi_off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    # sample grid: [ph, sr] x [pw, sr] offsets per roi
+    iy = (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr) \
+        .reshape(-1)                                    # [ph*sr]
+    ix = (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5) / sr) \
+        .reshape(-1)                                    # [pw*sr]
+    sy = y1[:, None] + iy[None, :] * bin_h[:, None]     # [R, ph*sr]
+    sx = x1[:, None] + ix[None, :] * bin_w[:, None]     # [R, pw*sr]
+
+    def bilinear(img, yy, xx):
+        """img [C, H, W]; yy [P], xx [Q] -> [C, P, Q]."""
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = yy - y0
+        wx = xx - x0
+        g = lambda yi, xi: img[:, yi][:, :, xi]          # [C, P, Q]
+        top = g(y0, x0) * (1 - wx)[None, None, :] \
+            + g(y0, x1_) * wx[None, None, :]
+        bot = g(y1_, x0) * (1 - wx)[None, None, :] \
+            + g(y1_, x1_) * wx[None, None, :]
+        return top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+
+    def one_roi(b, yy, xx):
+        img = feat[b]                                   # [C, H, W]
+        s = bilinear(img, yy, xx)                       # [C, ph*sr, pw*sr]
+        s = s.reshape(Cc, ph, sr, pw, sr)
+        return jnp.mean(s, axis=(2, 4))                 # [C, ph, pw]
+
+    out_ = jax.vmap(one_roi)(batch_idx, sy, sx)
+    return {"Out": [out_]}
+
+
+@register("multiclass_nms", grad=None,
+          attrs={"score_threshold": 0.05, "nms_top_k": 64,
+                 "keep_top_k": 100, "nms_threshold": 0.3, "nms_eta": 1.0,
+                 "normalized": True, "background_label": 0})
+def _multiclass_nms(ctx, ins, attrs):
+    """Greedy per-class NMS with STATIC shapes (reference
+    multiclass_nms_op.cc). BBoxes [N, M, 4], Scores [N, C, M] ->
+    Out [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded
+    with label -1; NmsRoisNum [N]."""
+    boxes = x(ins, "BBoxes").astype(jnp.float32)
+    scores = x(ins, "Scores").astype(jnp.float32)
+    N, M, _ = boxes.shape
+    C = scores.shape[1]
+    topk = min(attrs["nms_top_k"], M) if attrs["nms_top_k"] > 0 else M
+    keep_k = attrs["keep_top_k"] if attrs["keep_top_k"] > 0 else C * topk
+    thr = attrs["score_threshold"]
+    nms_thr = attrs["nms_threshold"]
+    bg = attrs["background_label"]
+
+    def nms_one_class(sc, bx):
+        """sc [M], bx [M, 4] -> kept score [topk] (suppressed -> 0)."""
+        val, idx = jax.lax.top_k(sc, topk)
+        cand = bx[idx]                                  # [topk, 4]
+        iou = _iou_matrix(cand, cand, attrs["normalized"])
+
+        def body(i, alive):
+            sup = (iou[i] > nms_thr) & (jnp.arange(topk) > i) & alive[i]
+            return alive & ~sup
+        alive = jax.lax.fori_loop(0, topk, body,
+                                  jnp.ones((topk,), bool))
+        keep = alive & (val > thr)
+        return jnp.where(keep, val, 0.0), idx
+
+    def one_image(bx, sc):
+        per = jax.vmap(lambda c: nms_one_class(sc[c], bx))(jnp.arange(C))
+        vals, idxs = per                                 # [C, topk]
+        cls = jnp.broadcast_to(jnp.arange(C)[:, None], (C, topk))
+        if bg >= 0:
+            vals = jnp.where(cls == bg, 0.0, vals)
+        flat_v = vals.reshape(-1)
+        flat_i = idxs.reshape(-1)
+        flat_c = cls.reshape(-1)
+        k = min(keep_k, flat_v.shape[0])
+        top_v, sel = jax.lax.top_k(flat_v, k)
+        out_rows = jnp.concatenate([
+            flat_c[sel][:, None].astype(jnp.float32),
+            top_v[:, None], bx[flat_i[sel]]], axis=1)    # [k, 6]
+        valid = top_v > 0.0
+        out_rows = jnp.where(valid[:, None], out_rows,
+                             jnp.full((1, 6), -1.0))
+        return out_rows, jnp.sum(valid.astype(jnp.int32))
+
+    out_, num = jax.vmap(one_image)(boxes, scores)
+    return {"Out": [out_], "Index": [jnp.zeros((1, 1), jnp.int32)],
+            "NmsRoisNum": [num]}

@@ -2,5 +2,6 @@
 models. Model zoo lives in paddle_tpu.models and is re-exported here."""
 from . import datasets, transforms
 from . import models
+from . import ops
 
-__all__ = ["datasets", "transforms", "models"]
+__all__ = ["datasets", "transforms", "models", "ops"]

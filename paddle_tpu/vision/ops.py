@@ -1,0 +1,67 @@
+"""paddle.vision.ops (reference python/paddle/vision/ops.py): eager/static
+detection operators over the fluid/ops/detection_ops.py tier."""
+from __future__ import annotations
+
+from ..common_ops import run_op, run_op_multi
+
+__all__ = ["yolo_box", "roi_align", "nms", "box_coder"]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    outs = run_op_multi(
+        "yolo_box", {"X": x, "ImgSize": img_size},
+        {"anchors": [int(a) for a in anchors], "class_num": class_num,
+         "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio,
+         "clip_bbox": clip_bbox, "scale_x_y": scale_x_y},
+        out_slots={"Boxes": "float32", "Scores": "float32"})
+    return outs["Boxes"][0], outs["Scores"][0]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return run_op("roi_align",
+                  {"X": x, "ROIs": boxes, "RoisNum": boxes_num},
+                  {"pooled_height": output_size[0],
+                   "pooled_width": output_size[1],
+                   "spatial_scale": spatial_scale,
+                   "sampling_ratio": sampling_ratio, "aligned": aligned})
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Single-class NMS over [M, 4] boxes (reference vision/ops.py nms),
+    via the multiclass_nms kernel with one foreground class. Returns the
+    padded [K, 6] rows (label, score, box) and the kept count."""
+    import jax.numpy as jnp
+    bx = boxes._value if hasattr(boxes, "_value") else jnp.asarray(boxes)
+    sc = scores._value if scores is not None and hasattr(scores, "_value") \
+        else scores
+    M = bx.shape[0]
+    if sc is None:
+        sc = jnp.linspace(1.0, 0.5, M)  # keep input order priority
+    sc = jnp.asarray(sc, jnp.float32)
+    outs = run_op_multi(
+        "multiclass_nms",
+        {"BBoxes": bx[None], "Scores": sc[None, None, :]},
+        {"score_threshold": 0.0, "nms_top_k": M,
+         "keep_top_k": top_k or M, "nms_threshold": iou_threshold,
+         "background_label": -1, "normalized": False},
+        out_slots={"Out": "float32", "Index": "int32",
+                   "NmsRoisNum": "int32"})
+    return outs["Out"][0], outs["NmsRoisNum"][0]
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    ins = {"PriorBox": prior_box, "TargetBox": target_box}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    elif prior_box_var is not None:
+        ins["PriorBoxVar"] = prior_box_var
+    return run_op("box_coder", ins, attrs, out_slot="OutputBox")

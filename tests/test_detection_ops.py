@@ -1,0 +1,205 @@
+"""Detection op tier (reference operators/detection/*): IoU, box coder,
+prior boxes, YOLO decode, RoIAlign (incl. grad), static-shape NMS."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.registry import require
+
+
+def _run(op, ins, attrs=None):
+    opdef = require(op)
+    a = dict(attrs or {})
+    opdef.fill_default_attrs(a)
+    return opdef.compute(
+        None, {k: [jnp.asarray(v)] for k, v in ins.items()}, a)
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)
+    got = np.asarray(_run("iou_similarity", {"X": a, "Y": b})["Out"][0])
+    # IoU(a0,b0)=1; IoU(a0,b1)=0; IoU(a1,b0)=1/7; IoU(a1,b1)=1/7
+    np.testing.assert_allclose(
+        got, [[1.0, 0.0], [1 / 7, 1 / 7]], atol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(5, 4).astype(np.float32))
+    prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+    target = np.abs(rng.rand(3, 4).astype(np.float32))
+    target[:, 2:] = target[:, :2] + 0.3 + target[:, 2:]
+    var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+    enc = np.asarray(_run(
+        "box_coder", {"PriorBox": prior, "TargetBox": target,
+                      "PriorBoxVar": np.tile(var, (5, 1))},
+        {"code_type": "encode_center_size"})["OutputBox"][0])
+    assert enc.shape == (3, 5, 4)
+    dec = np.asarray(_run(
+        "box_coder", {"PriorBox": prior, "TargetBox": enc,
+                      "PriorBoxVar": np.tile(var, (5, 1))},
+        {"code_type": "decode_center_size"})["OutputBox"][0])
+    # decoding the encoding of target against each prior returns target
+    for m in range(5):
+        np.testing.assert_allclose(dec[:, m], target, atol=1e-4)
+
+
+def test_prior_box_geometry():
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    img = np.zeros((1, 3, 64, 64), np.float32)
+    outs = _run("prior_box", {"Input": feat, "Image": img},
+                {"min_sizes": [16.0], "max_sizes": [32.0],
+                 "aspect_ratios": [2.0], "flip": True, "clip": True})
+    boxes = np.asarray(outs["Boxes"][0])
+    var = np.asarray(outs["Variances"][0])
+    # priors: ar 1 + ar 2 + ar 1/2 + sqrt(min*max) = 4 per cell
+    assert boxes.shape == (4, 4, 4, 4) and var.shape == boxes.shape
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    # center cell (1,1): center at (1.5*16)/64 = 0.375
+    b = boxes[1, 1, 0]
+    cx, cy = (b[0] + b[2]) / 2, (b[1] + b[3]) / 2
+    np.testing.assert_allclose([cx, cy], [0.375, 0.375], atol=1e-6)
+    # first prior is square min_size: w = h = 16/64
+    np.testing.assert_allclose(b[2] - b[0], 0.25, atol=1e-6)
+
+
+def test_yolo_box_decode():
+    A, C, H, W, ds = 2, 3, 2, 2, 32
+    rng = np.random.RandomState(1)
+    v = rng.randn(1, A * (5 + C), H, W).astype(np.float32) * 0.1
+    v[0, 4] = 5.0   # anchor 0, conf high everywhere
+    imgsize = np.array([[64, 64]], np.int32)
+    outs = _run("yolo_box", {"X": v, "ImgSize": imgsize},
+                {"anchors": [10, 13, 16, 30], "class_num": C,
+                 "conf_thresh": 0.01, "downsample_ratio": ds})
+    boxes = np.asarray(outs["Boxes"][0])
+    scores = np.asarray(outs["Scores"][0])
+    assert boxes.shape == (1, A * H * W, 4)
+    assert scores.shape == (1, A * H * W, C)
+    assert (scores >= 0).all() and (scores <= 1).all()
+    # hand-decode anchor 0, cell (0,0)
+    tx, ty, tw, th = v[0, 0, 0, 0], v[0, 1, 0, 0], v[0, 2, 0, 0], \
+        v[0, 3, 0, 0]
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    bx = (sig(tx) + 0) / W * 64
+    by = (sig(ty) + 0) / H * 64
+    bw = np.exp(tw) * 10 / (W * ds) * 64
+    bh = np.exp(th) * 13 / (H * ds) * 64
+    np.testing.assert_allclose(
+        boxes[0, 0], [max(bx - bw / 2, 0), max(by - bh / 2, 0),
+                      bx + bw / 2, by + bh / 2], rtol=1e-4)
+
+
+def test_roi_align_linear_feature_exact():
+    """Bilinear interpolation of a linear feature is exact, so each output
+    bin equals the feature at the mean of its sample points."""
+    H = W = 8
+    yy, xx = np.mgrid[0:H, 0:W].astype(np.float32)
+    feat = (2 * xx + 3 * yy)[None, None]               # [1, 1, H, W]
+    rois = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+    outs = _run("roi_align", {"X": feat, "ROIs": rois,
+                              "RoisNum": np.array([1], np.int32)},
+                {"pooled_height": 2, "pooled_width": 2,
+                 "spatial_scale": 1.0, "sampling_ratio": 2,
+                 "aligned": True})
+    got = np.asarray(outs["Out"][0])[0, 0]             # [2, 2]
+    # roi [0.5, 4.5] after aligned offset; bins 2x2 of size 2; sample
+    # means: bin centers at 1.5, 3.5 (y and x)
+    centers = np.array([1.5, 3.5])
+    want = 2 * centers[None, :] + 3 * centers[:, None]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    feat = jnp.asarray(np.random.RandomState(2).rand(1, 2, 6, 6)
+                       .astype(np.float32))
+    rois = jnp.asarray([[0.0, 0.0, 4.0, 4.0], [1.0, 1.0, 5.0, 5.0]],
+                       dtype=jnp.float32)
+
+    def loss(f):
+        outs = _run("roi_align", {"X": f, "ROIs": rois,
+                                  "RoisNum": jnp.asarray([2])},
+                    {"pooled_height": 2, "pooled_width": 2,
+                     "sampling_ratio": 2})
+        return jnp.sum(outs["Out"][0] ** 2)
+
+    g = jax.grad(loss)(feat)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_multiclass_nms_suppression_and_padding():
+    # 3 boxes: 0 and 1 overlap heavily, 2 is separate
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]    # class 1 (class 0 is background)
+    outs = _run("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+                {"score_threshold": 0.05, "nms_top_k": 3,
+                 "keep_top_k": 5, "nms_threshold": 0.5,
+                 "background_label": 0, "normalized": False})
+    out_ = np.asarray(outs["Out"][0])[0]               # [5, 6]
+    num = int(np.asarray(outs["NmsRoisNum"][0])[0])
+    assert num == 2                                     # box1 suppressed
+    kept = out_[out_[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], atol=1e-6)
+    assert (out_[num:, 0] == -1).all()                  # padding rows
+
+
+def test_multiclass_nms_background_excluded():
+    boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+    scores = np.zeros((1, 2, 1), np.float32)
+    scores[0, 0, 0] = 0.99   # background only
+    outs = _run("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+                {"background_label": 0, "keep_top_k": 3})
+    assert int(np.asarray(outs["NmsRoisNum"][0])[0]) == 0
+
+
+def test_vision_ops_eager_api():
+    paddle.disable_static()
+    import paddle_tpu.vision.ops as vops
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(1, 4, 4, 4).astype("float32"))
+    boxes = paddle.to_tensor(
+        np.array([[0, 0, 3, 3]], "float32"))
+    out = vops.roi_align(x, boxes,
+                         paddle.to_tensor(np.array([1], "int32")),
+                         output_size=2)
+    assert tuple(out.shape) == (1, 4, 2, 2)
+    kept, num = vops.nms(
+        paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [1, 1, 11, 11], [40, 40, 50, 50]],
+            "float32")),
+        iou_threshold=0.5,
+        scores=paddle.to_tensor(np.array([0.9, 0.8, 0.7], "float32")))
+    assert int(np.asarray(num._value if hasattr(num, "_value")
+                          else num)[0]) == 2
+
+
+def test_fluid_layers_detection_static():
+    paddle.enable_static()
+    from paddle_tpu.fluid import (Executor, framework, layers,
+                                  unique_name)
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            a = layers.data("a", [-1, 4], "float32")
+            b = layers.data("b", [-1, 4], "float32")
+            iou = layers.iou_similarity(a, b)
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        got, = exe.run(
+            main,
+            feed={"a": np.array([[0, 0, 2, 2]], "float32"),
+                  "b": np.array([[0, 0, 2, 2], [2, 2, 4, 4]], "float32")},
+            fetch_list=[iou])
+    paddle.disable_static()
+    np.testing.assert_allclose(np.asarray(got), [[1.0, 0.0]], atol=1e-6)

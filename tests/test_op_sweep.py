@@ -517,6 +517,9 @@ EXEMPT = {
     # structured losses: tests/test_structured_losses (torch oracles +
     # brute-force CRF enumeration + grad checks)
     "warpctc", "linear_chain_crf", "nce", "hierarchical_sigmoid",
+    # detection: tests/test_detection_ops (linear-feature exactness +
+    # grad-flow check for roi_align)
+    "roi_align",
     # debug/identity
     "print",
 }
