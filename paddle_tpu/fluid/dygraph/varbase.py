@@ -85,6 +85,18 @@ class Tensor:
     def __len__(self):
         return self.shape[0] if self.shape else 0
 
+    def __bool__(self):
+        # eager truthiness of a 0/1-element tensor (reference varbase
+        # __bool__/__nonzero__) — what makes `if tensor:` run in dygraph
+        return bool(np.asarray(self._value).reshape(-1)[0]) \
+            if self.size == 1 else self._raise_ambiguous()
+
+    def _raise_ambiguous(self):
+        raise ValueError(
+            "The truth value of a multi-element Tensor is ambiguous — "
+            "use paddle.all/paddle.any, or to_static for compiled "
+            "control flow")
+
     def __repr__(self):
         return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
                 f"stop_gradient={self.stop_gradient},\n{np.asarray(self._value)})")

@@ -1,5 +1,7 @@
 """fluid.layers namespace (reference python/paddle/fluid/layers/)."""
 from . import nn, tensor, detection
+from .math_op_patch import monkey_patch_variable
+monkey_patch_variable()
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403

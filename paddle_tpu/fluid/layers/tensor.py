@@ -18,6 +18,7 @@ __all__ = [
     "elementwise_mod", "elementwise_floordiv", "scale", "clip",
     "cross_entropy", "softmax_with_cross_entropy", "accuracy", "range",
     "increment", "equal", "less_than", "greater_than", "where", "cond",
+    "while_loop",
 ]
 
 
@@ -447,3 +448,56 @@ def cond(pred, true_fn, false_fn, name=None):
         attrs={"sub_block_true": tb, "sub_block_false": fb,
                "capture_names": caps, "out_names": [o.name for o in outs]})
     return outs[0] if len(outs) == 1 else outs
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while (reference layers/control_flow.py while_loop /
+    While): `body` is traced once into a sub-block of a `while` op that
+    lax.while_loop steps until `cond` is false. Loop vars must keep shape
+    and dtype across iterations (the XLA carry contract); variables read
+    inside but defined outside are loop-invariant captures."""
+    helper = LayerHelper("while_loop", name=name)
+    program = helper.main_program
+    parent = program.current_block()
+    single = not isinstance(loop_vars, (list, tuple))
+    lvs = [loop_vars] if single else list(loop_vars)
+
+    pre = cond(*lvs)
+    blk = program._create_block()
+    res = body(*lvs)
+    res_list = [res] if not isinstance(res, (list, tuple)) else list(res)
+    if len(res_list) != len(lvs):
+        program._rollback()
+        raise ValueError(
+            f"body returned {len(res_list)} vars, expected {len(lvs)}")
+    # write results back onto the carry names, then refresh the condition
+    for lv, nv in zip(lvs, res_list):
+        blk.append_op(type="assign", inputs={"X": [nv]},
+                      outputs={"Out": [lv.name]})
+    new_cond = cond(*lvs)
+    blk.append_op(type="assign", inputs={"X": [new_cond]},
+                  outputs={"Out": [pre.name]})
+    program._rollback()
+
+    carry = {lv.name for lv in lvs} | {pre.name}
+    caps, defined = [], set()
+    for op in blk.ops:
+        for n in op.input_arg_names:
+            if n not in defined and n not in carry and not blk.has_var(n) \
+                    and n not in caps:
+                caps.append(n)
+        defined.update(op.output_arg_names)
+    outs = [helper.create_variable_for_type_inference(
+        lv.dtype or "float32") for lv in lvs]
+    for o, lv in zip(outs, lvs):
+        o.shape = lv.shape
+    cond_out = helper.create_variable_for_type_inference("bool", True)
+    parent.append_op(
+        type="while",
+        inputs={"Condition": [pre], "X": [lv.name for lv in lvs],
+                "Captures": caps},
+        outputs={"Out": [o.name for o in outs], "CondOut": [cond_out]},
+        attrs={"sub_block": blk, "cond_name": pre.name,
+               "carry_names": [lv.name for lv in lvs],
+               "capture_names": caps})
+    return outs[0] if single else outs

@@ -107,6 +107,8 @@ def _while(ctx, ins, attrs):
     body: Block = attrs["sub_block"]
     cond_name: str = attrs["cond_name"]
     carry_names: list = attrs["carry_names"]
+    cap_names: list = attrs.get("capture_names", [])
+    caps = list(ins.get("Captures", []))
     init = [x(ins, "Condition")] + list(ins.get("X", []))
 
     def cond_fn(state):
@@ -114,8 +116,15 @@ def _while(ctx, ins, attrs):
 
     def body_fn(state):
         env = dict(zip([cond_name] + carry_names, state))
+        # captured externals are loop-invariant: closure constants, not
+        # carried state (XLA hoists them out of the loop)
+        env.update(zip(cap_names, caps))
         ctx.exec_block(body, env)
-        return tuple(env[n] for n in [cond_name] + carry_names)
+        new = tuple(env[n] for n in [cond_name] + carry_names)
+        # XLA while requires carry dtype/shape stability
+        return tuple(jnp.broadcast_to(n_, o.shape).astype(o.dtype)
+                     if hasattr(o, "shape") else n_
+                     for n_, o in zip(new, state))
 
     final = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
     return {"Out": list(final[1:]), "CondOut": [final[0]]}

@@ -1,22 +1,27 @@
 """paddle.io — Dataset / DataLoader (reference python/paddle/io/ +
-fluid/reader.py:123).
+fluid/reader.py:123 + fluid/dataloader/dataloader_iter.py).
 
-TPU-native data pipeline: host-side worker threads prefetch+collate batches
-into a bounded queue (double buffering), the executor moves them to device
-asynchronously. (A C++ shared-memory loader backs `num_workers>0` in a later
-round; thread-based prefetch is already overlap-effective because collation
-is numpy and releases the GIL.)
+TPU-native data pipeline, two regimes:
+  * num_workers=0 — a background thread prefetches+collates into a
+    bounded queue (double buffering; collation is numpy and releases the
+    GIL, so the overlap is real).
+  * num_workers>0 — forked worker processes pull index batches from
+    per-worker queues, collate, and stream results back over an output
+    queue; the parent reorders by batch id (the reference's
+    _DataLoaderIterMultiProcess with _order outstanding map).
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import queue as _queue
 import threading
+import traceback as _tb
 
 import numpy as np
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "BatchSampler",
            "Sampler", "SequenceSampler", "RandomSampler", "DataLoader",
-           "random_split", "Subset"]
+           "random_split", "Subset", "WorkerInfo", "get_worker_info"]
 
 
 class Dataset:
@@ -154,6 +159,9 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
+        self.num_workers = max(0, int(num_workers))
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
         elif isinstance(dataset, IterableDataset):
@@ -185,6 +193,9 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        if self.num_workers > 0:
+            yield from _MultiprocessIter(self)
+            return
         if not self.prefetch:
             yield from self._gen_batches()
             return
@@ -210,3 +221,159 @@ class DataLoader:
             yield item
         if err:
             raise err[0]
+
+
+# ---------------------------------------------------------------------------
+# multiprocess workers (reference fluid/dataloader/dataloader_iter.py
+# _DataLoaderIterMultiProcess + worker.py _worker_loop)
+# ---------------------------------------------------------------------------
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: (id, num_workers, dataset) —
+    what IterableDataset shards on (reference worker.py get_worker_info)."""
+    return _worker_info
+
+
+def _map_worker_loop(dataset, collate_fn, index_queue, out_queue,
+                     worker_id, num_workers, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        bid, indices = item
+        try:
+            out_queue.put((bid, collate_fn([dataset[i] for i in indices]),
+                           None))
+        except BaseException:
+            out_queue.put((bid, None, _tb.format_exc()))
+
+
+def _iter_worker_loop(dataset, collate_fn, batch_size, drop_last,
+                      out_queue, worker_id, num_workers, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    try:
+        batch = []
+        for sample in dataset:
+            batch.append(sample)
+            if len(batch) == batch_size:
+                out_queue.put((-1, collate_fn(batch), None))
+                batch = []
+        if batch and not drop_last:
+            out_queue.put((-1, collate_fn(batch), None))
+        out_queue.put((-2, worker_id, None))  # worker drained
+    except BaseException:
+        out_queue.put((-1, None, _tb.format_exc()))
+
+
+class _MultiprocessIter:
+    """Order-preserving fan-out over forked workers. Map-style datasets
+    get round-robin index batches and a reorder buffer; iterable datasets
+    stream unordered (each worker owns its iterator copy — shard with
+    get_worker_info, reference semantics)."""
+
+    def __init__(self, loader: "DataLoader"):
+        self.loader = loader
+        self.nw = loader.num_workers
+        self.timeout = loader.timeout or None
+        self._procs: list = []
+
+    def _start_map(self, ctx):
+        ld = self.loader
+        self.out_q = ctx.Queue()
+        self.idx_qs = [ctx.Queue() for _ in range(self.nw)]
+        for wid in range(self.nw):
+            p = ctx.Process(
+                target=_map_worker_loop,
+                args=(ld.dataset, ld.collate_fn, self.idx_qs[wid],
+                      self.out_q, wid, self.nw, ld.worker_init_fn),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+
+    def __iter__(self):
+        ld = self.loader
+        ctx = _mp.get_context("fork")
+        try:
+            if ld.batch_sampler is None:
+                yield from self._run_iterable(ctx)
+            else:
+                yield from self._run_map(ctx)
+        finally:
+            self._shutdown()
+
+    def _run_map(self, ctx):
+        ld = self.loader
+        self._start_map(ctx)
+        batches = list(ld.batch_sampler)
+        for bid, indices in enumerate(batches):
+            self.idx_qs[bid % self.nw].put((bid, indices))
+        for q in self.idx_qs:
+            q.put(None)
+        pending: dict = {}
+        next_bid = 0
+        got = 0
+        while got < len(batches):
+            bid, data, err = self._get()
+            if err is not None:
+                raise RuntimeError(
+                    f"DataLoader worker raised:\n{err}")
+            pending[bid] = data
+            got += 1
+            while next_bid in pending:
+                yield pending.pop(next_bid)
+                next_bid += 1
+
+    def _run_iterable(self, ctx):
+        ld = self.loader
+        self.out_q = ctx.Queue()
+        for wid in range(self.nw):
+            p = ctx.Process(
+                target=_iter_worker_loop,
+                args=(ld.dataset, ld.collate_fn, ld.batch_size,
+                      ld.drop_last, self.out_q, wid, self.nw,
+                      ld.worker_init_fn),
+                daemon=True)
+            p.start()
+            self._procs.append(p)
+        alive = self.nw
+        while alive:
+            bid, data, err = self._get()
+            if err is not None:
+                raise RuntimeError(f"DataLoader worker raised:\n{err}")
+            if bid == -2:
+                alive -= 1
+                continue
+            yield data
+
+    def _get(self):
+        try:
+            return self.out_q.get(timeout=self.timeout)
+        except _queue.Empty:
+            raise RuntimeError(
+                f"DataLoader timed out after {self.timeout}s waiting on "
+                f"workers (dead worker or too-slow dataset)") from None
+
+    def _shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        self._procs.clear()

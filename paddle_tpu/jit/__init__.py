@@ -1,11 +1,12 @@
 """paddle.jit (reference python/paddle/fluid/dygraph/jit.py +
 dygraph_to_static/ ProgramTranslator).
 
-TPU-native dynamic-to-static: `to_static` wraps a dygraph callable so the
-whole call is traced once and compiled by XLA (jax.jit over the tape replay),
-rather than AST-rewriting Python source like the reference's 13 transformers
-— XLA's trace-based staging subsumes that machinery for the supported
-(fixed-control-flow) subset. `save`/`load` serialise a traced Program.
+TPU-native dynamic-to-static: jax tracing stages all fixed Python control
+flow for free, so `to_static` only needs dy2static.py's AST pass for
+*tensor-dependent* `if`/`while` — those become cond/while sub-block ops
+(lax.cond / lax.while_loop) in static builds and eager Python branches in
+dygraph (Tensor.__bool__). `save`/`load` serialise the traced Program;
+TracedLayer wraps a layer trace as a runnable static program.
 """
 from __future__ import annotations
 
@@ -13,22 +14,28 @@ import functools
 
 import numpy as np
 
-__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static"]
+from . import dy2static
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
+           "ProgramTranslator", "TracedLayer"]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None):
-    """Compile a dygraph function/Layer.forward with XLA via jax.jit.
-
-    The wrapped function still runs eagerly through the tracer (so autograd
-    etc. work); jit acceleration of eager graphs arrives with the fused-step
-    cache. The primary use — export via paddle.jit.save — traces to a static
-    Program.
-    """
+    """Convert a dygraph callable for static compilation: tensor-dependent
+    Python control flow is AST-rewritten into cond/while converter calls
+    (dy2static.convert_to_static, reference program_translator.py:250).
+    Eager calls keep dygraph semantics (tape autograd intact); tracing
+    under a static Program (jit.save / declarative build) emits real
+    control-flow ops."""
     def decorate(fn):
+        converted = dy2static.convert_to_static(fn) \
+            if ProgramTranslator().enable_to_static else fn
+
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            return fn(*args, **kwargs)
+            return converted(*args, **kwargs)
         wrapper._original_fn = fn
+        wrapper._converted_fn = converted
         wrapper._input_spec = input_spec
         return wrapper
     if function is not None:
@@ -37,7 +44,26 @@ def to_static(function=None, input_spec=None, build_strategy=None):
 
 
 def not_to_static(fn):
+    fn._not_to_static = True
     return fn
+
+
+class ProgramTranslator:
+    """Singleton toggle (reference ProgramTranslator.get_instance())."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -128,3 +154,63 @@ def load(path, **configs):
     exe = Executor()
     program, feed_names, fetch_vars = io.load_inference_model(path, exe)
     return TranslatedLayer(program, feed_names, fetch_vars)
+
+
+class TracedLayer:
+    """Static-program trace of a dygraph Layer (reference
+    dygraph/jit.py TracedLayer): `trace` runs the layer once eagerly for
+    the dygraph result AND re-traces it into a Program the returned
+    TracedLayer executes (whole-program jit via the Executor cache).
+    `save_inference_model` exports the trace."""
+
+    def __init__(self, program, feed_names, fetch_vars, layer):
+        from ..fluid.executor import Executor
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_vars = fetch_vars
+        self._layer = layer
+        self._exe = Executor()
+
+    @staticmethod
+    def trace(layer, inputs):
+        from ..fluid import framework, layers
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        dygraph_out = layer(*inputs)
+        main, startup = framework.Program(), framework.Program()
+        was_tracer = framework._dygraph_tracer_
+        framework._dygraph_tracer_ = None
+        try:
+            with framework.program_guard(main, startup):
+                feeds = []
+                for i, t in enumerate(inputs):
+                    val = t._value if hasattr(t, "_value") else np.asarray(t)
+                    feeds.append(layers.data(
+                        f"traced_input_{i}", [-1] + list(val.shape[1:]),
+                        str(val.dtype)))
+                _bind_eager_params_static(layer)
+                outs = layer.forward(*feeds)
+        finally:
+            framework._dygraph_tracer_ = was_tracer
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return dygraph_out, TracedLayer(
+            main, [f.name for f in feeds], list(outs), layer)
+
+    def __call__(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        feed = {n: (t.numpy() if hasattr(t, "numpy") else np.asarray(t))
+                for n, t in zip(self._feed_names, inputs)}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars)
+        from ..fluid.dygraph.varbase import Tensor
+        res = [Tensor(o, stop_gradient=True) for o in outs]
+        return res[0] if len(res) == 1 else res
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from ..fluid import io
+        from ..fluid.executor import Executor
+        fetches = self._fetch_vars if fetch is None \
+            else [self._fetch_vars[i] for i in fetch]
+        feeds = self._feed_names if feed is None \
+            else [self._feed_names[i] for i in feed]
+        io.save_inference_model(path, feeds, fetches, Executor(),
+                                main_program=self._program)

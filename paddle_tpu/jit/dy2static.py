@@ -1,0 +1,307 @@
+"""Dygraph-to-static AST conversion (reference python/paddle/fluid/dygraph/
+dygraph_to_static/ — program_translator.py:250 ProgramTranslator + the
+ifelse/loop transformers).
+
+The TPU build needs far less machinery than the reference's 13
+transformers: jax tracing already stages all FIXED control flow, so only
+*tensor-dependent* Python `if`/`while` must be rewritten. The transform
+hoists branch/loop bodies into local functions and routes them through
+runtime converters that pick the execution mode:
+
+  * static graph build  -> layers.cond / layers.while_loop (sub-block ops
+    compiled by lax.cond / lax.while_loop — the export path)
+  * dygraph, tensor pred -> eager Python branch via Tensor.__bool__ (the
+    tape records the taken branch; autograd intact)
+  * plain Python values  -> untouched Python semantics
+
+v1 constraints (checked, with clear errors or transform skips):
+  * `return`/`break`/`continue` inside a converted branch/loop body are
+    not hoisted — such statements leave the `if`/`while` untransformed
+    (fine for Python preds; a tensor pred then raises via __bool__ in
+    static mode).
+  * loop carries must exist before the loop and keep shape/dtype (the
+    XLA carry contract; reference while_op shares it).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+
+class _Undefined:
+    def __repr__(self):
+        return "<undefined before control flow>"
+
+
+UNDEFINED = _Undefined()
+
+_CONVERTED_CACHE: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# analysis helpers
+# ---------------------------------------------------------------------------
+
+def _assigned_names(node_list):
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, n):
+            for t in n.targets:
+                self._targets(t)
+            self.generic_visit(n)
+
+        def visit_AugAssign(self, n):
+            self._targets(n.target)
+            self.generic_visit(n)
+
+        def visit_AnnAssign(self, n):
+            if n.value is not None:
+                self._targets(n.target)
+
+        def visit_For(self, n):
+            self._targets(n.target)
+            self.generic_visit(n)
+
+        def visit_FunctionDef(self, n):
+            names.append(n.name)  # nested def binds the name; don't recurse
+
+        def _targets(self, t):
+            if isinstance(t, ast.Name):
+                if t.id not in names:
+                    names.append(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._targets(e)
+
+    v = V()
+    for n in node_list:
+        v.visit(n)
+    return names
+
+
+def _read_names(node):
+    names = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            names.add(n.id)
+    return names
+
+
+def _has_flow_escape(node_list):
+    for n in node_list:
+        for sub in ast.walk(n):
+            if isinstance(sub, (ast.Return, ast.Break, ast.Continue)):
+                return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_call(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name("_jst"), attr=fn_name,
+                           ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _guard_stmts(names):
+    """try: __pt_x = x / except NameError: __pt_x = _jst.UNDEFINED"""
+    out = []
+    for v in names:
+        out.append(ast.Try(
+            body=[ast.Assign(targets=[_name(f"__pt_{v}", ast.Store())],
+                             value=_name(v))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError"),
+                                     _name("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_name(f"__pt_{v}", ast.Store())],
+                    value=ast.Attribute(value=_name("_jst"),
+                                        attr="UNDEFINED",
+                                        ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+class DygraphToStaticTransformer(ast.NodeTransformer):
+    """Rewrites If/While whose bodies are hoistable into _jst converter
+    calls (reference ifelse_transformer.py / loop_transformer.py)."""
+
+    def __init__(self):
+        self._uid = 0
+
+    def _next(self):
+        self._uid += 1
+        return self._uid
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or _has_flow_escape(node.orelse):
+            return node
+        mod = _assigned_names(node.body + node.orelse)
+        uid = self._next()
+        args = [ast.arg(arg=v) for v in mod]
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(v) for v in mod], ctx=ast.Load()))
+        tfn = ast.FunctionDef(
+            name=f"__pt_true_{uid}", body=list(node.body) + [ret],
+            args=ast.arguments(posonlyargs=[], args=args, kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            decorator_list=[])
+        ffn = ast.FunctionDef(
+            name=f"__pt_false_{uid}", body=list(node.orelse) + [ret],
+            args=ast.arguments(posonlyargs=[], args=args, kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            decorator_list=[])
+        call = _jst_call("convert_ifelse", [
+            node.test, _name(tfn.name), _name(ffn.name),
+            ast.Tuple(elts=[_name(f"__pt_{v}") for v in mod],
+                      ctx=ast.Load())])
+        if mod:
+            assign = ast.Assign(
+                targets=[ast.Tuple(elts=[_name(v, ast.Store())
+                                         for v in mod], ctx=ast.Store())],
+                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return _guard_stmts(mod) + [tfn, ffn, assign]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_flow_escape(node.body) or node.orelse:
+            return node
+        carries = _assigned_names(node.body)
+        if not carries:
+            return node
+        uid = self._next()
+        args = [ast.arg(arg=v) for v in carries]
+        cfn = ast.FunctionDef(
+            name=f"__pt_cond_{uid}",
+            body=[ast.Return(value=node.test)],
+            args=ast.arguments(posonlyargs=[], args=args, kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            decorator_list=[])
+        bfn = ast.FunctionDef(
+            name=f"__pt_body_{uid}",
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[_name(v) for v in carries], ctx=ast.Load()))],
+            args=ast.arguments(posonlyargs=[], args=args, kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            decorator_list=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(v, ast.Store())
+                                     for v in carries], ctx=ast.Store())],
+            value=_jst_call("convert_while", [
+                _name(cfn.name), _name(bfn.name),
+                ast.Tuple(elts=[_name(f"__pt_{v}") for v in carries],
+                          ctx=ast.Load())]))
+        return _guard_stmts(carries) + [cfn, bfn, assign]
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (the `_jst` module injected into converted globals)
+# ---------------------------------------------------------------------------
+
+def _is_var(v):
+    from ..fluid.framework import Variable
+    return isinstance(v, Variable)
+
+
+def _is_tensor(v):
+    from ..fluid.dygraph.varbase import Tensor
+    return isinstance(v, Tensor)
+
+
+def convert_ifelse(pred, true_fn, false_fn, args):
+    if _is_var(pred):
+        from ..fluid.layers import tensor as LT
+        n = len(args)
+        if n == 0:
+            raise ValueError(
+                "a tensor-pred `if` with no assigned variables has no "
+                "effect in a static graph")
+        res = LT.cond(pred, lambda: true_fn(*args),
+                      lambda: false_fn(*args))
+        return (res,) if n == 1 and not isinstance(res, (list, tuple)) \
+            else tuple(res)
+    taken = true_fn if bool(pred) else false_fn   # Tensor.__bool__ / python
+    return taken(*args)
+
+
+def convert_while(cond_fn, body_fn, args):
+    first = cond_fn(*args)
+    if _is_var(first):
+        from ..fluid.layers import tensor as LT
+        for a in args:
+            if isinstance(a, _Undefined):
+                raise ValueError(
+                    "while-loop carry used before assignment — XLA loop "
+                    "carries must exist before the loop")
+        res = LT.while_loop(cond_fn, body_fn, list(args))
+        return tuple(res) if isinstance(res, (list, tuple)) else (res,)
+    while bool(cond_fn(*args)):
+        new = body_fn(*args)
+        args = new if isinstance(new, tuple) else (new,)
+    return args
+
+
+class _JstModule:
+    UNDEFINED = UNDEFINED
+    convert_ifelse = staticmethod(convert_ifelse)
+    convert_while = staticmethod(convert_while)
+
+
+# ---------------------------------------------------------------------------
+# conversion entry
+# ---------------------------------------------------------------------------
+
+def convert_to_static(fn):
+    """Return fn with tensor control flow rewritten (cached per code
+    object). Falls back to the original fn when source is unavailable
+    (REPL, builtins) — those can't carry tensor-dependent Python flow
+    into export anyway."""
+    key = getattr(fn, "__code__", None)
+    if key in _CONVERTED_CACHE:
+        return _CONVERTED_CACHE[key]
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # drop @to_static-style decorators so exec doesn't recurse
+    if isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        fdef.decorator_list = []
+    new_tree = DygraphToStaticTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    glb = dict(fn.__globals__)
+    glb["_jst"] = _JstModule
+    if fn.__closure__:
+        # free variables become globals of the converted function —
+        # snapshot semantics, same trade the reference makes
+        # (dygraph_to_static/utils.py func_to_source_code + exec)
+        for nm, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb.setdefault(nm, cell.cell_contents)
+            except ValueError:
+                pass
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    loc: dict = {}
+    exec(code, glb, loc)
+    converted = functools.wraps(fn)(loc[fdef.name])
+    _CONVERTED_CACHE[key] = converted
+    return converted

@@ -1,0 +1,219 @@
+"""Dygraph-to-static AST conversion (reference dygraph_to_static/
+program_translator.py + ifelse/loop transformers) and TracedLayer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import dy2static
+
+
+def setup_function(_fn):
+    paddle.disable_static()
+
+
+# ---------------------------------------------------------------------------
+# eager semantics preserved
+# ---------------------------------------------------------------------------
+
+def test_eager_tensor_if_runs_python_branch():
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    xp = paddle.to_tensor(np.ones((2, 2), "float32"))
+    xn = paddle.to_tensor(-np.ones((2, 2), "float32"))
+    np.testing.assert_allclose(np.asarray(f(xp)._value), 2.0)
+    np.testing.assert_allclose(np.asarray(f(xn)._value), -2.0)
+
+
+def test_eager_tensor_while():
+    @paddle.jit.to_static
+    def f(x):
+        i = paddle.to_tensor(np.zeros((1,), "float32"))
+        while i < 3:
+            x = x + 1
+            i = i + 1
+        return x
+
+    out = f(paddle.to_tensor(np.zeros((2,), "float32")))
+    np.testing.assert_allclose(np.asarray(out._value), 3.0)
+
+
+def test_eager_autograd_through_converted_if():
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.mean(x) > 0:
+            y = x * 3
+        else:
+            y = x * 5
+        return paddle.mean(y)
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"), stop_gradient=False)
+    loss = f(x)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 3.0 / 4)
+
+
+def test_python_control_flow_untouched():
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag:                      # plain python pred
+            for _ in range(2):        # static python loop
+                x = x + 1
+        return x
+
+    out = f(paddle.to_tensor(np.zeros((1,), "float32")), True)
+    np.testing.assert_allclose(np.asarray(out._value), 2.0)
+
+
+def test_return_inside_branch_falls_back():
+    # a branch with `return` is not hoisted; python pred still works
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag:
+            return x * 2
+        return x
+
+    out = f(paddle.to_tensor(np.ones((1,), "float32")), True)
+    np.testing.assert_allclose(np.asarray(out._value), 2.0)
+
+
+# ---------------------------------------------------------------------------
+# static export of tensor control flow
+# ---------------------------------------------------------------------------
+
+def _build_static(fn, feeds):
+    from paddle_tpu.fluid import framework, layers
+    paddle.enable_static()
+    main, startup = framework.Program(), framework.Program()
+    with framework.program_guard(main, startup):
+        vars_ = [layers.data(n, shape, dt) for n, shape, dt in feeds]
+        out = fn(*vars_)
+    paddle.disable_static()
+    return main, startup, out
+
+
+def test_static_if_becomes_cond_op():
+    from paddle_tpu.fluid import layers
+
+    @paddle.jit.to_static
+    def f(x):
+        if layers.reduce_mean(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y
+
+    main, startup, out = _build_static(f, [("x", [-1, 2], "float32")])
+    ops = [op.type for op in main.global_block().ops]
+    assert "cond" in ops, ops
+    from paddle_tpu.fluid import Executor
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    paddle.enable_static()
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        pos, = exe.run(main, feed={"x": np.ones((2, 2), "float32")},
+                       fetch_list=[out])
+        neg, = exe.run(main, feed={"x": -np.ones((2, 2), "float32")},
+                       fetch_list=[out])
+    paddle.disable_static()
+    np.testing.assert_allclose(np.asarray(pos), 2.0)
+    np.testing.assert_allclose(np.asarray(neg), -2.0)
+
+
+def test_static_while_becomes_while_op():
+    from paddle_tpu.fluid import layers
+
+    @paddle.jit.to_static
+    def f(x):
+        i = layers.fill_constant([1], "float32", 0.0)
+        while layers.reduce_sum(i) < 4.0:
+            x = x + 1.0
+            i = i + 1.0
+        return x
+
+    main, startup, out = _build_static(f, [("x", [-1, 2], "float32")])
+    ops = [op.type for op in main.global_block().ops]
+    assert "while" in ops, ops
+    from paddle_tpu.fluid import Executor
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    paddle.enable_static()
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.zeros((1, 2), "float32")},
+                       fetch_list=[out])
+    paddle.disable_static()
+    np.testing.assert_allclose(np.asarray(got), 4.0)
+
+
+def test_jit_save_with_tensor_if(tmp_path):
+    """The export path: a layer whose forward has a tensor `if` saves to
+    an inference model containing a cond op and reloads correctly."""
+
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        @paddle.jit.to_static
+        def forward(self, x):
+            h = self.lin(x)
+            if paddle.mean(h) > 0:
+                y = h * 2.0
+            else:
+                y = h * 0.5
+            return y
+
+    from paddle_tpu.static import InputSpec
+    layer = Gate()
+    path = str(tmp_path / "gate")
+    paddle.jit.save(layer, path,
+                    input_spec=[InputSpec([None, 4], "float32", "x")])
+    loaded = paddle.jit.load(path)
+    x = np.ones((2, 4), "float32")
+    want = np.asarray(layer(paddle.to_tensor(x))._value)
+    got = np.asarray(loaded(x)._value)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_program_translator_toggle():
+    pt = paddle.jit.ProgramTranslator()
+    assert pt is paddle.jit.ProgramTranslator.get_instance()
+    pt.enable(False)
+    try:
+        @paddle.jit.to_static
+        def f(x):
+            return x
+
+        assert f._converted_fn is f._original_fn
+    finally:
+        pt.enable(True)
+
+
+def test_traced_layer_roundtrip(tmp_path):
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(3, 2)
+
+        def forward(self, x):
+            return paddle.nn.functional.relu(self.lin(x))
+
+    m = M()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 3).astype("float32"))
+    dy_out, traced = paddle.jit.TracedLayer.trace(m, [x])
+    st_out = traced([x])
+    np.testing.assert_allclose(np.asarray(st_out._value),
+                               np.asarray(dy_out._value), rtol=1e-5)
+    traced.save_inference_model(str(tmp_path / "traced"))
+    loaded = paddle.jit.load(str(tmp_path / "traced"))
+    np.testing.assert_allclose(
+        np.asarray(loaded(np.asarray(x._value))._value),
+        np.asarray(dy_out._value), rtol=1e-5)
