@@ -1,0 +1,125 @@
+"""Elastic training: heartbeats, hang detection, job-level restart.
+
+Reference: python/paddle/distributed/fleet/elastic/* (ElasticManager
+watching etcd heartbeats, restarting the pod on scale events or dead
+nodes). TPU build: no etcd — heartbeats are mtime-touched files in a
+shared directory (PADDLE_ELASTIC_HEARTBEAT_DIR), the launcher's watchdog
+(distributed/launch.py --max_restarts) is the manager: a crashed or hung
+rank tears the whole job down and respawns it; training scripts resume
+from their latest checkpoint (incubate/checkpoint.py TrainEpochRange),
+which is exactly the reference's pod-restart recovery contract — XLA
+collectives cannot re-admit a single lost rank mid-step any more than
+NCCL could.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["HeartbeatWriter", "start_heartbeat", "stale_ranks",
+           "ElasticManager"]
+
+_HB_SUFFIX = ".hb"
+
+
+def _hb_path(dir_, rank):
+    return os.path.join(dir_, f"rank{rank}{_HB_SUFFIX}")
+
+
+class HeartbeatWriter:
+    """Touches this rank's heartbeat file every `interval` seconds from a
+    daemon thread. The launcher treats a file older than its timeout as a
+    hung rank."""
+
+    def __init__(self, dir_: str, rank: int, interval: float = 1.0):
+        self.path = _hb_path(dir_, rank)
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._touch()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _touch(self):
+        with open(self.path, "w") as f:
+            f.write(str(time.time()))
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._touch()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+
+_writer: HeartbeatWriter | None = None
+
+
+def start_heartbeat(interval: float = 1.0):
+    """Start this process's heartbeat if the launcher asked for one
+    (PADDLE_ELASTIC_HEARTBEAT_DIR set). Idempotent; called by training
+    entry points (TrainEpochRange does it automatically)."""
+    global _writer
+    dir_ = os.environ.get("PADDLE_ELASTIC_HEARTBEAT_DIR")
+    if not dir_ or _writer is not None:
+        return _writer
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    _writer = HeartbeatWriter(dir_, rank, interval).start()
+    return _writer
+
+
+def stale_ranks(dir_: str, timeout: float, expected: int) -> list[int]:
+    """Ranks whose heartbeat file is missing-after-grace or older than
+    `timeout` seconds. Ranks that never wrote a file are only reported
+    once SOME rank has (otherwise scripts that don't opt in would always
+    look hung)."""
+    now = time.time()
+    seen_any = False
+    stale = []
+    ages = {}
+    for r in range(expected):
+        p = _hb_path(dir_, r)
+        try:
+            ages[r] = now - os.path.getmtime(p)
+            seen_any = True
+        except OSError:
+            ages[r] = None
+    if not seen_any:
+        return []
+    for r, age in ages.items():
+        if age is None or age > timeout:
+            stale.append(r)
+    return stale
+
+
+class ElasticManager:
+    """API-parity facade (reference fleet/elastic/manager.py): wraps the
+    watchdog decision — should the job restart, and how many lives are
+    left."""
+
+    def __init__(self, max_restarts: int = 0, heartbeat_timeout: float = 30.0,
+                 heartbeat_dir: str | None = None, world_size: int = 1):
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout = heartbeat_timeout
+        self.heartbeat_dir = heartbeat_dir
+        self.world_size = world_size
+        self.restart_count = 0
+
+    def should_restart(self) -> bool:
+        return self.restart_count < self.max_restarts
+
+    def record_restart(self):
+        self.restart_count += 1
+
+    def hung_ranks(self) -> list[int]:
+        if not self.heartbeat_dir:
+            return []
+        return stale_ranks(self.heartbeat_dir, self.heartbeat_timeout,
+                           self.world_size)

@@ -40,6 +40,14 @@ def _parse(argv):
     p.add_argument("--workers", type=str, default="",
                    help="PS mode: comma-separated worker endpoints")
     p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart the whole job up to N times "
+                        "after a crashed or hung rank (children resume "
+                        "from their checkpoints)")
+    p.add_argument("--heartbeat_timeout", type=float, default=30.0,
+                   help="elastic: seconds without a heartbeat before a "
+                        "rank counts as hung (ranks opt in via "
+                        "distributed.elastic.start_heartbeat)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -83,9 +91,11 @@ def _spawn_children(specs, log_dir):
     return procs
 
 
-def _watch(procs):
-    """Poll children; on any failure kill the rest (reference
-    launch.py:214 watch + terminate_local_trainers)."""
+def _watch(procs, manager=None):
+    """Poll children; on failure or a hung heartbeat kill the rest
+    (reference launch.py:214 watch + terminate_local_trainers). Returns
+    (rc, needs_restart): the elastic loop in `launch` respawns when the
+    manager still has restarts left."""
     try:
         while True:
             alive = False
@@ -98,13 +108,22 @@ def _watch(procs):
                         f"[launch] {name} exited with code {rc}; "
                         f"terminating the job\n")
                     _kill_all(procs)
-                    return rc
+                    return rc, True
             if not alive:
-                return 0
+                return 0, False
+            if manager is not None:
+                hung = manager.hung_ranks()
+                if hung:
+                    sys.stderr.write(
+                        f"[launch] ranks {hung} missed heartbeats for "
+                        f">{manager.heartbeat_timeout}s; terminating the "
+                        f"job\n")
+                    _kill_all(procs)
+                    return 1, True
             time.sleep(0.2)
     except KeyboardInterrupt:
         _kill_all(procs)
-        return 1
+        return 1, False
     finally:
         for _, _, fh in procs:
             if fh:
@@ -171,11 +190,35 @@ def launch(argv=None):
             rank = base + i
             specs.append((f"trainer.{rank}",
                           get_cluster_env(rank, endpoints), script))
-    procs = _spawn_children(specs, args.log_dir)
-    # forward SIGTERM to the job
-    signal.signal(signal.SIGTERM, lambda *a: (_kill_all(procs),
-                                              sys.exit(143)))
-    return _watch(procs)
+    from .elastic import ElasticManager
+    hb_dir = None
+    if args.max_restarts > 0:
+        import tempfile
+        hb_dir = tempfile.mkdtemp(prefix="paddle_elastic_hb_")
+        for _name, env, _argv in specs:
+            env["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
+    manager = ElasticManager(
+        max_restarts=args.max_restarts,
+        heartbeat_timeout=args.heartbeat_timeout,
+        heartbeat_dir=hb_dir, world_size=len(specs)) \
+        if args.max_restarts > 0 else None
+
+    while True:
+        if hb_dir:  # fresh heartbeat epoch per attempt
+            for f in os.listdir(hb_dir):
+                os.unlink(os.path.join(hb_dir, f))
+        procs = _spawn_children(specs, args.log_dir)
+        # forward SIGTERM to the job
+        signal.signal(signal.SIGTERM, lambda *a: (_kill_all(procs),
+                                                  sys.exit(143)))
+        rc, needs_restart = _watch(procs, manager)
+        if rc == 0 or manager is None or not needs_restart \
+                or not manager.should_restart():
+            return rc
+        manager.record_restart()
+        sys.stderr.write(
+            f"[launch] elastic restart "
+            f"{manager.restart_count}/{manager.max_restarts}\n")
 
 
 def main():

@@ -90,6 +90,8 @@ class TrainEpochRange:
 
     def __iter__(self):
         from ..fluid.framework import default_main_program
+        from ..distributed.elastic import start_heartbeat
+        start_heartbeat()  # no-op unless the elastic launcher asked
         program = self.program or default_main_program()
         start = self.saver.load_checkpoint(program) + 1
         for epoch in range(start, self.max_epoch_num):

@@ -1,0 +1,106 @@
+"""Elastic fault tolerance: heartbeats, hang detection, launcher restart
+(reference python/paddle/distributed/fleet/elastic/ + launch.py watch)."""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_heartbeat_writer_and_stale_detection(tmp_path):
+    from paddle_tpu.distributed.elastic import (HeartbeatWriter,
+                                                stale_ranks)
+    hb = HeartbeatWriter(str(tmp_path), rank=0, interval=0.1).start()
+    try:
+        time.sleep(0.3)
+        assert stale_ranks(str(tmp_path), timeout=5.0, expected=2) == [1]
+        assert stale_ranks(str(tmp_path), timeout=5.0, expected=1) == []
+    finally:
+        hb.stop()
+    time.sleep(0.4)
+    assert stale_ranks(str(tmp_path), timeout=0.2, expected=1) == [0]
+
+
+def test_stale_ranks_no_optin_is_silent(tmp_path):
+    from paddle_tpu.distributed.elastic import stale_ranks
+    # nobody wrote a heartbeat => scripts didn't opt in => not hung
+    assert stale_ranks(str(tmp_path), timeout=0.1, expected=4) == []
+
+
+def test_launcher_restarts_crashed_job(tmp_path):
+    """First life crashes; the restart succeeds (the crash marker makes
+    the script deterministic across lives) — reference elastic pod
+    restart semantics."""
+    marker = tmp_path / "crashed_once"
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close()\n"
+        "    sys.exit(7)\n"
+        "print('recovered OK', flush=True)\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", "--started_port=0",
+         "--max_restarts=2", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=_env(), capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, res.stderr
+    assert "elastic restart 1/2" in res.stderr
+    logs = ""
+    for f in sorted(os.listdir(tmp_path / "logs")):
+        logs += open(tmp_path / "logs" / f).read()
+    assert "recovered OK" in logs
+
+
+def test_launcher_gives_up_after_max_restarts(tmp_path):
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", "--started_port=0", "--max_restarts=1",
+         str(script)],
+        env=_env(), capture_output=True, text=True, timeout=180)
+    assert res.returncode == 9
+    assert res.stderr.count("elastic restart") == 1
+
+
+def test_launcher_kills_hung_rank_via_heartbeat(tmp_path):
+    """A rank that starts a heartbeat then hangs (stops beating) is
+    detected and the job restarted; second life completes."""
+    marker = tmp_path / "hung_once"
+    script = tmp_path / "hang.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "from paddle_tpu.distributed.elastic import start_heartbeat\n"
+        f"marker = {str(marker)!r}\n"
+        "hb = start_heartbeat(interval=0.2)\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').close()\n"
+        "    hb.stop()\n"          # heartbeat goes stale == hung
+        "    time.sleep(120)\n"
+        "print('second life OK', flush=True)\n")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", "--started_port=0", "--max_restarts=1",
+         "--heartbeat_timeout=2", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=_env(), capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, (res.stderr, res.stdout)
+    assert "missed heartbeats" in res.stderr
+    logs = ""
+    for f in sorted(os.listdir(tmp_path / "logs")):
+        logs += open(tmp_path / "logs" / f).read()
+    assert "second life OK" in logs
