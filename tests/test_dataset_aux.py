@@ -157,3 +157,33 @@ def test_ps_heartbeat_monitor():
     finally:
         srv.shutdown()
         srv.server_close()
+
+def test_local_fs_roundtrip(tmp_path):
+    from paddle_tpu.distributed.fs import (LocalFS, FSFileExistsError,
+                                           HDFSClient)
+    fs = LocalFS()
+    d = str(tmp_path / "a")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path))
+    assert dirs == ["a"] and files == []
+    _, files = fs.ls_dir(d)
+    assert files == ["x.txt"]
+    fs.mv(f, str(tmp_path / "y.txt"))
+    assert fs.is_file(str(tmp_path / "y.txt")) and not fs.is_exist(f)
+    import pytest as _pytest
+    fs.touch(str(tmp_path / "z.txt"))
+    with _pytest.raises(FSFileExistsError):
+        fs.mv(str(tmp_path / "y.txt"), str(tmp_path / "z.txt"))
+    fs.mv(str(tmp_path / "y.txt"), str(tmp_path / "z.txt"),
+          overwrite=True)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+    # HDFS without hadoop: clear error, not a silent stub
+    h = HDFSClient(hadoop_home=None)
+    if h._hadoop is None:
+        with _pytest.raises(RuntimeError, match="hadoop"):
+            h.is_exist("/tmp")

@@ -162,3 +162,39 @@ def test_op_version_registry_roundtrip(fresh_programs):
     assert problems and "dropout" in problems[0]
     with pytest.raises(RuntimeError, match="dropout"):
         op_version.check_compatibility({"dropout": 999}, strict=True)
+
+def test_multivariate_normal_diag_vs_torch():
+    import torch
+    from paddle_tpu.distribution import MultivariateNormalDiag
+    loc = np.array([0.5, -1.0, 2.0], "float32")
+    scale = np.array([1.0, 2.0, 0.5], "float32")
+    val = np.array([0.0, 0.0, 1.0], "float32")
+    m = MultivariateNormalDiag(loc, scale)
+    t = torch.distributions.MultivariateNormal(
+        torch.from_numpy(loc),
+        covariance_matrix=torch.diag(torch.from_numpy(scale) ** 2))
+    np.testing.assert_allclose(
+        float(np.ravel(np.asarray(m.log_prob(val)._value))[0]),
+        float(t.log_prob(torch.from_numpy(val))), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(np.ravel(np.asarray(m.entropy()._value))[0]),
+        float(t.entropy()), rtol=1e-5)
+    s = m.sample((1000,))
+    assert tuple(s.shape) == (1000, 3)
+
+
+def test_kl_divergence_dispatch_vs_torch():
+    import torch
+    from paddle_tpu.distribution import (MultivariateNormalDiag, Normal,
+                                         kl_divergence)
+    p = MultivariateNormalDiag([0.0, 1.0], [1.0, 2.0])
+    q = MultivariateNormalDiag([0.5, 0.0], [2.0, 1.0])
+    tp = torch.distributions.MultivariateNormal(
+        torch.tensor([0.0, 1.0]), torch.diag(torch.tensor([1.0, 4.0])))
+    tq = torch.distributions.MultivariateNormal(
+        torch.tensor([0.5, 0.0]), torch.diag(torch.tensor([4.0, 1.0])))
+    np.testing.assert_allclose(
+        float(np.ravel(np.asarray(kl_divergence(p, q)._value))[0]),
+        float(torch.distributions.kl_divergence(tp, tq)), rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        kl_divergence(p, Normal(0.0, 1.0))

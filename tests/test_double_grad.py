@@ -1,0 +1,83 @@
+"""Double grad / create_graph (reference imperative/partial_grad_engine.cc
+PartialGradEngine + test_imperative_double_grad.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def setup_function(_fn):
+    paddle.disable_static()
+
+
+def test_first_order_grad_values():
+    x = paddle.to_tensor(np.array([2.0, 3.0], "float32"),
+                         stop_gradient=False)
+    y = paddle.mean(x * x * x)          # y = mean(x^3)
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(g._value),
+                               3 * np.array([4.0, 9.0]) / 2, rtol=1e-5)
+
+
+def test_second_order_via_backward():
+    """d/dx of sum((dy/dx)^2) where y = mean(x^3):
+    g = 3x^2/2; sum(g^2) = 9/4 * sum(x^4); d/dx = 9 x^3."""
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                         stop_gradient=False)
+    y = paddle.mean(x * x * x)
+    (g,) = paddle.grad(y, x, create_graph=True)
+    penalty = paddle.sum(g * g)
+    penalty.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               9 * np.array([1.0, 8.0]), rtol=1e-5)
+
+
+def test_double_grad_through_grad_call():
+    x = paddle.to_tensor(np.array([[0.5]], "float32"),
+                         stop_gradient=False)
+    y = paddle.sum(paddle.exp(x))
+    (g,) = paddle.grad(y, x, create_graph=True)     # g = exp(x)
+    (gg,) = paddle.grad(paddle.sum(g), x, create_graph=True)
+    np.testing.assert_allclose(np.asarray(gg._value),
+                               np.exp([[0.5]]), rtol=1e-5)
+
+
+def test_gradient_penalty_trains():
+    """WGAN-GP-style use: loss = f(x) + ||df/dx||^2 trains through the
+    penalty term."""
+    lin = paddle.nn.Linear(3, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=list(lin.parameters()))
+    rng = np.random.RandomState(0)
+    xv = rng.randn(16, 3).astype("float32")
+    first = last = None
+    for _ in range(25):
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = paddle.mean(lin(x))
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        # push the input-gradient norm toward 0 => weights toward 0
+        loss = paddle.sum(gx * gx)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lv = float(np.ravel(np.asarray(loss._value))[0])
+        first = first if first is not None else lv
+        last = lv
+    assert last < first * 0.1, (first, last)
+
+
+def test_create_graph_with_stochastic_forward_replays_mask():
+    """The replay must reuse the forward's dropout mask (stable rng id),
+    or the first-order grads would disagree with plain backward."""
+    paddle.seed(7)
+    x = paddle.to_tensor(np.ones((4, 4), "float32"), stop_gradient=False)
+    drop = paddle.nn.Dropout(0.5)
+    y = paddle.mean(drop(x) * 2.0)
+    (g1,) = paddle.grad(y, x, create_graph=True)
+    # plain backward on an identical fresh graph
+    paddle.seed(7)
+    x2 = paddle.to_tensor(np.ones((4, 4), "float32"), stop_gradient=False)
+    y2 = paddle.mean(paddle.nn.Dropout(0.5)(x2) * 2.0)
+    y2.backward()
+    np.testing.assert_allclose(np.asarray(g1._value),
+                               np.asarray(x2.grad._value), rtol=1e-5)

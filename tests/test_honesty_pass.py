@@ -153,3 +153,36 @@ def test_sync_batch_norm_convert_and_jit_semantics():
     xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
     sharded = np.asarray(jax.jit(f)(xs))
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+def test_op_errors_carry_operator_context(fresh_programs):
+    """Kernel failures surface with [operator < type >] context
+    (reference operator.cc catch-and-rethrow + errors.h taxonomy)."""
+    import paddle_tpu as paddle
+    paddle.enable_static()
+    from paddle_tpu.fluid import Executor, framework, layers, unique_name
+    from paddle_tpu.fluid.errors import EnforceNotMet
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            a = layers.data("a", [-1, 3], "float32")
+            b = layers.data("b", [-1, 5], "float32")
+            bad = layers.matmul(a, b)  # inner dims mismatch at run time
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        with pytest.raises(EnforceNotMet) as ei:
+            exe.run(main, feed={"a": np.ones((2, 3), "float32"),
+                                "b": np.ones((2, 5), "float32")},
+                    fetch_list=[bad])
+    assert "operator < matmul >" in str(ei.value)
+    assert "input shapes" in str(ei.value)
+    paddle.disable_static()
+
+
+def test_enforce_taxonomy():
+    from paddle_tpu.fluid import errors
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, "bad arg")
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+    assert issubclass(errors.InvalidArgumentError, RuntimeError)

@@ -1,0 +1,107 @@
+"""Program pass framework (reference framework/ir/pass.h PassRegistry +
+prune/constant-fold passes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import Executor, framework, layers, passes
+from paddle_tpu.fluid.scope import Scope, scope_guard
+from paddle_tpu.fluid import unique_name
+
+
+def _build():
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            h = layers.fc(x, 8, act="relu")
+            dead = layers.fc(x, 16)           # never fetched
+            out = layers.fc(h, 2)
+    return main, startup, out, dead
+
+
+def test_dce_removes_unfetched_chain():
+    main, startup, out, dead = _build()
+    n_before = len(main.global_block().ops)
+    passes.apply_pass(main, "dead_code_elimination",
+                      passes.PassContext(fetch_names=[out.name]))
+    n_after = len(main.global_block().ops)
+    assert n_after < n_before
+    remaining = {n for op in main.global_block().ops
+                 for n in op.output_arg_names}
+    assert dead.name not in remaining
+    # program still runs and produces the fetch
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                       fetch_list=[out])
+    assert np.asarray(got).shape == (2, 2)
+    paddle.disable_static()
+
+
+def test_dce_keeps_side_effect_ops():
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 2], "float32")
+            gb = main.global_block()
+            pr = gb.create_var(name="printed")
+            gb.append_op(type="print", inputs={"In": [x]},
+                         outputs={"Out": [pr.name]}, attrs={})
+    passes.apply_pass(main, "dead_code_elimination",
+                      passes.PassContext(fetch_names=[]))
+    assert [op.type for op in main.global_block().ops] == ["print"]
+    paddle.disable_static()
+
+
+def test_assign_collapse():
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 3], "float32")
+            gb = main.global_block()
+            mid = gb.create_var(name="mid")
+            gb.append_op(type="assign", inputs={"X": [x]},
+                         outputs={"Out": [mid.name]}, attrs={})
+            y = layers.scale(mid, 2.0)
+    passes.apply_pass(main, "assign_collapse",
+                      passes.PassContext(fetch_names=[y.name]))
+    types = [op.type for op in main.global_block().ops]
+    assert "assign" not in types
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        got, = exe.run(main, feed={"x": np.ones((1, 3), "float32")},
+                       fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(got), 2.0)
+    paddle.disable_static()
+
+
+def test_constant_fold_scale_of_fill():
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            c = layers.fill_constant([2, 2], "float32", 3.0)
+            y = layers.scale(c, 2.0, bias=1.0)
+    passes.apply_pass(main, "constant_fold",
+                      passes.PassContext(fetch_names=[y.name]))
+    ops = main.global_block().ops
+    assert [op.type for op in ops] == ["fill_constant"]
+    assert ops[0].attrs["value"] == 7.0
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        got, = exe.run(main, feed={}, fetch_list=[y])
+    np.testing.assert_allclose(np.asarray(got), 7.0)
+    paddle.disable_static()
+
+
+def test_unknown_pass_raises():
+    main = framework.Program()
+    with pytest.raises(KeyError, match="unknown pass"):
+        passes.apply_pass(main, "nope")
