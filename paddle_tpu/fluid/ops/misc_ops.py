@@ -1,0 +1,619 @@
+"""Long-tail operator groups: conv/vision extras, sequence extras, rnn
+step units, ranking losses, proximal optimizers, PS id ops, metrics.
+
+Reference files (all under /root/reference/paddle/fluid/operators/):
+  conv_shift_op.cc, lrn_op.cc, data_norm_op.cc, pixel_shuffle_op.cc,
+  shuffle_channel_op.cc, temporal_shift_op.cc, grid_sampler_op.cc,
+  affine_grid_op.cc, unfold_op.cc, spp_op.cc, norm_op.cc,
+  edit_distance_op.cc, ctc_align_op.cc, im2sequence_op.cc, row_conv_op.cc,
+  gru_unit_op.cc, lstm_unit_op.cc, add_position_encoding_op.cc,
+  margin_rank_loss_op.cc, rank_loss_op.cc,
+  teacher_student_sigmoid_loss_op.cc, optimizers/proximal_gd_op.cc,
+  optimizers/proximal_adagrad_op.cc, dgc_clip_by_norm_op.cc,
+  metrics/precision_recall_op.cc, detection/anchor_generator_op.cc,
+  histogram_op.cc, masked_select_op.cc, diag_v2 (diag_op.cc),
+  distributed_ops/split_ids_op.cc, merge_ids_op.cc.
+All are jnp compute fns; grads come from auto-vjp unless grad=None.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register, same_shape_as
+from .common import x, out
+
+
+# ---------------------------------------------------------------------------
+# conv / vision extras
+# ---------------------------------------------------------------------------
+
+@register("conv_shift", infer_shape=same_shape_as("X"))
+def _conv_shift(ctx, ins, attrs):
+    """Circular correlation (reference conv_shift_op): Out[i,j] =
+    sum_k X[i, (j+k-M//2) mod N] * Y[i, k]."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    N, M = a.shape[1], b.shape[1]
+    idx = (jnp.arange(N)[:, None] + jnp.arange(M)[None, :]
+           - M // 2) % N                                  # [N, M]
+    return out(jnp.einsum("bnm,bm->bn", a[:, idx], b))
+
+
+@register("lrn", infer_shape=same_shape_as("X"),
+          no_grad_out_slots=("MidOut",),
+          attrs={"n": 5, "k": 2.0, "alpha": 1e-4, "beta": 0.75})
+def _lrn(ctx, ins, attrs):
+    """Local response normalisation across channels (reference
+    lrn_op.cc)."""
+    v = x(ins, "X")
+    n, k, alpha, beta = (attrs["n"], attrs["k"], attrs["alpha"],
+                         attrs["beta"])
+    sq = jnp.square(v)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + v.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {"Out": [v / mid ** beta], "MidOut": [mid]}
+
+
+@register("data_norm", no_grad_slots=("BatchSize", "BatchSum",
+                                      "BatchSquareSum"),
+          no_grad_out_slots=("Means", "Scales"),
+          attrs={"epsilon": 1e-4, "slot_dim": -1})
+def _data_norm(ctx, ins, attrs):
+    """Global-stats normalisation for CTR models (reference
+    data_norm_op.cc): y = (x - mean) / scale from running batch
+    sum/square-sum counters (PS-updated in the reference)."""
+    v = x(ins, "X").astype(jnp.float32)
+    bsz = x(ins, "BatchSize").astype(jnp.float32)
+    bsum = x(ins, "BatchSum").astype(jnp.float32)
+    bsq = x(ins, "BatchSquareSum").astype(jnp.float32)
+    means = bsum / jnp.maximum(bsz, 1e-4)
+    scales = jnp.sqrt(jnp.maximum(bsz, 1e-4)
+                      / jnp.maximum(bsq, attrs["epsilon"]))
+    return {"Y": [(v - means) * scales], "Means": [means],
+            "Scales": [scales]}
+
+
+@register("pixel_shuffle", attrs={"upscale_factor": 1,
+                                  "data_format": "NCHW"})
+def _pixel_shuffle(ctx, ins, attrs):
+    v = x(ins, "X")
+    r = attrs["upscale_factor"]
+    N, C, H, W = v.shape
+    v = v.reshape(N, C // (r * r), r, r, H, W)
+    v = v.transpose(0, 1, 4, 2, 5, 3)
+    return out(v.reshape(N, C // (r * r), H * r, W * r))
+
+
+@register("shuffle_channel", attrs={"group": 1})
+def _shuffle_channel(ctx, ins, attrs):
+    v = x(ins, "X")
+    g = attrs["group"]
+    N, C, H, W = v.shape
+    return out(v.reshape(N, g, C // g, H, W).swapaxes(1, 2)
+               .reshape(N, C, H, W))
+
+
+@register("temporal_shift", attrs={"seg_num": 1, "shift_ratio": 0.25})
+def _temporal_shift(ctx, ins, attrs):
+    """TSM shift (reference temporal_shift_op): within each segment,
+    shift the first C*ratio channels back one step in time and the next
+    C*ratio forward."""
+    v = x(ins, "X")
+    T = attrs["seg_num"]
+    NT, C, H, W = v.shape
+    c1 = int(C * attrs["shift_ratio"])
+    c2 = int(C * 2 * attrs["shift_ratio"])
+    v = v.reshape(NT // T, T, C, H, W)
+    back = jnp.concatenate(
+        [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+    return out(jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+               .reshape(NT, C, H, W))
+
+
+@register("grid_sampler", attrs={"mode": "bilinear",
+                                 "padding_mode": "zeros",
+                                 "align_corners": True})
+def _grid_sampler(ctx, ins, attrs):
+    """Bilinear grid sample (reference grid_sampler_op): X [N,C,H,W] +
+    Grid [N,Ho,Wo,2] in [-1,1] -> [N,C,Ho,Wo]; zero padding outside."""
+    v = x(ins, "X").astype(jnp.float32)
+    grid = x(ins, "Grid").astype(jnp.float32)
+    N, C, H, W = v.shape
+    if attrs.get("align_corners", True):
+        gx = (grid[..., 0] + 1) * (W - 1) / 2
+        gy = (grid[..., 1] + 1) * (H - 1) / 2
+    else:
+        gx = ((grid[..., 0] + 1) * W - 1) / 2
+        gy = ((grid[..., 1] + 1) * H - 1) / 2
+
+    def sample_one(img, yy, xx):
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+
+        def tap(yi, xi, wgt):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yi = jnp.clip(yi, 0, H - 1)
+            xi = jnp.clip(xi, 0, W - 1)
+            val = img[:, yi, xi]                          # [C, Ho, Wo]
+            return val * (wgt * inb)[None]
+        wy1 = yy - y0
+        wx1 = xx - x0
+        return (tap(y0, x0, (1 - wy1) * (1 - wx1))
+                + tap(y0, x0 + 1, (1 - wy1) * wx1)
+                + tap(y0 + 1, x0, wy1 * (1 - wx1))
+                + tap(y0 + 1, x0 + 1, wy1 * wx1))
+
+    return {"Output": [jax.vmap(sample_one)(v, gy, gx)]}
+
+
+@register("affine_grid", no_grad_slots=("OutputShape",),
+          attrs={"align_corners": True, "output_shape": []})
+def _affine_grid(ctx, ins, attrs):
+    """Theta [N,2,3] -> sampling grid [N,H,W,2] (reference
+    affine_grid_op)."""
+    theta = x(ins, "Theta").astype(jnp.float32)
+    shape_v = x(ins, "OutputShape")
+    if shape_v is not None:
+        _, _, H, W = [int(s) for s in np.asarray(shape_v)]
+    else:
+        _, _, H, W = attrs["output_shape"]
+    if attrs.get("align_corners", True):
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+    else:
+        ys = (jnp.arange(H) * 2 + 1) / H - 1
+        xs = (jnp.arange(W) * 2 + 1) / W - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    return {"Output": [jnp.einsum("hwk,njk->nhwj", base, theta)]}
+
+
+@register("unfold", attrs={"kernel_sizes": [3, 3], "strides": [1, 1],
+                           "paddings": [0, 0, 0, 0], "dilations": [1, 1]})
+def _unfold(ctx, ins, attrs):
+    """im2col (reference unfold_op): [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+    v = x(ins, "X")
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs["strides"]
+    p = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    v = jnp.pad(v, ((0, 0), (0, 0), (p[0], p[2] if len(p) > 2 else p[0]),
+                    (p[1], p[3] if len(p) > 3 else p[1])))
+    N, C, H, W = v.shape
+    oh = (H - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W - (dw * (kw - 1) + 1)) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = v[:, :, i * dh:i * dh + oh * sh:sh,
+                      j * dw:j * dw + ow * sw:sw]
+            cols.append(patch.reshape(N, C, -1))
+    colm = jnp.stack(cols, axis=2)                # [N, C, kh*kw, L]
+    return {"Y": [colm.reshape(N, C * kh * kw, -1)]}
+
+
+@register("spp", attrs={"pyramid_height": 2, "pooling_type": "max"})
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference spp_op): concat adaptive pools
+    at 1x1, 2x2, ... 2^(h-1) bins."""
+    v = x(ins, "X")
+    N, C = v.shape[:2]
+    outs = []
+    from .nn_ops import _pool2d
+    for lvl in range(attrs["pyramid_height"]):
+        bins = 2 ** lvl
+        r = _pool2d(ctx, {"X": [v]},
+                    {"pooling_type": attrs["pooling_type"],
+                     "ksize": [bins, bins], "adaptive": True,
+                     "global_pooling": False, "strides": [1, 1],
+                     "paddings": [0, 0], "exclusive": True,
+                     "ceil_mode": False})["Out"][0]
+        outs.append(r.reshape(N, -1))
+    return out(jnp.concatenate(outs, axis=1))
+
+
+@register("norm", no_grad_out_slots=("Norm",),
+          attrs={"axis": 1, "epsilon": 1e-10})
+def _norm(ctx, ins, attrs):
+    """L2-normalise along axis (reference norm_op); Norm output carries
+    the magnitudes."""
+    v = x(ins, "X")
+    nrm = jnp.sqrt(jnp.sum(jnp.square(v), axis=attrs["axis"],
+                           keepdims=True) + attrs["epsilon"])
+    return {"Out": [v / nrm], "Norm": [nrm]}
+
+
+# ---------------------------------------------------------------------------
+# sequence extras
+# ---------------------------------------------------------------------------
+
+@register("edit_distance", grad=None,
+          no_grad_slots=("Hyps", "Refs", "HypsLength", "RefsLength"),
+          attrs={"normalized": False})
+def _edit_distance(ctx, ins, attrs):
+    """Levenshtein distance per pair (reference edit_distance_op), dense
+    [B, L] + lengths. DP over the reference sequence via scan."""
+    hyp = x(ins, "Hyps").astype(jnp.int32)
+    ref = x(ins, "Refs").astype(jnp.int32)
+    hlen = x(ins, "HypsLength")
+    rlen = x(ins, "RefsLength")
+    B, HL = hyp.shape
+    RL = ref.shape[1]
+    hlen = (jnp.full((B,), HL, jnp.int32) if hlen is None
+            else hlen.reshape(-1).astype(jnp.int32))
+    rlen = (jnp.full((B,), RL, jnp.int32) if rlen is None
+            else rlen.reshape(-1).astype(jnp.int32))
+
+    def one(h, r, hl, rl):
+        row0 = jnp.minimum(jnp.arange(HL + 1), hl).astype(jnp.float32)
+
+        def step(row, j):
+            # row = distances for ref[:j]; compute for ref[:j+1]
+            ins_cost = row[:-1] + jnp.where(h != r[j], 1.0, 0.0)
+
+            def inner(carry, t):
+                left_new = carry
+                diag, up, sub = t
+                val = jnp.minimum(jnp.minimum(up + 1.0, left_new + 1.0),
+                                  sub)
+                return val, val
+            first = row[0] + 1.0
+            _, rest = jax.lax.scan(
+                inner, first, (row[:-1], row[1:], ins_cost))
+            new = jnp.concatenate([first[None], rest])
+            new = jnp.where(j < rl, new, row)
+            return new, None
+        final, _ = jax.lax.scan(step, row0, jnp.arange(RL))
+        d = final[hl]
+        return jnp.where(attrs["normalized"],
+                         d / jnp.maximum(rl.astype(jnp.float32), 1.0), d)
+
+    dist = jax.vmap(one)(hyp, ref, hlen, rlen)
+    return {"Out": [dist[:, None]],
+            "SequenceNum": [jnp.asarray([B], jnp.int64)]}
+
+
+@register("ctc_align", grad=None, attrs={"blank": 0, "merge_repeated": True,
+                                         "padding_value": 0})
+def _ctc_align(ctx, ins, attrs):
+    """Collapse CTC paths: drop repeats then blanks (reference
+    ctc_align_op), padded-dense output."""
+    v = x(ins, "Input").astype(jnp.int32)
+    blank = attrs["blank"]
+    pad = attrs["padding_value"]
+    B, T = v.shape
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), v[:, :-1]],
+                           axis=1)
+    keep = (v != blank)
+    if attrs["merge_repeated"]:
+        keep &= (v != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out_ = jnp.full((B, T), pad, jnp.int32)
+    b_idx = jnp.repeat(jnp.arange(B)[:, None], T, 1)
+    out_ = out_.at[b_idx, jnp.where(keep, pos, T - 1)].set(
+        jnp.where(keep, v, out_[b_idx, jnp.where(keep, pos, T - 1)]))
+    lens = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Output": [out_], "OutputLength": [lens[:, None]]}
+
+
+@register("im2sequence", grad=None,
+          attrs={"kernels": [1, 1], "strides": [1, 1],
+                 "paddings": [0, 0, 0, 0], "out_stride": [1, 1]})
+def _im2sequence(ctx, ins, attrs):
+    """Image -> patch rows (reference im2sequence_op): [N,C,H,W] ->
+    [N*oh*ow, C*kh*kw] (dense, batch-major — LoD designed away)."""
+    r = _unfold(ctx, {"X": ins["X"]},
+                {"kernel_sizes": attrs["kernels"],
+                 "strides": attrs["strides"],
+                 "paddings": attrs["paddings"], "dilations": [1, 1]})
+    y = r["Y"][0]                                  # [N, C*kh*kw, L]
+    N, CK, L = y.shape
+    return out(y.transpose(0, 2, 1).reshape(N * L, CK))
+
+
+@register("row_conv", attrs={})
+def _row_conv(ctx, ins, attrs):
+    """Lookahead row convolution (reference row_conv_op, DeepSpeech2):
+    Out[t] = sum_{k} X[t+k] * W[k] over a [future_len, D] filter."""
+    v = x(ins, "X")                                # [B, T, D]
+    w = x(ins, "Filter")                           # [K, D]
+    K = w.shape[0]
+    B, T, D = v.shape
+    pad = jnp.pad(v, ((0, 0), (0, K - 1), (0, 0)))
+    acc = sum(pad[:, k:k + T] * w[k][None, None, :] for k in range(K))
+    return out(acc)
+
+
+# ---------------------------------------------------------------------------
+# rnn step units
+# ---------------------------------------------------------------------------
+
+@register("gru_unit", no_grad_out_slots=("ResetHiddenPrev", "Gate"),
+          attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                 "origin_mode": False})
+def _gru_unit(ctx, ins, attrs):
+    """One GRU step (reference gru_unit_op): Input [B, 3D] (pre-projected
+    x), HiddenPrev [B, D], Weight [D, 3D], Bias [1, 3D]."""
+    xin = x(ins, "Input")
+    h = x(ins, "HiddenPrev")
+    w = x(ins, "Weight")
+    b = x(ins, "Bias")
+    D = h.shape[1]
+    gates_x = xin if b is None else xin + b.reshape(-1)
+    ru_x, c_x = gates_x[:, :2 * D], gates_x[:, 2 * D:]
+    ru = jax.nn.sigmoid(ru_x + h @ w[:, :2 * D])
+    r, u = ru[:, :D], ru[:, D:]
+    rh = r * h
+    c = jnp.tanh(c_x + rh @ w[:, 2 * D:])
+    if attrs.get("origin_mode"):
+        new_h = u * h + (1 - u) * c
+    else:
+        new_h = (1 - u) * h + u * c
+    return {"Hidden": [new_h], "ResetHiddenPrev": [rh],
+            "Gate": [jnp.concatenate([ru, c], axis=1)]}
+
+
+@register("lstm_unit", attrs={"forget_bias": 0.0})
+def _lstm_unit(ctx, ins, attrs):
+    """One LSTM step (reference lstm_unit_op): X [B, 4D] pre-activations
+    (i, f, c~, o order), C_prev [B, D]."""
+    xin = x(ins, "X")
+    c_prev = x(ins, "C_prev")
+    D = c_prev.shape[1]
+    i = jax.nn.sigmoid(xin[:, :D])
+    f = jax.nn.sigmoid(xin[:, D:2 * D] + attrs["forget_bias"])
+    g = jnp.tanh(xin[:, 2 * D:3 * D])
+    o = jax.nn.sigmoid(xin[:, 3 * D:])
+    c = f * c_prev + i * g
+    return {"C": [c], "H": [o * jnp.tanh(c)]}
+
+
+@register("add_position_encoding", attrs={"alpha": 1.0, "beta": 1.0})
+def _add_position_encoding(ctx, ins, attrs):
+    """Sinusoidal position encoding add (reference
+    add_position_encoding_op)."""
+    v = x(ins, "X")                                # [B, T, D]
+    B, T, D = v.shape
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    div = jnp.exp(jnp.arange(0, D, 2, dtype=jnp.float32)
+                  * (-math.log(10000.0) / D))
+    pe = jnp.zeros((T, D), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
+    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[:(D - D // 2)]))
+    return out(attrs["alpha"] * v + attrs["beta"] * pe[None])
+
+
+# ---------------------------------------------------------------------------
+# ranking / distillation losses
+# ---------------------------------------------------------------------------
+
+@register("margin_rank_loss", no_grad_slots=("Label",),
+          no_grad_out_slots=("Activated",),
+          attrs={"margin": 0.0})
+def _margin_rank_loss(ctx, ins, attrs):
+    lab = x(ins, "Label")
+    a, b = x(ins, "X1"), x(ins, "X2")
+    act = jnp.maximum(0.0, -lab * (a - b) + attrs["margin"])
+    return {"Out": [act], "Activated": [(act > 0).astype(a.dtype)]}
+
+
+@register("rank_loss", no_grad_slots=("Label",))
+def _rank_loss(ctx, ins, attrs):
+    """RankNet pairwise loss (reference rank_loss_op)."""
+    lab = x(ins, "Label")
+    l, r = x(ins, "Left"), x(ins, "Right")
+    d = l - r
+    return out(jax.nn.softplus(d) - lab * d)
+
+
+@register("teacher_student_sigmoid_loss", no_grad_slots=("Label",),
+          attrs={"soft_max_up_bound": 15.0, "soft_max_lower_bound": -15.0})
+def _ts_sigmoid_loss(ctx, ins, attrs):
+    """CTR distillation loss (reference
+    teacher_student_sigmoid_loss_op): label<0 => teacher soft target
+    -label; else hard sigmoid CE."""
+    z = x(ins, "X").reshape(-1)
+    lab = x(ins, "Label").reshape(-1).astype(jnp.float32)
+    ce_hard = jax.nn.softplus(z) - lab * z
+    soft = -lab
+    ce_soft = jax.nn.softplus(z) - soft * z
+    return out(jnp.where(lab < 0, ce_soft, ce_hard)[:, None])
+
+
+# ---------------------------------------------------------------------------
+# optimizers / grad utils
+# ---------------------------------------------------------------------------
+
+def _lr_of(ins):
+    return x(ins, "LearningRate").reshape(())
+
+
+@register("proximal_gd", grad=None,
+          attrs={"l1": 0.0, "l2": 0.0})
+def _proximal_gd(ctx, ins, attrs):
+    """Proximal GD with L1/L2 shrinkage (reference proximal_gd_op)."""
+    p, g = x(ins, "Param"), x(ins, "Grad")
+    lr = _lr_of(ins)
+    prox = p - lr * g
+    l1, l2 = attrs["l1"], attrs["l2"]
+    new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    return {"ParamOut": [new]}
+
+
+@register("proximal_adagrad", grad=None,
+          attrs={"l1": 0.0, "l2": 0.0})
+def _proximal_adagrad(ctx, ins, attrs):
+    p, g, m = x(ins, "Param"), x(ins, "Grad"), x(ins, "Moment")
+    lr = _lr_of(ins)
+    m_new = m + g * g
+    eff = lr / jnp.sqrt(m_new)
+    prox = p - eff * g
+    l1, l2 = attrs["l1"], attrs["l2"]
+    new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff * l1, 0.0) \
+        / (1.0 + eff * l2)
+    return {"ParamOut": [new], "MomentOut": [m_new]}
+
+
+@register("dgc_clip_by_norm", attrs={"max_norm": 1.0, "rampup_begin_step":
+                                     0.0})
+def _dgc_clip_by_norm(ctx, ins, attrs):
+    """clip_by_norm gated on the DGC rampup step (reference
+    dgc_clip_by_norm_op)."""
+    v = x(ins, "X")
+    step = x(ins, "current_step").reshape(())
+    nrm = jnp.sqrt(jnp.sum(jnp.square(v)))
+    clipped = v * jnp.minimum(1.0, attrs["max_norm"]
+                              / jnp.maximum(nrm, 1e-12))
+    return out(jnp.where(step < attrs["rampup_begin_step"], v, clipped))
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+@register("precision_recall", grad=None,
+          attrs={"class_number": 2})
+def _precision_recall(ctx, ins, attrs):
+    """Macro/micro precision/recall/F1 (reference
+    metrics/precision_recall_op): MaxProbs+Indices (or predictions) vs
+    Labels. Emits [macro P, R, F1, micro P, R, F1]."""
+    idx = x(ins, "Indices").reshape(-1).astype(jnp.int32)
+    lab = x(ins, "Labels").reshape(-1).astype(jnp.int32)
+    C = attrs["class_number"]
+    pred_oh = jax.nn.one_hot(idx, C, dtype=jnp.float32)
+    lab_oh = jax.nn.one_hot(lab, C, dtype=jnp.float32)
+    tp = jnp.sum(pred_oh * lab_oh, axis=0)
+    fp = jnp.sum(pred_oh, axis=0) - tp
+    fn = jnp.sum(lab_oh, axis=0) - tp
+    prec = tp / jnp.maximum(tp + fp, 1e-12)
+    rec = tp / jnp.maximum(tp + fn, 1e-12)
+    f1 = 2 * prec * rec / jnp.maximum(prec + rec, 1e-12)
+    macro = jnp.stack([jnp.mean(prec), jnp.mean(rec), jnp.mean(f1)])
+    stp, sfp, sfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    mp = stp / jnp.maximum(stp + sfp, 1e-12)
+    mr = stp / jnp.maximum(stp + sfn, 1e-12)
+    micro = jnp.stack([mp, mr, 2 * mp * mr / jnp.maximum(mp + mr, 1e-12)])
+    states = jnp.stack([tp, fp, fn, tp + fn], axis=1)
+    return {"BatchMetrics": [jnp.concatenate([macro, micro])],
+            "AccumMetrics": [jnp.concatenate([macro, micro])],
+            "AccumStatesInfo": [states]}
+
+
+@register("positive_negative_pair", grad=None, attrs={})
+def _pos_neg_pair(ctx, ins, attrs):
+    """Counts correctly-ordered (pos) vs mis-ordered (neg) score pairs
+    within each query (reference positive_negative_pair_op)."""
+    score = x(ins, "Score").reshape(-1)
+    lab = x(ins, "Label").reshape(-1).astype(jnp.float32)
+    qid = x(ins, "QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    better = lab[:, None] > lab[None, :]
+    pos = jnp.sum(same_q & better & (score[:, None] > score[None, :]))
+    neg = jnp.sum(same_q & better & (score[:, None] < score[None, :]))
+    neu = jnp.sum(same_q & better & (score[:, None] == score[None, :]))
+    asf = lambda v: v.astype(jnp.float32).reshape(1, 1)
+    return {"PositivePair": [asf(pos)], "NegativePair": [asf(neg)],
+            "NeutralPair": [asf(neu)]}
+
+
+# ---------------------------------------------------------------------------
+# detection extras / tensor extras / PS id ops
+# ---------------------------------------------------------------------------
+
+@register("anchor_generator", grad=None,
+          attrs={"anchor_sizes": [64.0], "aspect_ratios": [1.0],
+                 "variances": [0.1, 0.1, 0.2, 0.2], "stride": [16.0, 16.0],
+                 "offset": 0.5})
+def _anchor_generator(ctx, ins, attrs):
+    """RPN anchors (reference detection/anchor_generator_op):
+    [H, W, A, 4] in input-image pixel coords."""
+    feat = x(ins, "Input")
+    H, W = feat.shape[2], feat.shape[3]
+    sw, sh = attrs["stride"]
+    whs = []
+    for size in attrs["anchor_sizes"]:
+        area = float(size) ** 2
+        for ar in attrs["aspect_ratios"]:
+            w = math.sqrt(area / ar)
+            whs.append((w, w * ar))
+    whs = np.asarray(whs, np.float32)
+    cx = (np.arange(W, dtype=np.float32) + attrs["offset"]) * sw
+    cy = (np.arange(H, dtype=np.float32) + attrs["offset"]) * sh
+    cxg, cyg = np.meshgrid(cx, cy)
+    anchors = np.stack([
+        cxg[:, :, None] - whs[None, None, :, 0] / 2,
+        cyg[:, :, None] - whs[None, None, :, 1] / 2,
+        cxg[:, :, None] + whs[None, None, :, 0] / 2,
+        cyg[:, :, None] + whs[None, None, :, 1] / 2], axis=-1)
+    var = np.broadcast_to(np.asarray(attrs["variances"], np.float32),
+                          anchors.shape).copy()
+    return {"Anchors": [jnp.asarray(anchors)],
+            "Variances": [jnp.asarray(var)]}
+
+
+@register("histogram", grad=None, attrs={"bins": 100, "min": 0, "max": 0})
+def _histogram(ctx, ins, attrs):
+    v = x(ins, "X").reshape(-1).astype(jnp.float32)
+    lo, hi = float(attrs["min"]), float(attrs["max"])
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(v), jnp.max(v)
+    h, _ = jnp.histogram(v, bins=attrs["bins"], range=(lo, hi))
+    return out(h.astype(jnp.int64))
+
+
+@register("masked_select", grad=None, no_grad_slots=("Mask",))
+def _masked_select(ctx, ins, attrs):
+    """Dynamic-shape op: eager-only (concrete values), like the
+    reference's CPU kernel. Under jit the result shape would be
+    data-dependent — use where/gather instead there."""
+    v, m = x(ins, "X"), x(ins, "Mask")
+    if isinstance(v, jax.core.Tracer) or isinstance(m, jax.core.Tracer):
+        raise NotImplementedError(
+            "masked_select has a data-dependent output shape — not "
+            "jittable; use paddle.where or boolean-mask host-side")
+    return out(jnp.asarray(np.asarray(v)[np.asarray(m).astype(bool)]))
+
+
+@register("split_ids", grad=None, attrs={})
+def _split_ids(ctx, ins, attrs):
+    """Route ids to PS shards by id % n_shards (reference
+    distributed_ops/split_ids_op); dense padded outputs."""
+    ids = x(ins, "Ids").reshape(-1)
+    n = len(ins.get("Out", [])) or attrs.get("num_shards", 1)
+    outs = []
+    for s in range(n):
+        sel = np.asarray(ids)[np.asarray(ids % n) == s] \
+            if not isinstance(ids, jax.core.Tracer) else None
+        if sel is None:
+            raise NotImplementedError("split_ids is an eager/host op")
+        outs.append(jnp.asarray(sel))
+    return {"Out": outs}
+
+
+@register("merge_ids", grad=None, attrs={})
+def _merge_ids(ctx, ins, attrs):
+    """Inverse of split_ids: scatter shard rows back to the original id
+    order (reference distributed_ops/merge_ids_op)."""
+    ids = x(ins, "Ids").reshape(-1)
+    shard_ids = ins.get("X", [])
+    rows = ins.get("Rows", [])
+    if isinstance(ids, jax.core.Tracer):
+        raise NotImplementedError("merge_ids is an eager/host op")
+    ids_np = np.asarray(ids)
+    D = np.asarray(rows[0]).shape[-1]
+    out_np = np.zeros((len(ids_np), D), np.asarray(rows[0]).dtype)
+    for sid, r in zip(shard_ids, rows):
+        sid_np = np.asarray(sid).reshape(-1)
+        r_np = np.asarray(r)
+        for i, v in enumerate(sid_np):
+            out_np[ids_np == v] = r_np[i]
+    return out(jnp.asarray(out_np))

@@ -1,0 +1,345 @@
+"""Long-tail op tier (fluid/ops/misc_ops.py) vs torch / brute-force
+oracles."""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid.registry import require
+
+
+def _run(op, ins, attrs=None):
+    opdef = require(op)
+    a = dict(attrs or {})
+    opdef.fill_default_attrs(a)
+    return opdef.compute(
+        None, {k: ([jnp.asarray(x) for x in v] if isinstance(v, list)
+                   else [jnp.asarray(v)]) for k, v in ins.items()}, a)
+
+
+def test_conv_shift_bruteforce():
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 7).astype(np.float32)
+    b = rng.randn(2, 3).astype(np.float32)
+    got = np.asarray(_run("conv_shift", {"X": a, "Y": b})["Out"][0])
+    want = np.zeros_like(a)
+    N, M = 7, 3
+    for i in range(2):
+        for j in range(N):
+            for k in range(M):
+                want[i, j] += a[i, (j + k - M // 2) % N] * b[i, k]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lrn_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(1)
+    v = rng.rand(2, 8, 4, 4).astype(np.float32)
+    got = np.asarray(_run("lrn", {"X": v},
+                          {"n": 5, "k": 2.0, "alpha": 1e-4,
+                           "beta": 0.75})["Out"][0])
+    # torch divides alpha by n; match by scaling
+    want = TF.local_response_norm(torch.from_numpy(v), size=5,
+                                  alpha=1e-4 * 5, beta=0.75, k=2.0)
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-5)
+
+
+def test_pixel_shuffle_vs_torch():
+    import torch
+    rng = np.random.RandomState(2)
+    v = rng.randn(1, 8, 3, 3).astype(np.float32)
+    got = np.asarray(_run("pixel_shuffle", {"X": v},
+                          {"upscale_factor": 2})["Out"][0])
+    want = torch.pixel_shuffle(torch.from_numpy(v), 2).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_grid_sampler_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(3)
+    v = rng.randn(2, 3, 5, 5).astype(np.float32)
+    grid = (rng.rand(2, 4, 4, 2).astype(np.float32) * 2.4 - 1.2)
+    got = np.asarray(_run("grid_sampler", {"X": v, "Grid": grid})
+                     ["Output"][0])
+    want = TF.grid_sample(torch.from_numpy(v), torch.from_numpy(grid),
+                          mode="bilinear", padding_mode="zeros",
+                          align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_affine_grid_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    theta = np.array([[[1.0, 0.2, 0.1], [0.0, 0.9, -0.3]]], np.float32)
+    got = np.asarray(_run("affine_grid", {"Theta": theta},
+                          {"output_shape": [1, 1, 3, 4]})["Output"][0])
+    want = TF.affine_grid(torch.from_numpy(theta), (1, 1, 3, 4),
+                          align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_unfold_vs_torch():
+    import torch
+    import torch.nn.functional as TF
+    rng = np.random.RandomState(4)
+    v = rng.randn(2, 3, 6, 6).astype(np.float32)
+    got = np.asarray(_run("unfold", {"X": v},
+                          {"kernel_sizes": [3, 3], "strides": [2, 2],
+                           "paddings": [1, 1, 1, 1],
+                           "dilations": [1, 1]})["Y"][0])
+    want = TF.unfold(torch.from_numpy(v), 3, padding=1, stride=2).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_edit_distance_bruteforce():
+    def lev(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[len(a), len(b)]
+
+    rng = np.random.RandomState(5)
+    hyps = rng.randint(1, 5, (3, 6)).astype(np.int64)
+    refs = rng.randint(1, 5, (3, 7)).astype(np.int64)
+    hl = np.array([6, 4, 2], np.int64)
+    rl = np.array([7, 3, 5], np.int64)
+    got = np.asarray(_run("edit_distance",
+                          {"Hyps": hyps, "Refs": refs,
+                           "HypsLength": hl, "RefsLength": rl})
+                     ["Out"][0]).ravel()
+    want = [lev(list(hyps[b, :hl[b]]), list(refs[b, :rl[b]]))
+            for b in range(3)]
+    np.testing.assert_allclose(got, want)
+
+
+def test_ctc_align():
+    inp = np.array([[1, 1, 0, 2, 2, 0, 3],
+                    [0, 0, 1, 2, 0, 0, 0]], np.int32)
+    outs = _run("ctc_align", {"Input": inp},
+                {"blank": 0, "merge_repeated": True})
+    got = np.asarray(outs["Output"][0])
+    lens = np.asarray(outs["OutputLength"][0]).ravel()
+    assert list(lens) == [3, 2]
+    assert list(got[0, :3]) == [1, 2, 3]
+    assert list(got[1, :2]) == [1, 2]
+
+
+def test_row_conv_bruteforce():
+    rng = np.random.RandomState(6)
+    v = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(2, 3).astype(np.float32)
+    got = np.asarray(_run("row_conv", {"X": v, "Filter": w})["Out"][0])
+    want = np.zeros_like(v)
+    for t in range(5):
+        for k in range(2):
+            if t + k < 5:
+                want[:, t] += v[:, t + k] * w[k]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lstm_unit_manual():
+    rng = np.random.RandomState(7)
+    D = 4
+    xin = rng.randn(2, 4 * D).astype(np.float32)
+    c_prev = rng.randn(2, D).astype(np.float32)
+    outs = _run("lstm_unit", {"X": xin, "C_prev": c_prev},
+                {"forget_bias": 1.0})
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    i, f = sig(xin[:, :D]), sig(xin[:, D:2 * D] + 1.0)
+    g, o = np.tanh(xin[:, 2 * D:3 * D]), sig(xin[:, 3 * D:])
+    c = f * c_prev + i * g
+    np.testing.assert_allclose(np.asarray(outs["C"][0]), c, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs["H"][0]),
+                               o * np.tanh(c), rtol=1e-5)
+
+
+def test_gru_unit_shapes_and_range():
+    rng = np.random.RandomState(8)
+    D = 4
+    outs = _run("gru_unit",
+                {"Input": rng.randn(2, 3 * D).astype(np.float32),
+                 "HiddenPrev": rng.randn(2, D).astype(np.float32),
+                 "Weight": (rng.randn(D, 3 * D) * 0.1).astype(np.float32)})
+    h = np.asarray(outs["Hidden"][0])
+    assert h.shape == (2, D) and np.isfinite(h).all()
+
+
+def test_add_position_encoding():
+    v = np.zeros((1, 4, 6), np.float32)
+    got = np.asarray(_run("add_position_encoding", {"X": v})["Out"][0])
+    # position 0: sin(0)=0, cos(0)=1 interleaved
+    np.testing.assert_allclose(got[0, 0, 0::2], 0.0, atol=1e-6)
+    np.testing.assert_allclose(got[0, 0, 1::2], 1.0, atol=1e-6)
+
+
+def test_rank_losses():
+    lab = np.array([[1.0]], np.float32)
+    got = np.asarray(_run("margin_rank_loss",
+                          {"X1": np.array([[0.2]], np.float32),
+                           "X2": np.array([[0.5]], np.float32),
+                           "Label": lab}, {"margin": 0.1})["Out"][0])
+    np.testing.assert_allclose(got, [[0.4]], atol=1e-6)
+    got2 = np.asarray(_run("rank_loss",
+                           {"Left": np.array([[1.0]], np.float32),
+                            "Right": np.array([[0.0]], np.float32),
+                            "Label": lab})["Out"][0])
+    np.testing.assert_allclose(got2, np.log1p(np.exp(1.0)) - 1.0,
+                               rtol=1e-5)
+
+
+def test_proximal_gd_shrinks_to_zero():
+    p = np.array([0.05, -0.03, 2.0], np.float32)
+    g = np.zeros(3, np.float32)
+    outs = _run("proximal_gd",
+                {"Param": p, "Grad": g,
+                 "LearningRate": np.array([1.0], np.float32)},
+                {"l1": 0.1, "l2": 0.0})
+    new = np.asarray(outs["ParamOut"][0])
+    assert new[0] == 0.0 and new[1] == 0.0      # under the L1 threshold
+    np.testing.assert_allclose(new[2], 1.9, rtol=1e-6)
+
+
+def test_precision_recall_manual():
+    idx = np.array([0, 0, 1, 1], np.int64)
+    lab = np.array([0, 1, 1, 1], np.int64)
+    outs = _run("precision_recall", {"Indices": idx, "Labels": lab},
+                {"class_number": 2})
+    m = np.asarray(outs["BatchMetrics"][0])
+    # class0: tp=1 fp=1 fn=0; class1: tp=2 fp=0 fn=1
+    macro_p = (0.5 + 1.0) / 2
+    macro_r = (1.0 + 2 / 3) / 2
+    np.testing.assert_allclose(m[0], macro_p, rtol=1e-5)
+    np.testing.assert_allclose(m[1], macro_r, rtol=1e-5)
+    np.testing.assert_allclose(m[3], 0.75, rtol=1e-5)   # micro P = 3/4
+
+
+def test_histogram_vs_numpy():
+    rng = np.random.RandomState(9)
+    v = rng.randn(100).astype(np.float32)
+    got = np.asarray(_run("histogram", {"X": v},
+                          {"bins": 10, "min": -2, "max": 2})["Out"][0])
+    want, _ = np.histogram(v, bins=10, range=(-2, 2))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_masked_select_eager_and_jit_error():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    m = np.array([True, False, True])
+    got = np.asarray(_run("masked_select", {"X": v, "Mask": m})["Out"][0])
+    np.testing.assert_allclose(got, [1.0, 3.0])
+    with pytest.raises(NotImplementedError, match="data-dependent"):
+        jax.jit(lambda a: _run("masked_select",
+                               {"X": a, "Mask": m})["Out"][0])(
+            jnp.asarray(v))
+
+
+def test_diag_v2_roundtrip():
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+    d = np.asarray(_run("diag_v2", {"X": v})["Out"][0])
+    np.testing.assert_allclose(d, np.diag(v))
+    back = np.asarray(_run("diag_v2", {"X": d})["Out"][0])
+    np.testing.assert_allclose(back, v)
+
+
+def test_temporal_shift_and_shuffle_channel():
+    rng = np.random.RandomState(10)
+    v = rng.randn(4, 4, 2, 2).astype(np.float32)  # NT=4 (T=2), C=4
+    got = np.asarray(_run("temporal_shift", {"X": v},
+                          {"seg_num": 2, "shift_ratio": 0.25})["Out"][0])
+    r = v.reshape(2, 2, 4, 2, 2)
+    assert np.allclose(got.reshape(2, 2, 4, 2, 2)[:, 0, 0], r[:, 1, 0])
+    assert np.allclose(got.reshape(2, 2, 4, 2, 2)[:, 1, 1], r[:, 0, 1])
+    sc = np.asarray(_run("shuffle_channel", {"X": v},
+                         {"group": 2})["Out"][0])
+    want = v.reshape(4, 2, 2, 2, 2).swapaxes(1, 2).reshape(4, 4, 2, 2)
+    np.testing.assert_allclose(sc, want)
+
+
+def test_norm_and_spp_shapes():
+    rng = np.random.RandomState(11)
+    v = rng.randn(2, 3, 4).astype(np.float32)
+    outs = _run("norm", {"X": v}, {"axis": 1})
+    n = np.asarray(outs["Out"][0])
+    np.testing.assert_allclose(np.sum(n * n, axis=1), 1.0, rtol=1e-4)
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+    spp = np.asarray(_run("spp", {"X": img},
+                          {"pyramid_height": 2})["Out"][0])
+    assert spp.shape == (2, 3 * (1 + 4))
+
+
+def test_split_merge_ids_roundtrip():
+    ids = np.array([0, 3, 4, 7, 1], np.int64)
+    opdef = require("split_ids")
+    outs = opdef.compute(None, {"Ids": [jnp.asarray(ids)],
+                                "Out": [None, None]}, {"num_shards": 2})
+    s0, s1 = [np.asarray(o) for o in outs["Out"]]
+    assert sorted(s0) == [0, 4] and sorted(s1) == [1, 3, 7]
+    rows = [np.stack([np.full(2, float(i)) for i in s0]),
+            np.stack([np.full(2, float(i)) for i in s1])]
+    merged = _run("merge_ids", {"Ids": ids, "X": [s0, s1],
+                                "Rows": rows})["Out"][0]
+    np.testing.assert_allclose(np.asarray(merged)[:, 0],
+                               ids.astype(np.float32))
+
+
+def test_anchor_generator_geometry():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    outs = _run("anchor_generator", {"Input": feat},
+                {"anchor_sizes": [32.0], "aspect_ratios": [1.0],
+                 "stride": [16.0, 16.0]})
+    a = np.asarray(outs["Anchors"][0])
+    assert a.shape == (2, 2, 1, 4)
+    # cell (0,0): center (8, 8), square side 32
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-5)
+
+
+def test_data_norm():
+    v = np.array([[2.0, 4.0]], np.float32)
+    outs = _run("data_norm",
+                {"X": v,
+                 "BatchSize": np.array([10.0, 10.0], np.float32),
+                 "BatchSum": np.array([10.0, 30.0], np.float32),
+                 "BatchSquareSum": np.array([40.0, 160.0], np.float32)})
+    y = np.asarray(outs["Y"][0])
+    # means = [1, 3]; scales = sqrt(10/40), sqrt(10/160)
+    np.testing.assert_allclose(
+        y, [[(2 - 1) * 0.5, (4 - 3) * 0.25]], rtol=1e-5)
+
+
+def test_grad_flows_through_differentiable_misc_ops():
+    rng = np.random.RandomState(12)
+    for op, ins, attrs in [
+        ("conv_shift", {"X": rng.randn(2, 5).astype(np.float32),
+                        "Y": rng.randn(2, 3).astype(np.float32)}, {}),
+        ("lrn", {"X": rng.rand(1, 6, 3, 3).astype(np.float32)}, {}),
+        ("grid_sampler",
+         {"X": rng.randn(1, 2, 4, 4).astype(np.float32),
+          "Grid": (rng.rand(1, 2, 2, 2) * 1.6 - 0.8)
+          .astype(np.float32)}, {}),
+        ("row_conv", {"X": rng.randn(1, 4, 3).astype(np.float32),
+                      "Filter": rng.randn(2, 3).astype(np.float32)}, {}),
+    ]:
+        opdef = require(op)
+        a = dict(attrs)
+        opdef.fill_default_attrs(a)
+        keys = list(ins)
+
+        def loss(vals):
+            o = opdef.compute(
+                None, {k: [v] for k, v in zip(keys, vals)}, a)
+            first = next(iter(o.values()))[0]
+            return jnp.sum(first ** 2)
+
+        g = jax.grad(loss)([jnp.asarray(v) for v in ins.values()])
+        for gv in g:
+            assert np.isfinite(np.asarray(gv)).all(), op
