@@ -1,0 +1,55 @@
+"""C API build helper (header + impl live beside this file; see
+paddle_c_api.h for the design stance — reference inference/capi/ +
+framework/c/c_api.h).
+
+`build_capi()` compiles libpaddle_tpu_capi.so on demand (g++ +
+libpython), cached and mtime-invalidated like native/__init__.py.
+C/C++/Go/R hosts link it and include paddle_c_api.h.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+__all__ = ["build_capi", "header_path"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_lock = threading.Lock()
+
+
+def header_path() -> str:
+    return os.path.join(_DIR, "paddle_c_api.h")
+
+
+def build_capi() -> str | None:
+    """Compile (if stale) the C API shared library; returns its path, or
+    None when no toolchain is available."""
+    src = os.path.join(_DIR, "paddle_capi.cc")
+    so = os.path.join(_BUILD, "libpaddle_tpu_capi.so")
+    with _lock:
+        if os.path.exists(so) and \
+                os.path.getmtime(so) >= os.path.getmtime(src):
+            return so
+        os.makedirs(_BUILD, exist_ok=True)
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        ver = sysconfig.get_config_var("LDVERSION") or \
+            sysconfig.get_python_version()
+        import tempfile
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD)
+        os.close(fd)
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 f"-I{inc}", f"-I{_DIR}", src,
+                 f"-L{libdir}", f"-lpython{ver}", "-o", tmp],
+                check=True, capture_output=True, text=True)
+            os.replace(tmp, so)
+            return so
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            return None

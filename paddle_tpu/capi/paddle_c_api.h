@@ -1,0 +1,56 @@
+/* C inference API (reference paddle/fluid/inference/capi/c_api.h +
+ * framework/c/c_api.h): a stable C ABI over the predictor so non-Python
+ * hosts (C, C++, Go, R via cgo/FFI) can load and run exported models.
+ *
+ * This build's predictor core is Python-native (SURVEY §7 stance); the C
+ * library embeds the interpreter once per process (Py_Initialize) and
+ * marshals tensors by pointer — the same deploy pattern as the
+ * reference's C++-only train/infer demos, with libpython in place of
+ * libpaddle_fluid. Thread-safety: calls are serialized on the GIL.
+ */
+#ifndef PADDLE_TPU_C_API_H
+#define PADDLE_TPU_C_API_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_INT32 = 1,
+  PD_INT64 = 2,
+} PD_DataType;
+
+typedef struct {
+  const void *data;   /* caller-owned for inputs */
+  int64_t shape[8];
+  int ndim;
+  PD_DataType dtype;
+} PD_Tensor;
+
+/* Load an exported inference model (save_inference_model / jit.save
+ * directory). Returns NULL on failure; PD_GetLastError() explains. */
+PD_Predictor *PD_NewPredictor(const char *model_dir);
+
+void PD_DeletePredictor(PD_Predictor *p);
+
+int PD_GetInputNum(PD_Predictor *p);
+int PD_GetOutputNum(PD_Predictor *p);
+
+/* Run with n_inputs tensors (model feed order). On success outputs[i]
+ * is filled for min(PD_GetOutputNum, max_outputs) tensors whose data
+ * pointers stay valid until the next PD_PredictorRun/Delete on this
+ * predictor. Returns 0 on success, nonzero on error. */
+int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
+                    int n_inputs, PD_Tensor *outputs, int max_outputs);
+
+const char *PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_C_API_H */

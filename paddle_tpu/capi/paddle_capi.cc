@@ -1,0 +1,234 @@
+/* C API implementation: embeds CPython and drives
+ * paddle_tpu.inference.Predictor (see paddle_c_api.h for the design
+ * stance; reference equivalents inference/capi/pd_predictor.cc and the
+ * C++-only train demo fluid/train/demo/demo_trainer.cc).
+ *
+ * Build (native/__init__.py build_capi does this automatically):
+ *   g++ -O3 -shared -fPIC paddle_capi.cc $(python3-config --includes)
+ *       -lpython3.x -o libpaddle_tpu_capi.so
+ */
+#include "paddle_c_api.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+
+void set_err(const std::string &msg) { g_last_error = msg; }
+
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+const char *np_dtype_name(PD_DataType t) {
+  switch (t) {
+    case PD_FLOAT32: return "float32";
+    case PD_INT32: return "int32";
+    case PD_INT64: return "int64";
+  }
+  return "float32";
+}
+
+size_t dtype_size(PD_DataType t) {
+  return t == PD_FLOAT32 ? 4 : (t == PD_INT32 ? 4 : 8);
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+  }
+}
+
+}  // namespace
+
+struct PD_Predictor {
+  PyObject *predictor = nullptr;   // paddle_tpu.inference.Predictor
+  PyObject *np = nullptr;          // numpy module
+  // output buffers stay alive until the next run/delete
+  std::vector<std::vector<char>> out_buffers;
+};
+
+extern "C" {
+
+PD_Predictor *PD_NewPredictor(const char *model_dir) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor *p = nullptr;
+  PyObject *mod = nullptr, *np = nullptr, *cfg = nullptr, *pred = nullptr;
+  do {
+    mod = PyImport_ImportModule("paddle_tpu.inference");
+    if (!mod) { set_err_from_python(); break; }
+    np = PyImport_ImportModule("numpy");
+    if (!np) { set_err_from_python(); break; }
+    cfg = PyObject_CallMethod(mod, "Config", "s", model_dir);
+    if (!cfg) { set_err_from_python(); break; }
+    pred = PyObject_CallMethod(mod, "create_predictor", "O", cfg);
+    if (!pred) {
+      PyErr_Clear();
+      PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+      if (cls) {
+        pred = PyObject_CallFunctionObjArgs(cls, cfg, nullptr);
+        Py_DECREF(cls);
+      }
+    }
+    if (!pred) { set_err_from_python(); break; }
+    p = new PD_Predictor();
+    p->predictor = pred;
+    p->np = np;
+    np = nullptr;
+    pred = nullptr;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(np);
+  Py_XDECREF(cfg);
+  Py_XDECREF(pred);
+  PyGILState_Release(gil);
+  return p;
+}
+
+void PD_DeletePredictor(PD_Predictor *p) {
+  if (p == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->predictor);
+  Py_XDECREF(p->np);
+  PyGILState_Release(gil);
+  delete p;
+}
+
+static int name_count(PD_Predictor *p, const char *method) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n = -1;
+  PyObject *names = PyObject_CallMethod(p->predictor, method, nullptr);
+  if (names != nullptr) {
+    n = static_cast<int>(PyList_Size(names));
+    Py_DECREF(names);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+int PD_GetInputNum(PD_Predictor *p) {
+  return name_count(p, "get_input_names");
+}
+
+int PD_GetOutputNum(PD_Predictor *p) {
+  return name_count(p, "get_output_names");
+}
+
+int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
+                    int n_inputs, PD_Tensor *outputs, int max_outputs) {
+  if (p == nullptr || p->predictor == nullptr) {
+    set_err("null predictor");
+    return 1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *arr_list = nullptr, *result = nullptr;
+  do {
+    arr_list = PyList_New(n_inputs);
+    if (!arr_list) { set_err_from_python(); break; }
+    bool ok = true;
+    for (int i = 0; i < n_inputs; ++i) {
+      const PD_Tensor &t = inputs[i];
+      size_t numel = 1;
+      for (int d = 0; d < t.ndim; ++d) numel *= t.shape[d];
+      PyObject *mv = PyMemoryView_FromMemory(
+          reinterpret_cast<char *>(const_cast<void *>(t.data)),
+          numel * dtype_size(t.dtype), PyBUF_READ);
+      if (!mv) { set_err_from_python(); ok = false; break; }
+      PyObject *flat = PyObject_CallMethod(
+          p->np, "frombuffer", "Os", mv, np_dtype_name(t.dtype));
+      Py_DECREF(mv);
+      if (!flat) { set_err_from_python(); ok = false; break; }
+      PyObject *shape = PyTuple_New(t.ndim);
+      for (int d = 0; d < t.ndim; ++d)
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+      PyObject *arr = PyObject_CallMethod(flat, "reshape", "O", shape);
+      Py_DECREF(flat);
+      Py_DECREF(shape);
+      if (!arr) { set_err_from_python(); ok = false; break; }
+      PyList_SET_ITEM(arr_list, i, arr);  // steals
+    }
+    if (!ok) break;
+    result = PyObject_CallMethod(p->predictor, "run", "O", arr_list);
+    if (!result) { set_err_from_python(); break; }
+    if (!PyList_Check(result)) { set_err("run() did not return a list");
+      break; }
+    int n_out = static_cast<int>(PyList_Size(result));
+    if (n_out > max_outputs) n_out = max_outputs;
+    p->out_buffers.clear();
+    p->out_buffers.resize(n_out);
+    ok = true;
+    for (int i = 0; i < n_out; ++i) {
+      PyObject *a = PyList_GET_ITEM(result, i);  // borrowed
+      // contiguous fp32/int bytes via numpy: np.ascontiguousarray
+      PyObject *ca = PyObject_CallMethod(p->np, "ascontiguousarray",
+                                         "O", a);
+      if (!ca) { set_err_from_python(); ok = false; break; }
+      PyObject *dt = PyObject_GetAttrString(ca, "dtype");
+      PyObject *dt_name = dt ? PyObject_GetAttrString(dt, "name") : nullptr;
+      std::string dname = dt_name ? PyUnicode_AsUTF8(dt_name) : "float32";
+      Py_XDECREF(dt);
+      Py_XDECREF(dt_name);
+      PD_DataType out_t = PD_FLOAT32;
+      if (dname == "int32") out_t = PD_INT32;
+      else if (dname == "int64") out_t = PD_INT64;
+      else if (dname != "float32") {
+        PyObject *cast = PyObject_CallMethod(ca, "astype", "s", "float32");
+        Py_DECREF(ca);
+        if (!cast) { set_err_from_python(); ok = false; break; }
+        ca = cast;
+      }
+      PyObject *shape = PyObject_GetAttrString(ca, "shape");
+      int nd = static_cast<int>(PyTuple_Size(shape));
+      outputs[i].ndim = nd > 8 ? 8 : nd;
+      size_t numel = 1;
+      for (int d = 0; d < outputs[i].ndim; ++d) {
+        outputs[i].shape[d] = PyLong_AsLongLong(
+            PyTuple_GET_ITEM(shape, d));
+        numel *= outputs[i].shape[d];
+      }
+      Py_DECREF(shape);
+      outputs[i].dtype = out_t;
+      PyObject *bytes = PyObject_CallMethod(ca, "tobytes", nullptr);
+      Py_DECREF(ca);
+      if (!bytes) { set_err_from_python(); ok = false; break; }
+      char *buf = nullptr;
+      Py_ssize_t len = 0;
+      PyBytes_AsStringAndSize(bytes, &buf, &len);
+      p->out_buffers[i].assign(buf, buf + len);
+      Py_DECREF(bytes);
+      outputs[i].data = p->out_buffers[i].data();
+    }
+    if (!ok) break;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(arr_list);
+  Py_XDECREF(result);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+const char *PD_GetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+"""C inference API (reference inference/capi/ + the C++-only deploy
+demos train/demo/demo_trainer.cc): compile a real C host program against
+libpaddle_tpu_capi.so, run an exported model from C, compare with the
+Python predictor."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_DEMO = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include "paddle_c_api.h"
+
+int main(int argc, char **argv) {
+  PD_Predictor *p = PD_NewPredictor(argv[1]);
+  if (!p) { fprintf(stderr, "load: %s\n", PD_GetLastError()); return 2; }
+  if (PD_GetInputNum(p) != 1 || PD_GetOutputNum(p) < 1) return 3;
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = (float)i * 0.25f - 1.0f;
+  PD_Tensor input = {in, {2, 4}, 2, PD_FLOAT32};
+  PD_Tensor out[4];
+  if (PD_PredictorRun(p, &input, 1, out, 4) != 0) {
+    fprintf(stderr, "run: %s\n", PD_GetLastError());
+    return 4;
+  }
+  const float *o = (const float *)out[0].data;
+  long numel = 1;
+  for (int d = 0; d < out[0].ndim; ++d) numel *= out[0].shape[d];
+  for (long i = 0; i < numel; ++i) printf("%.6f\n", o[i]);
+  PD_DeletePredictor(p);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def capi_lib():
+    from paddle_tpu.capi import build_capi
+    so = build_capi()
+    if so is None:
+        pytest.skip("no g++/libpython toolchain")
+    return so
+
+
+def _save_model(tmp_path):
+    paddle.disable_static()
+    paddle.seed(0)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 3)
+
+        def forward(self, x):
+            return paddle.nn.functional.relu(self.lin(x))
+
+    m = M()
+    from paddle_tpu.static import InputSpec
+    path = str(tmp_path / "cmodel")
+    paddle.jit.save(m, path, input_spec=[InputSpec([None, 4], "float32",
+                                                   "x")])
+    return m, path
+
+
+def test_c_host_program_matches_python(tmp_path, capi_lib):
+    m, model_dir = _save_model(tmp_path)
+    from paddle_tpu.capi import header_path
+    csrc = tmp_path / "demo.c"
+    csrc.write_text(C_DEMO)
+    exe = tmp_path / "demo"
+    subprocess.run(
+        ["gcc", str(csrc), "-o", str(exe),
+         f"-I{os.path.dirname(header_path())}",
+         capi_lib, f"-Wl,-rpath,{os.path.dirname(capi_lib)}"],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([str(exe), model_dir], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    got = np.array([float(v) for v in res.stdout.split()],
+                   np.float32).reshape(2, 3)
+    x = (np.arange(8, dtype=np.float32) * 0.25 - 1.0).reshape(2, 4)
+    want = np.asarray(m(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
