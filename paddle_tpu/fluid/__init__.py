@@ -5,7 +5,8 @@ that lowers blocks to single XLA computations, graph-level autodiff, layers,
 optimizers. See SURVEY.md §7 for the design mapping.
 """
 from . import core, framework, layers, initializer, regularizer, clip, \
-    unique_name, io, dataset, passes
+    unique_name, io, dataset, passes, transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .dataset import DatasetFactory
 from . import ops as _ops  # registers all built-in ops
 from .core import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,

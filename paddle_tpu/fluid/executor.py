@@ -167,6 +167,15 @@ class Executor:
         fetch_names = [v.name if isinstance(v, Variable) else str(v)
                        for v in (fetch_list or [])]
 
+        # pserver programs run host-side: a blocking service loop has no
+        # place inside a traced computation (reference executor runs
+        # listen_and_serv the same way)
+        for op in program.global_block().ops:
+            if op.type == "listen_and_serv":
+                from .ops.ps_ops import run_listen_and_serv
+                run_listen_and_serv(op)
+                return []
+
         run_ops = None
         if use_prune:
             # cached like _analysis_cache: pruning + analysis are O(#ops)

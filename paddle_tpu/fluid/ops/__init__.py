@@ -6,4 +6,5 @@ fuses them (no per-device kernel files, no Eigen/cuBLAS dispatch).
 """
 from . import (math_ops, nn_ops, tensor_ops, random_ops, optimizer_ops,
                control_ops, metric_ops, sequence_ops,
-               structured_loss_ops, detection_ops, misc_ops)  # noqa: F401
+               structured_loss_ops, detection_ops, misc_ops,
+               ps_ops)  # noqa: F401

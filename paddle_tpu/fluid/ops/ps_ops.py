@@ -1,0 +1,102 @@
+"""PS graph ops: send / recv / listen_and_serv (reference
+operators/distributed_ops/send_op.cc, recv_op.cc,
+listen_and_serv_op.cc:352).
+
+send/recv run inside the jitted step via `io_callback` (ordered host
+side effects) against the TCP parameter-server tier
+(distributed/fleet/runtime/parameter_server_runtime.py PSClient/PSServer
+— the gRPC/BRPC transport replacement). Dense params are stored as KV
+rows keyed 0..m-1, one table per param; the server applies the SGD
+update on arrival (reference RunAsyncLoop apply-on-arrival semantics).
+`listen_and_serv` is host-only: the Executor runs it outside tracing
+(a blocking server loop has no place inside an XLA computation).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register, same_shape_as
+from .common import x, out
+
+_clients: dict = {}
+
+
+def _client(endpoints):
+    key = tuple(endpoints)
+    if key not in _clients:
+        from ...distributed.fleet.runtime.parameter_server_runtime import \
+            PSClient
+        _clients[key] = PSClient(list(endpoints))
+    return _clients[key]
+
+
+@register("send", grad=None,
+          no_grad_slots=("X", "LearningRate"),
+          attrs={"table_name": "", "endpoints": [], "is_sparse": False})
+def _send(ctx, ins, attrs):
+    """Push a (dense or sparse-rows) gradient to the PS, which applies
+    -lr * grad on arrival."""
+    g = x(ins, "X")
+    lr = x(ins, "LearningRate")
+    lr = jnp.ones((), jnp.float32) if lr is None else lr.reshape(())
+    endpoints = tuple(attrs["endpoints"])
+    table = attrs["table_name"]
+
+    def do_push(gv, lrv):
+        gv = np.asarray(gv)
+        rows = gv.reshape(gv.shape[0], -1)
+        _client(endpoints).push(table, rows.shape[1],
+                                np.arange(rows.shape[0], dtype=np.int64),
+                                rows, float(lrv))
+        return np.zeros((1,), np.float32)
+
+    from jax.experimental import io_callback
+    done = io_callback(do_push,
+                       jax.ShapeDtypeStruct((1,), jnp.float32),
+                       g, lr, ordered=True)
+    return {"Out": [done]}
+
+
+@register("recv", grad=None, attrs={"table_name": "", "endpoints": [],
+                                    "shape": [], "dtype": "float32"})
+def _recv(ctx, ins, attrs):
+    """Pull the current server-side value of a dense param."""
+    endpoints = tuple(attrs["endpoints"])
+    table = attrs["table_name"]
+    shape = tuple(attrs["shape"])
+    m = shape[0]
+    dim = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+
+    def do_pull():
+        rows = _client(endpoints).pull(
+            table, dim, np.arange(m, dtype=np.int64))
+        return rows.reshape(shape).astype(np.float32)
+
+    from jax.experimental import io_callback
+    val = io_callback(do_pull,
+                      jax.ShapeDtypeStruct(shape, jnp.float32),
+                      ordered=True)
+    return {"Out": [val]}
+
+
+@register("listen_and_serv", grad=None,
+          attrs={"endpoint": "", "optimize_blocks": [], "Fanin": 1,
+                 "sync_mode": False})
+def _listen_and_serv(ctx, ins, attrs):
+    raise RuntimeError(
+        "listen_and_serv is a host-side blocking loop — the Executor "
+        "runs it directly (it cannot live inside a traced computation)")
+
+
+def run_listen_and_serv(op):
+    """Host-side service loop the Executor dispatches to (reference
+    listen_and_serv_op RunAsyncLoop): serve until the process is
+    terminated by the launcher/fleet.stop_server()."""
+    from ...distributed.fleet.runtime.parameter_server_runtime import \
+        PSServer
+    server = PSServer(op.attrs["endpoint"])
+    t = server.serve_in_thread()
+    t.join()  # blocks like the reference's server loop

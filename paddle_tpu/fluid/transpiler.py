@@ -1,0 +1,122 @@
+"""DistributeTranspiler (reference python/paddle/fluid/transpiler/
+distribute_transpiler.py): rewrite a single-process training program into
+trainer + pserver programs.
+
+Async-PS semantics (reference a_sync / RunAsyncLoop): the transpiled
+trainer replaces every optimizer op with
+  send(grad, lr)   -- server applies -lr*grad on arrival
+  recv(param)      -- pull the fresh server-side value
+and the pserver program is one `listen_and_serv` op the Executor runs
+host-side as a blocking service loop. Parameters LIVE on the servers
+(large_scale_kv init rules): the first recv overwrites the trainer's
+local init, so every trainer sees one consistent model without a
+broadcast. Sharding across multiple pservers is row-hash routing inside
+PSClient (one table per param, rows 0..m-1).
+
+Sync mode (send_barrier/fetch_barrier rounds) is not implemented — the
+mesh-collective data-parallel path covers synchronous training natively;
+transpiler mode exists for the sparse/async regime.
+"""
+from __future__ import annotations
+
+from . import framework
+from .framework import Program
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+_OPT_OPS = {"sgd", "momentum", "adam", "adamw", "adagrad", "adamax",
+            "adadelta", "rmsprop", "ftrl", "lamb", "decayed_adagrad",
+            "lars_momentum", "dgc_momentum"}
+
+
+class DistributeTranspilerConfig:
+    """Reference transpiler config bag (slice_var_up etc. — row-hash
+    routing subsumes explicit var slicing)."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = False
+        self.runtime_split_send_recv = False
+        self.mode = "pserver"
+
+
+class DistributeTranspiler:
+    def __init__(self, config: DistributeTranspilerConfig | None = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program = None
+        self._pservers = []
+        self._origin_program = None
+
+    def transpile(self, trainer_id, program=None, pservers="",
+                  trainers=1, sync_mode=False, startup_program=None,
+                  current_endpoint=""):
+        if sync_mode or self.config.sync_mode:
+            raise NotImplementedError(
+                "sync PS rounds: use the mesh-collective DP path; the "
+                "transpiler implements the async regime")
+        program = program or framework.default_main_program()
+        self._origin_program = program
+        self._pservers = [e for e in pservers.split(",") if e]
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+
+        t = program.clone()
+        gb = t.global_block()
+        new_ops = []
+        for op in gb.ops:
+            if op.type not in _OPT_OPS:
+                new_ops.append(op)
+                continue
+            param_name = op.input("Param")[0]
+            grad_name = op.input("Grad")[0]
+            lr_name = (op.input("LearningRate") or [None])[0]
+            pvar = gb._var_recursive(param_name)
+            shape = list(pvar.shape) if pvar is not None and pvar.shape \
+                else []
+            from .framework import Operator
+            send_out = gb.create_var(
+                name=f"{param_name}.send_done", persistable=False)
+            ins = {"X": [grad_name]}
+            if lr_name:
+                ins["LearningRate"] = [lr_name]
+            new_ops.append(Operator(
+                gb, "send", inputs=ins, outputs={"Out": [send_out.name]},
+                attrs={"table_name": param_name,
+                       "endpoints": self._pservers}))
+            new_ops.append(Operator(
+                gb, "recv", inputs={}, outputs={"Out": [param_name]},
+                attrs={"table_name": param_name,
+                       "endpoints": self._pservers, "shape": shape}))
+        gb.ops[:] = new_ops
+        t._bump_version()
+        self._trainer_program = t
+        return self
+
+    def get_trainer_program(self, wait_port=True) -> Program:
+        if self._trainer_program is None:
+            raise RuntimeError("call transpile() first")
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint) -> Program:
+        from .framework import Operator
+        p = Program()
+        gb = p.global_block()
+        dummy = gb.create_var(name="serv_out", persistable=False)
+        gb.ops.append(Operator(
+            gb, "listen_and_serv", inputs={},
+            outputs={"Out": [dummy.name]},
+            attrs={"endpoint": endpoint, "sync_mode": False}))
+        p._bump_version()
+        return p
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint), \
+            self.get_startup_program(endpoint)
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        """Server-side startup: tables init lazily on first touch
+        (large_scale_kv init rules) — nothing to run."""
+        return Program()

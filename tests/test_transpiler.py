@@ -1,0 +1,94 @@
+"""DistributeTranspiler + PS graph ops (reference
+transpiler/distribute_transpiler.py + distributed_ops/send,recv,
+listen_and_serv): async-PS training against a live TCP server."""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import (DistributeTranspiler, Executor, framework,
+                              layers, optimizer, unique_name)
+from paddle_tpu.fluid.scope import Scope, scope_guard
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def ps_server():
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSServer
+    ep = f"127.0.0.1:{_free_port()}"
+    server = PSServer(ep)
+    server.serve_in_thread()
+    yield ep
+    server.shutdown()
+
+
+def test_transpiled_trainer_trains_via_ps(ps_server, fresh_programs):
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 3
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, pservers=ps_server,
+                trainers=1)
+    trainer = t.get_trainer_program()
+    types = [op.type for op in trainer.global_block().ops]
+    assert "send" in types and "recv" in types
+    assert "sgd" not in types   # update moved to the server
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype("float32")
+    losses = []
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(60):
+            xb = rng.randn(32, 4).astype("float32")
+            lv, = exe.run(trainer, feed={"x": xb, "y": xb @ w_true},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    # server-side async SGD converges (params live on the pserver)
+    assert losses[-1] < losses[2] * 0.2, (losses[2], losses[-1])
+
+
+def test_pserver_program_shape(fresh_programs):
+    t = DistributeTranspiler()
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 2], "float32")
+            pred = layers.fc(x, 1)
+            loss = layers.mean(pred)
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+    t.transpile(trainer_id=0, program=main,
+                pservers="127.0.0.1:1234", trainers=2)
+    ps_prog = t.get_pserver_program("127.0.0.1:1234")
+    ops = ps_prog.global_block().ops
+    assert [op.type for op in ops] == ["listen_and_serv"]
+    assert ops[0].attrs["endpoint"] == "127.0.0.1:1234"
+    paddle.disable_static()
+
+
+def test_sync_mode_rejected():
+    with pytest.raises(NotImplementedError, match="sync"):
+        DistributeTranspiler().transpile(
+            0, program=framework.Program(), pservers="a:1",
+            sync_mode=True)
