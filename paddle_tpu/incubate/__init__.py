@@ -1,4 +1,5 @@
 """paddle.incubate (reference python/paddle/incubate/): experimental APIs."""
 from . import checkpoint
+from . import fleet
 
-__all__ = ["checkpoint"]
+__all__ = ["checkpoint", "fleet"]

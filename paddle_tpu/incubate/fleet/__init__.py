@@ -1,0 +1,4 @@
+"""fleet 1.x incubate namespace (reference python/paddle/fluid/incubate/
+fleet/) — the transpiler-era PS API, kept for parity with the 2.0 fleet
+in paddle_tpu.distributed.fleet."""
+from . import base, parameter_server  # noqa: F401

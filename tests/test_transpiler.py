@@ -92,3 +92,45 @@ def test_sync_mode_rejected():
         DistributeTranspiler().transpile(
             0, program=framework.Program(), pservers="a:1",
             sync_mode=True)
+
+
+def test_fleet1x_incubate_api(ps_server, fresh_programs):
+    """Reference fleet 1.x flow: init(role) -> distributed_optimizer ->
+    minimize -> worker trains via fleet.main_program."""
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.parameter_server. \
+        distribute_transpiler import StrategyFactory, fleet
+    paddle.enable_static()
+    rm = UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                              worker_num=1,
+                              server_endpoints=[ps_server])
+    fleet.init(rm)
+    assert fleet.is_worker() and not fleet.is_server()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 9
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            opt = fleet.distributed_optimizer(
+                optimizer.SGD(learning_rate=0.1),
+                StrategyFactory.create_async_strategy())
+            opt.minimize(loss)
+    rng = np.random.RandomState(1)
+    w_true = rng.randn(4, 1).astype("float32")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(50):
+            xb = rng.randn(32, 4).astype("float32")
+            lv, = exe.run(fleet.main_program,
+                          feed={"x": xb, "y": xb @ w_true},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    fleet.stop_worker()
+    assert losses[-1] < losses[2] * 0.2, (losses[2], losses[-1])
