@@ -128,8 +128,23 @@ class BertPretrainingHeads(nn.Layer):
             [cfg.vocab_size], is_bias=True)
         self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
 
-    def forward(self, sequence_output, pooled_output):
+    def forward(self, sequence_output, pooled_output,
+                masked_positions=None):
         from .. import tensor as T
+        if masked_positions is not None:
+            # gather the masked rows BEFORE the vocab projection
+            # (MLPerf-BERT / PaddleNLP practice): the [B*S, V] logits
+            # shrink to [B*P, V] — the head's FLOPs and HBM traffic drop
+            # by S/P (~7x at 15% masking)
+            B, S = sequence_output.shape[0], sequence_output.shape[1]
+            H = sequence_output.shape[2]
+            flat = T.reshape(sequence_output, [-1, H])
+            pos = T.reshape(masked_positions, [-1, 1])
+            base = T.reshape(
+                T.scale(T.arange(0, B, 1, dtype="int64"), float(S)),
+                [B, 1])
+            idx = T.add(T.reshape(pos, [B, -1]), base)
+            sequence_output = T.gather(flat, T.reshape(idx, [-1]))
         h = self.layer_norm(self.act(self.transform(sequence_output)))
         # tied softmax: logits = h @ word_embeddings^T
         logits = T.matmul(h, self.decoder_weight, transpose_y=True)
@@ -147,10 +162,14 @@ class BertForPretraining(nn.Layer):
         self.cls = BertPretrainingHeads(
             cfg, self.bert.embeddings.word_embeddings.weight)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_positions=None):
+        """masked_positions [B, P] selects the MLM prediction rows; the
+        logits come back [B*P, V] (flattened) instead of [B, S, V], and
+        loss() then takes labels [B, P]."""
         seq_out, pooled = self.bert(input_ids, token_type_ids,
                                     attention_mask=attention_mask)
-        return self.cls(seq_out, pooled)
+        return self.cls(seq_out, pooled, masked_positions)
 
     def loss(self, prediction_logits, nsp_logits, masked_lm_labels,
              next_sentence_labels, ignore_index=-100):

@@ -101,3 +101,31 @@ def test_train_step_write_back_and_eval():
     np.testing.assert_allclose(model.weight.numpy(), before)
     step.write_back()
     assert not np.allclose(model.weight.numpy(), before)
+
+
+def test_masked_positions_head_matches_full_head():
+    """The gathered MLM head (models/bert.py masked_positions path —
+    MLPerf practice) must produce exactly the full head's logits at the
+    selected positions."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    paddle.disable_static()
+    paddle.seed(11)
+    cfg = BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    B, S, P = 2, 16, 4
+    ids = paddle.to_tensor(rng.randint(
+        4, cfg.vocab_size, (B, S)).astype("int64"))
+    pos_np = np.stack([np.sort(rng.choice(S, P, replace=False))
+                       for _ in range(B)]).astype("int64")
+    pos = paddle.to_tensor(pos_np)
+    full_logits, _ = model(ids)
+    got_logits, _ = model(ids, masked_positions=pos)
+    full = np.asarray(full_logits._value)          # [B, S, V]
+    got = np.asarray(got_logits._value).reshape(B, P, -1)
+    for b in range(B):
+        np.testing.assert_allclose(got[b], full[b, pos_np[b]],
+                                   rtol=1e-5, atol=1e-5)
