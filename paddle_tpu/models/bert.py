@@ -139,11 +139,9 @@ class BertPretrainingHeads(nn.Layer):
             B, S = sequence_output.shape[0], sequence_output.shape[1]
             H = sequence_output.shape[2]
             flat = T.reshape(sequence_output, [-1, H])
-            pos = T.reshape(masked_positions, [-1, 1])
             base = T.reshape(
-                T.scale(T.arange(0, B, 1, dtype="int64"), float(S)),
-                [B, 1])
-            idx = T.add(T.reshape(pos, [B, -1]), base)
+                T.arange(0, B * S, S, dtype="int64"), [B, 1])
+            idx = T.add(masked_positions, base)
             sequence_output = T.gather(flat, T.reshape(idx, [-1]))
         h = self.layer_norm(self.act(self.transform(sequence_output)))
         # tied softmax: logits = h @ word_embeddings^T
