@@ -887,3 +887,49 @@ def _pool3d(ctx, ins, attrs):
         else:
             r = ssum / (k[0] * k[1] * k[2])
     return out(r)
+
+
+# ---------------------------------------------------------------------------
+# fused dropout + residual-add + layer_norm (Pallas,
+# ops/pallas_fused_residual.py; reference skip_layernorm_fuse_pass tier).
+# The transformer sublayer epilogue as ONE kernel each way.
+# ---------------------------------------------------------------------------
+
+@register("fused_dropout_add_ln", infer_shape=same_shape_as("X", "Out"),
+          stochastic=True,
+          attrs={"dropout_p": 0.0, "epsilon": 1e-5})
+def _fused_dropout_add_ln(ctx, ins, attrs):
+    v, res = x(ins, "X"), x(ins, "Residual")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    p = attrs["dropout_p"]
+    if ctx is not None and ctx.is_test:
+        p = 0.0
+    eps = attrs["epsilon"]
+    shape = v.shape
+    c = shape[-1]
+    r = 1
+    for s in shape[:-1]:
+        r *= s
+    from ...ops.pallas_fused_residual import (
+        can_use_fused_dropout_add_ln, fused_dropout_add_ln)
+    if can_use_fused_dropout_add_ln(r, c):
+        seed = jnp.zeros((1,), jnp.int32)
+        if p > 0.0:
+            key = ctx.rng(attrs) if ctx is not None \
+                else jax.random.PRNGKey(0)
+            kd = key if jnp.issubdtype(key.dtype, jnp.integer) \
+                else jax.random.key_data(key)
+            seed = kd.ravel()[-1:].astype(jnp.int32)
+        y = fused_dropout_add_ln(v.reshape(r, c), res.reshape(r, c),
+                                 scale, bias, seed, float(p), float(eps))
+        return out(y.reshape(shape))
+    # composed fallback (non-aligned dims / pallas disabled)
+    if p > 0.0:
+        key = ctx.rng(attrs) if ctx is not None else jax.random.PRNGKey(0)
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        v = jnp.where(keep, v / (1.0 - p), 0.0)
+    z = (v + res).astype(jnp.float32)
+    mean = jnp.mean(z, -1, keepdims=True)
+    var = jnp.mean(jnp.square(z - mean), -1, keepdims=True)
+    zhat = (z - mean) * jax.lax.rsqrt(var + eps)
+    return out((zhat * scale + bias).astype(res.dtype))

@@ -1,0 +1,204 @@
+"""Pallas TPU fused dropout + residual-add + layer_norm.
+
+The transformer sublayer epilogue `LN(residual + dropout(x))` as ONE
+VMEM pass each way. Reference equivalent: the fused skip-layernorm tier
+(framework/ir/skip_layernorm_fuse_pass.cc,
+operators/fused/fused_bn_activation and
+fused_embedding_eltwise_layernorm). The forward reads x and residual and
+writes y + the pre-LN sum (the backward residual); the backward fuses
+the LN-dx reduction with a dropout-mask REPLAY (counter-based hash rng,
+same scheme as the flash kernel) — no mask tensor ever exists in HBM.
+
+Measured effect at BERT-base shapes (v5e): ~neutral at seq 128, ~+1% at
+seq 512 — XLA's own fusion already handles this chain well; the kernel's
+remaining value is the guaranteed fusion contract (independent of XLA
+heuristics) and the in-kernel deterministic dropout. It stays behind
+can_use_fused_dropout_add_ln with a composed fallback.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_attention import on_tpu
+from .pallas_layer_norm import _pick_block
+
+__all__ = ["fused_dropout_add_ln", "can_use_fused_dropout_add_ln"]
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def can_use_fused_dropout_add_ln(rows: int, cols: int) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    if cols % 128 or cols > 16384:
+        return False
+    return _pick_block(rows) is not None
+
+
+def _keep(seed_ref, rows, cols, c_total, p):
+    """murmur3-finalised counter mask over global element ids —
+    identical forward/backward for any block partitioning."""
+    x = (jnp.uint32(seed_ref[0])
+         ^ ((rows * c_total + cols).astype(jnp.uint32)
+            * jnp.uint32(0x85ebca6b)))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85ebca6b)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xc2b2ae35)
+    x = x ^ (x >> 16)
+    thr = jnp.uint32(min(int(p * 4294967296.0), 4294967295))
+    return x >= thr
+
+
+def _ids(i, br, c):
+    rows = jax.lax.broadcasted_iota(jnp.int32, (br, c), 0) + i * br
+    cols = jax.lax.broadcasted_iota(jnp.int32, (br, c), 1)
+    return rows, cols
+
+
+def _fwd_kernel(seed_ref, x_ref, res_ref, scale_ref, bias_ref,
+                y_ref, z_ref, mean_ref, rstd_ref, *, eps, p):
+    i = pl.program_id(0)
+    xv = x_ref[:].astype(jnp.float32)
+    rv = res_ref[:].astype(jnp.float32)
+    br, c = xv.shape
+    if p > 0.0:
+        rows, cols = _ids(i, br, c)
+        keep = _keep(seed_ref, rows, cols, c, p)
+        xv = jnp.where(keep, xv / (1.0 - p), 0.0)
+    z = xv + rv
+    mean = jnp.mean(z, axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(z - mean), axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    zhat = (z - mean) * rstd
+    y = zhat * scale_ref[0].astype(jnp.float32)[None, :] \
+        + bias_ref[0].astype(jnp.float32)[None, :]
+    y_ref[:] = y.astype(y_ref.dtype)
+    z_ref[:] = z.astype(z_ref.dtype)
+    mean_ref[:] = jax.lax.broadcast_in_dim(mean[:, 0], (br, 128), (0,))
+    rstd_ref[:] = jax.lax.broadcast_in_dim(rstd[:, 0], (br, 128), (0,))
+
+
+def _bwd_kernel(seed_ref, z_ref, scale_ref, mean_ref, rstd_ref, dy_ref,
+                dx_ref, dres_ref, *, p):
+    i = pl.program_id(0)
+    zv = z_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    br, c = zv.shape
+    mean = mean_ref[:][:, 0:1]
+    rstd = rstd_ref[:][:, 0:1]
+    zhat = (zv - mean) * rstd
+    a = dy * scale_ref[0].astype(jnp.float32)[None, :]
+    c1 = jnp.mean(a, axis=1, keepdims=True)
+    c2 = jnp.mean(a * zhat, axis=1, keepdims=True)
+    dz = rstd * (a - c1 - zhat * c2)
+    dres_ref[:] = dz.astype(dres_ref.dtype)
+    if p > 0.0:
+        rows, cols = _ids(i, br, c)
+        keep = _keep(seed_ref, rows, cols, c, p)
+        dx = jnp.where(keep, dz / (1.0 - p), 0.0)
+    else:
+        dx = dz
+    dx_ref[:] = dx.astype(dx_ref.dtype)
+
+
+def _smem_seed_spec():
+    if _interpret():
+        return pl.BlockSpec((1,), lambda i: (0,))
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def fused_dropout_add_ln(x2d, res2d, scale, bias, seed_arr, p, eps):
+    """y = LN(res + dropout_p(x)) * scale + bias, one kernel each way.
+
+    x2d/res2d: (R, C); scale/bias: (C,); seed_arr: (1,) int32. p and eps
+    are static. Gradients flow to x (mask-replayed), residual, scale,
+    bias; never to seed."""
+    y, _z, _mean, _rstd = _fwd_impl(x2d, res2d, scale, bias, seed_arr,
+                                    p, eps)
+    return y
+
+
+def _fwd_impl(x2d, res2d, scale, bias, seed_arr, p, eps):
+    r, c = x2d.shape
+    br = _pick_block(r)
+    y, z, mean_b, rstd_b = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps, p=p),
+        grid=(r // br,),
+        in_specs=[
+            _smem_seed_spec(),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, c), x2d.dtype),
+            jax.ShapeDtypeStruct((r, c), x2d.dtype),
+            jax.ShapeDtypeStruct((r, 128), jnp.float32),
+            jax.ShapeDtypeStruct((r, 128), jnp.float32),
+        ],
+        interpret=_interpret())(
+            seed_arr, x2d, res2d, scale.reshape(1, c), bias.reshape(1, c))
+    return y, z, mean_b[:, 0], rstd_b[:, 0]
+
+
+def _vjp_fwd(x2d, res2d, scale, bias, seed_arr, p, eps):
+    y, z, mean, rstd = _fwd_impl(x2d, res2d, scale, bias, seed_arr, p,
+                                 eps)
+    return y, (z, scale, mean, rstd, seed_arr)
+
+
+def _vjp_bwd(p, eps, res, dy):
+    z, scale, mean, rstd, seed_arr = res
+    r, c = z.shape
+    br = _pick_block(r)
+    mean_b = jnp.broadcast_to(mean[:, None], (r, 128))
+    rstd_b = jnp.broadcast_to(rstd[:, None], (r, 128))
+    dx, dres = pl.pallas_call(
+        functools.partial(_bwd_kernel, p=p),
+        grid=(r // br,),
+        in_specs=[
+            _smem_seed_spec(),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, 128), lambda i: (i, 0)),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+            pl.BlockSpec((br, c), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, c), z.dtype),
+            jax.ShapeDtypeStruct((r, c), z.dtype),
+        ],
+        interpret=_interpret())(
+            seed_arr, z, scale.reshape(1, c), mean_b, rstd_b, dy)
+    zf = z.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    zhat = (zf - mean[:, None]) * rstd[:, None]
+    dscale = jnp.sum(dyf * zhat, axis=0).astype(scale.dtype)
+    dbias = jnp.sum(dyf, axis=0).astype(scale.dtype)
+    return dx, dres, dscale, dbias, None
+
+
+fused_dropout_add_ln.defvjp(_vjp_fwd, _vjp_bwd)

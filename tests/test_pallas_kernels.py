@@ -143,3 +143,106 @@ def test_fused_attention_op_routes_through_pallas():
     finally:
         os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
     np.testing.assert_allclose(o1.numpy(), o2.numpy(), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# fused dropout + residual + layer_norm (ops/pallas_fused_residual.py)
+# ---------------------------------------------------------------------------
+
+def _composed_ref(xv, rv, scale, bias, eps):
+    z = (xv + rv).astype(np.float32)
+    mean = z.mean(-1, keepdims=True)
+    var = ((z - mean) ** 2).mean(-1, keepdims=True)
+    return (z - mean) / np.sqrt(var + eps) * scale + bias
+
+
+def test_fused_dropout_add_ln_p0_matches_composed(_interpret_env):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_fused_residual import fused_dropout_add_ln
+    rng = np.random.RandomState(0)
+    R, C = 32, 128
+    xv = rng.randn(R, C).astype(np.float32)
+    rv = rng.randn(R, C).astype(np.float32)
+    scale = rng.rand(C).astype(np.float32) + 0.5
+    bias = rng.randn(C).astype(np.float32)
+    seed = jnp.zeros((1,), jnp.int32)
+    y = fused_dropout_add_ln(jnp.asarray(xv), jnp.asarray(rv),
+                             jnp.asarray(scale), jnp.asarray(bias),
+                             seed, 0.0, 1e-5)
+    np.testing.assert_allclose(np.asarray(y),
+                               _composed_ref(xv, rv, scale, bias, 1e-5),
+                               rtol=2e-5, atol=2e-5)
+
+    # grads vs composed-jnp autodiff
+    def fused_loss(a, b, s, bb):
+        return jnp.sum(fused_dropout_add_ln(a, b, s, bb, seed, 0.0,
+                                            1e-5) ** 2)
+
+    def ref_loss(a, b, s, bb):
+        z = (a + b).astype(jnp.float32)
+        mean = z.mean(-1, keepdims=True)
+        var = ((z - mean) ** 2).mean(-1, keepdims=True)
+        return jnp.sum(((z - mean) * jax.lax.rsqrt(var + 1e-5) * s
+                        + bb) ** 2)
+
+    g1 = jax.grad(fused_loss, argnums=(0, 1, 2, 3))(
+        jnp.asarray(xv), jnp.asarray(rv), jnp.asarray(scale),
+        jnp.asarray(bias))
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2, 3))(
+        jnp.asarray(xv), jnp.asarray(rv), jnp.asarray(scale),
+        jnp.asarray(bias))
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fused_dropout_add_ln_dropout_semantics(_interpret_env):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas_fused_residual import fused_dropout_add_ln
+    rng = np.random.RandomState(1)
+    R, C = 16, 128
+    xv = jnp.asarray(rng.randn(R, C).astype(np.float32))
+    rv = jnp.zeros((R, C), jnp.float32)
+    scale = jnp.ones((C,), jnp.float32)
+    bias = jnp.zeros((C,), jnp.float32)
+    seed = jnp.asarray([7], jnp.int32)
+    p = 0.5
+
+    # grad wrt x must be 0 exactly where the mask dropped (replayed in bwd)
+    def loss(a):
+        return jnp.sum(fused_dropout_add_ln(a, rv, scale, bias, seed, p,
+                                            1e-5))
+    g = np.asarray(jax.grad(loss)(xv))
+    dropped = g == 0.0
+    assert 0.3 < dropped.mean() < 0.7          # ~p of elements dropped
+    # same seed => identical mask across calls
+    g2 = np.asarray(jax.grad(loss)(xv))
+    np.testing.assert_array_equal(g, g2)
+    # different seed => different mask
+    def loss2(a):
+        return jnp.sum(fused_dropout_add_ln(
+            a, rv, scale, bias, jnp.asarray([8], jnp.int32), p, 1e-5))
+    g3 = np.asarray(jax.grad(loss2)(xv))
+    assert (g3 == 0.0).mean() > 0.3 and not np.array_equal(g, g3)
+
+
+def test_fused_epilogue_op_and_encoder_parity(_interpret_env):
+    """The registered op + TransformerEncoderLayer (post-LN) match the
+    composed path in eval mode (p=0)."""
+    import paddle_tpu as paddle
+    paddle.disable_static()
+    import numpy as np
+    rng = np.random.RandomState(2)
+    layer = paddle.nn.TransformerEncoderLayer(128, 4, 256, dropout=0.1)
+    layer.eval()
+    x = paddle.to_tensor(rng.randn(2, 8, 128).astype("float32"))
+    out_fused = np.asarray(layer(x)._value)
+    import os
+    os.environ["PADDLE_TPU_DISABLE_PALLAS"] = "1"
+    try:
+        out_ref = np.asarray(layer(x)._value)
+    finally:
+        os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
+    np.testing.assert_allclose(out_fused, out_ref, rtol=2e-5, atol=2e-5)
