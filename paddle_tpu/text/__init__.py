@@ -6,7 +6,9 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "WMT14", "UCIHousing"]
+__all__ = ["Imdb", "WMT14", "UCIHousing", "Imikolov",
+           "Movielens", "Conll05st", "ViterbiDecoder",
+           "viterbi_decode"]
 
 
 class Imdb(Dataset):
@@ -63,3 +65,135 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return self.n
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference text/datasets/imikolov.py).
+    Synthetic Zipf-distributed token stream with Markov structure so an
+    n-gram model has signal to learn."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        rng = np.random.RandomState(11 if mode == "train" else 12)
+        self.window = window_size
+        self.vocab = 2000
+        n_tokens = 20000 if mode == "train" else 4000
+        # first-order Markov chain over a Zipf marginal
+        zipf = 1.0 / np.arange(1, self.vocab + 1)
+        zipf /= zipf.sum()
+        toks = [int(rng.choice(self.vocab, p=zipf))]
+        for _ in range(n_tokens - 1):
+            if rng.rand() < 0.3:     # sticky transitions: bigram signal
+                toks.append((toks[-1] * 7 + 3) % self.vocab)
+            else:
+                toks.append(int(rng.choice(self.vocab, p=zipf)))
+        self.stream = np.asarray(toks, np.int64)
+        self.n = len(self.stream) - window_size
+
+    def __getitem__(self, idx):
+        w = self.stream[idx:idx + self.window]
+        return w[:-1].copy(), w[-1:].copy()
+
+    def __len__(self):
+        return self.n
+
+
+class Movielens(Dataset):
+    """User/movie rating tuples (reference text/datasets/movielens.py):
+    (user_id, gender, age, job, movie_id, category, title, rating).
+    Synthetic with a planted low-rank preference structure."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        rng = np.random.RandomState(rand_seed + (0 if mode == "train"
+                                                 else 1))
+        self.n_users, self.n_movies = 500, 800
+        n = 4000 if mode == "train" else 400
+        self.users = rng.randint(0, self.n_users, n).astype("int64")
+        self.movies = rng.randint(0, self.n_movies, n).astype("int64")
+        # low-rank taste model -> learnable ratings in [1, 5]
+        uf = rng.randn(self.n_users, 4)
+        mf = rng.randn(self.n_movies, 4)
+        raw = (uf[self.users] * mf[self.movies]).sum(1)
+        self.ratings = np.clip(np.round(3.0 + raw), 1, 5).astype("float32")
+        self.genders = (self.users % 2).astype("int64")
+        self.ages = (self.users % 7).astype("int64")
+        self.jobs = (self.users % 21).astype("int64")
+        self.cats = (self.movies % 18).astype("int64")
+
+    def __getitem__(self, idx):
+        return (self.users[idx], self.genders[idx], self.ages[idx],
+                self.jobs[idx], self.movies[idx], self.cats[idx],
+                np.array([self.ratings[idx]], "float32"))
+
+    def __len__(self):
+        return len(self.users)
+
+
+class Conll05st(Dataset):
+    """SRL-style tagged sequences (reference text/datasets/conll05.py):
+    (words, predicate-context windows, label sequence). Synthetic BIO
+    tags correlated with token ranges."""
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(21 if mode == "train" else 22)
+        self.n = 128 if mode == "train" else 32
+        self.seq_len = 40
+        self.word_vocab = 4000
+        self.n_labels = 9
+        self.words = rng.randint(1, self.word_vocab,
+                                 (self.n, self.seq_len)).astype("int64")
+        # labels depend on token bucket => learnable
+        self.labels = (self.words % self.n_labels).astype("int64")
+        self.predicates = rng.randint(0, self.seq_len,
+                                      self.n).astype("int64")
+
+    def __getitem__(self, idx):
+        return (self.words[idx], self.predicates[idx:idx + 1].copy(),
+                self.labels[idx])
+
+    def __len__(self):
+        return self.n
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder (reference text/viterbi_decode.py):
+    argmax path through emissions [B, T, N] + transitions [N, N] with a
+    length mask — runs the crf_decoding kernel (padded/Length form,
+    paddle transition layout adds start/stop rows internally as zeros)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        import jax.numpy as jnp
+        from ..fluid.registry import require
+        trans = self.transitions
+        tv = trans._value if hasattr(trans, "_value") else jnp.asarray(trans)
+        pv = potentials._value if hasattr(potentials, "_value") \
+            else jnp.asarray(potentials)
+        lv = lengths._value if hasattr(lengths, "_value") \
+            else jnp.asarray(lengths)
+        n = tv.shape[-1]
+        full = jnp.concatenate([jnp.zeros((2, n), tv.dtype), tv], axis=0)
+        outs = require("crf_decoding").compute(
+            None, {"Emission": [pv], "Transition": [full],
+                   "Length": [lv]}, {})
+        path = outs["ViterbiPath"][0]
+        # scores of the decoded paths
+        t_idx = jnp.arange(pv.shape[1])
+        em = jnp.take_along_axis(pv, path[:, :, None], axis=2)[:, :, 0]
+        mask = (t_idx[None, :] < lv.reshape(-1, 1)).astype(pv.dtype)
+        scores = jnp.sum(em * mask, axis=1)
+        pair = tv[path[:, :-1], path[:, 1:]]
+        scores = scores + jnp.sum(pair * mask[:, 1:], axis=1)
+        from ..fluid.dygraph.varbase import Tensor
+        return Tensor(scores, stop_gradient=True), \
+            Tensor(path, stop_gradient=True)
+
+
+def viterbi_decode(potentials, transitions, lengths,
+                   include_bos_eos_tag=True, name=None):
+    return ViterbiDecoder(transitions, include_bos_eos_tag)(
+        potentials, lengths)

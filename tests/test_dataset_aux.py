@@ -187,3 +187,25 @@ def test_local_fs_roundtrip(tmp_path):
     if h._hadoop is None:
         with _pytest.raises(RuntimeError, match="hadoop"):
             h.is_exist("/tmp")
+
+
+def test_text_datasets_and_viterbi():
+    import numpy as np
+    from paddle_tpu.text import (Conll05st, Imikolov, Movielens,
+                                 ViterbiDecoder)
+    d = Imikolov(window_size=5)
+    ctx, nxt = d[3]
+    assert ctx.shape == (4,) and nxt.shape == (1,)
+    assert len(d) == 20000 - 5
+    m = Movielens()
+    row = m[0]
+    assert len(row) == 7 and 1.0 <= float(row[6][0]) <= 5.0
+    c = Conll05st(mode="test")
+    w, p, l = c[1]
+    assert w.shape == (40,) and l.shape == (40,)
+    # viterbi: strong diagonal transitions force tag continuity
+    em = np.zeros((1, 4, 2), np.float32)
+    em[0, 0, 1] = 5.0   # start clearly in tag 1
+    trans = np.array([[2.0, -2.0], [-2.0, 2.0]], np.float32)
+    scores, path = ViterbiDecoder(trans)(em, np.array([4], "int64"))
+    assert list(np.asarray(path._value)[0]) == [1, 1, 1, 1]
