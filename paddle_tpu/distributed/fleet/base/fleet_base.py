@@ -157,6 +157,7 @@ class Fleet:
         if st.expert_parallel and ep == 1:
             ep = st.expert_parallel_configs["ep_degree"]
         kwargs.setdefault("ep", ep)
+        kwargs.setdefault("sharding", bool(st.sharding))  # ZeRO-1
         return HybridParallelTrainStep(cfg, dp=dp, pp=pp, tp=tp, **kwargs)
 
 

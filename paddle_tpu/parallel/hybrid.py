@@ -60,7 +60,7 @@ class HybridParallelTrainStep:
                  weight_decay: float = 0.01, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
                  grad_clip_norm: float | None = 1.0, seed: int = 0,
-                 devices=None):
+                 sharding: bool = False, devices=None):
         if mesh is None:
             mesh = make_hybrid_mesh(dp, pp, tp, sp, ep, devices)
         self.sp = mesh.shape.get("sp", 1)
@@ -121,12 +121,35 @@ class HybridParallelTrainStep:
                  "lnf_b": "lnf_b",
                  "blocks": {k: f"blocks.{k}" for k in params["blocks"]}}
         self._names = names
+        # ZeRO-1 (strategy.sharding): optimizer moments shard over the dp
+        # axis on a free divisible dim — each dp rank owns 1/dp of the
+        # Adam state and computes its slice of the update; GSPMD inserts
+        # the param all-gather (reference sharding/ZeRO stage-1
+        # semantics, fleet sharding_configs)
+        self.zero_sharding = bool(sharding) and mesh.shape.get("dp", 1) > 1
+
+        def _opt_sharding(v, spec):
+            if not self.zero_sharding:
+                return NamedSharding(mesh, spec)
+            ndp = mesh.shape["dp"]
+            entries = list(spec) + [None] * (v.ndim - len(spec))
+            for i in range(v.ndim):
+                if entries[i] is None and v.shape[i] % ndp == 0:
+                    entries[i] = "dp"
+                    break
+            return NamedSharding(mesh, P(*entries))
+
+        self._opt_shardings = jax.tree_util.tree_map(
+            lambda v, s: {"m1": _opt_sharding(v, s),
+                          "m2": _opt_sharding(v, s)},
+            self.params, self._specs,
+            is_leaf=lambda s: isinstance(s, P))
         self.opt_state = jax.tree_util.tree_map(
             lambda v, sh: {"m1": jax.device_put(
-                               jnp.zeros(v.shape, jnp.float32), sh),
+                               jnp.zeros(v.shape, jnp.float32), sh["m1"]),
                            "m2": jax.device_put(
-                               jnp.zeros(v.shape, jnp.float32), sh)},
-            self.params, self._shardings)
+                               jnp.zeros(v.shape, jnp.float32), sh["m2"])},
+            self.params, self._opt_shardings)
         repl = NamedSharding(mesh, P())
         self._pows = (jax.device_put(jnp.ones((1,), jnp.float32), repl),
                       jax.device_put(jnp.ones((1,), jnp.float32), repl))
@@ -218,12 +241,7 @@ class HybridParallelTrainStep:
         repl = NamedSharding(mesh, P())
         return jax.jit(
             step, donate_argnums=(0, 1, 2),
-            out_shardings=(repl, self._shardings,
-                           jax.tree_util.tree_map(
-                               lambda s: {"m1": s, "m2": s},
-                               self._shardings,
-                               is_leaf=lambda s: isinstance(
-                                   s, NamedSharding)),
+            out_shardings=(repl, self._shardings, self._opt_shardings,
                            (repl, repl)))
 
     # ------------------------------------------------------------------
