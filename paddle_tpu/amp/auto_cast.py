@@ -17,15 +17,30 @@ white_list = {
     "matmul", "matmul_v2", "mul", "bmm", "conv2d", "depthwise_conv2d",
     "fc", "addmm", "fused_attention",
 }
-# numerically sensitive ops kept in fp32 (reference black list)
+# numerically sensitive ops kept in fp32 (reference black list).
+# batch_norm is deliberately NOT here: the kernel computes statistics in
+# f32 internally whatever the IO dtype (cuDNN-BN-style mixed precision, the
+# path the reference uses under AMP), and forcing f32 IO materialised
+# activation-sized f32 buffers around every BN — 2-3x the HBM traffic of
+# a ResNet step.
 black_list = {
     "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
-    "layer_norm", "batch_norm", "group_norm", "instance_norm", "mean",
+    "layer_norm", "group_norm", "instance_norm", "mean",
     "reduce_mean", "reduce_sum", "sum", "exp", "log", "square", "sqrt",
     "rsqrt", "p_norm", "squared_l2_norm",
 }
 
 _AMP_DTYPE = {"O1": jnp.bfloat16, "O2": jnp.bfloat16}
+
+
+# per-op slots that must stay f32 even when the op itself runs bf16:
+# batch_norm's running stats and affine params are f32 state (bf16 IO
+# applies to X only — re-rounding Mean/Variance through bf16 every step
+# would decay the running statistics)
+_KEEP_F32_SLOTS = {
+    "batch_norm": {"Mean", "Variance", "Scale", "Bias"},
+    "sync_batch_norm": {"Mean", "Variance", "Scale", "Bias"},
+}
 
 
 def _autocast_inputs(op_type, in_tensors, level):
@@ -41,8 +56,12 @@ def _autocast_inputs(op_type, in_tensors, level):
         target = jnp.bfloat16
     if target is None:
         return in_tensors
+    keep_f32 = _KEEP_F32_SLOTS.get(op_type, ())
     out = {}
     for slot, lst in in_tensors.items():
+        if target == jnp.bfloat16 and slot in keep_f32:
+            out[slot] = lst
+            continue
         res = []
         for t in lst:
             if t is not None and hasattr(t, "_value") and \

@@ -219,19 +219,37 @@ def _causal_attention(q, k, v, n_heads, impl="xla"):
     return o.reshape(B, T, D)
 
 
-def gpt_block_fn(p: dict, x, cfg: GPTConfig):
+def _dropout(x, rate, key):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def gpt_block_fn(p: dict, x, cfg: GPTConfig, key=None):
     """One pre-LN decoder block; p leaves are unstacked ([D,...]).
+
+    `key` enables residual dropout (GPT-2 placement: after the attention
+    out-projection and after the FFN down-projection); None or
+    cfg.dropout=0 is the deterministic path. The pipeline engines re-derive
+    the same key at recompute time, so rematerialised backward sees
+    identical masks.
 
     Returns (x, aux): aux is the MoE load-balance loss of this block's
     routed FFN (0.0 for the dense FFN)."""
     cdt = jnp.dtype(cfg.amp_dtype) if cfg.amp_dtype else x.dtype
     c = lambda a: a.astype(cdt)
+    drop = cfg.dropout if (cfg.dropout and key is not None) else 0.0
+    if drop:
+        k1, k2 = jax.random.split(key)
     h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
     q = c(h) @ c(p["wq"]) + c(p["bq"])
     k = c(h) @ c(p["wk"]) + c(p["bk"])
     v = c(h) @ c(p["wv"]) + c(p["bv"])
     a = _causal_attention(q, k, v, cfg.num_heads, cfg.attn_impl)
-    x = x + (a @ c(p["wo"]) + c(p["bo"])).astype(x.dtype)
+    proj = a @ c(p["wo"]) + c(p["bo"])
+    if drop:
+        proj = _dropout(proj, drop, k1)
+    x = x + proj.astype(x.dtype)
     h = _ln(x, p["ln2_s"], p["ln2_b"], cfg.layer_norm_eps)
     if cfg.num_experts > 0:
         from ..parallel.moe import moe_ffn
@@ -239,9 +257,14 @@ def gpt_block_fn(p: dict, x, cfg: GPTConfig):
             c(h), p["wg"], p["we_up"], p["be_up"], p["we_down"],
             p["be_down"], capacity_factor=cfg.moe_capacity_factor,
             top_k=cfg.moe_top_k)
+        if drop:
+            y = _dropout(y, drop, k2)
         return x + y.astype(x.dtype), aux
     u = jax.nn.gelu(c(h) @ c(p["w_up"]) + c(p["b_up"]), approximate=True)
-    x = x + (u @ c(p["w_down"]) + c(p["b_down"])).astype(x.dtype)
+    d = u @ c(p["w_down"]) + c(p["b_down"])
+    if drop:
+        d = _dropout(d, drop, k2)
+    x = x + d.astype(x.dtype)
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -276,26 +299,48 @@ def block_body(cfg: GPTConfig):
     return body
 
 
-def gpt_forward_aux(params: dict, ids, cfg: GPTConfig):
+def block_body_keyed(cfg: GPTConfig):
+    """Like block_body but the scan xs is (blk, per-layer dropout key)."""
+    def inner(blk, h, key):
+        return gpt_block_fn(blk, h, cfg, key)
+
+    if cfg.remat:
+        inner = jax.checkpoint(inner)
+
+    def body(h, xs):
+        blk, key = xs
+        return inner(blk, h, key)
+
+    return body
+
+
+def gpt_forward_aux(params: dict, ids, cfg: GPTConfig, key=None):
     """(logits [B, T, V], aux): aux = summed MoE load-balance loss over
-    layers (0.0 for dense models)."""
+    layers (0.0 for dense models). `key` turns on dropout (training)."""
     x = _embed(params, ids, cfg)
-    x, auxs = jax.lax.scan(block_body(cfg), x, params["blocks"])
+    if cfg.dropout and key is not None:
+        kemb, key = jax.random.split(key)
+        x = _dropout(x, cfg.dropout, kemb)
+        lkeys = jax.random.split(key, cfg.num_layers)
+        x, auxs = jax.lax.scan(block_body_keyed(cfg), x,
+                               (params["blocks"], lkeys))
+    else:
+        x, auxs = jax.lax.scan(block_body(cfg), x, params["blocks"])
     return _head(params, x, cfg), jnp.sum(auxs)
 
 
-def gpt_forward(params: dict, ids, cfg: GPTConfig):
+def gpt_forward(params: dict, ids, cfg: GPTConfig, key=None):
     """ids [B, T] int -> logits [B, T, V]. Blocks run under lax.scan over
     the stacked [L, ...] leaves."""
-    return gpt_forward_aux(params, ids, cfg)[0]
+    return gpt_forward_aux(params, ids, cfg, key=key)[0]
 
 
-def gpt_loss(params: dict, ids, cfg: GPTConfig, logits=None):
+def gpt_loss(params: dict, ids, cfg: GPTConfig, logits=None, key=None):
     """Mean next-token cross entropy; predicts ids[:,1:] from ids[:,:-1].
     MoE models add cfg.moe_aux_weight * load-balance aux."""
     aux = None
     if logits is None:
-        logits, aux = gpt_forward_aux(params, ids, cfg)
+        logits, aux = gpt_forward_aux(params, ids, cfg, key=key)
     logits = logits[:, :-1]
     labels = ids[:, 1:]
     logz = jax.nn.logsumexp(logits, axis=-1)

@@ -17,11 +17,13 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "BasicBlock", "BottleneckBlock"]
 
 
-def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True):
+def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True,
+             data_format="NCHW"):
     pad = (k - 1) // 2
     layers = [nn.Conv2D(in_c, out_c, k, stride=stride, padding=pad,
-                        groups=groups, bias_attr=False),
-              nn.BatchNorm2D(out_c)]
+                        groups=groups, bias_attr=False,
+                        data_format=data_format),
+              nn.BatchNorm2D(out_c, data_format=data_format)]
     if act:
         layers.append(nn.ReLU())
     return nn.Sequential(*layers)
@@ -30,10 +32,11 @@ def _conv_bn(in_c, out_c, k, stride=1, groups=1, act=True):
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, in_c, c, stride=1, downsample=None):
+    def __init__(self, in_c, c, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv1 = _conv_bn(in_c, c, 3, stride)
-        self.conv2 = _conv_bn(c, c, 3, act=False)
+        self.conv1 = _conv_bn(in_c, c, 3, stride, data_format=data_format)
+        self.conv2 = _conv_bn(c, c, 3, act=False, data_format=data_format)
         self.downsample = downsample
         self.relu = nn.ReLU()
 
@@ -46,11 +49,13 @@ class BasicBlock(nn.Layer):
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, in_c, c, stride=1, downsample=None):
+    def __init__(self, in_c, c, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv1 = _conv_bn(in_c, c, 1)
-        self.conv2 = _conv_bn(c, c, 3, stride)
-        self.conv3 = _conv_bn(c, c * 4, 1, act=False)
+        self.conv1 = _conv_bn(in_c, c, 1, data_format=data_format)
+        self.conv2 = _conv_bn(c, c, 3, stride, data_format=data_format)
+        self.conv3 = _conv_bn(c, c * 4, 1, act=False,
+                              data_format=data_format)
         self.downsample = downsample
         self.relu = nn.ReLU()
 
@@ -71,7 +76,7 @@ class ResNet(nn.Layer):
               152: (BottleneckBlock, [3, 8, 36, 3])}
 
     def __init__(self, block=None, depth=50, num_classes=1000,
-                 with_pool=True):
+                 with_pool=True, data_format="NCHW"):
         super().__init__()
         if block is None:
             block, counts = self._SPECS[depth]
@@ -79,10 +84,17 @@ class ResNet(nn.Layer):
             _, counts = self._SPECS[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
+        # data_format="NHWC" runs the whole trunk channel-minor — the
+        # native TPU conv layout (inputs may stay NCHW; they are transposed
+        # once at the stem). NCHW stays the default for reference parity.
+        self._data_format = data_format
+        df = data_format
         self.stem = nn.Sequential(
-            nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False),
-            nn.BatchNorm2D(64), nn.ReLU(),
-            nn.MaxPool2D(kernel_size=3, stride=2, padding=1))
+            nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
+                      data_format=df),
+            nn.BatchNorm2D(64, data_format=df), nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1,
+                         data_format=df))
         stages = []
         in_c = 64
         for i, (c, n) in enumerate(zip([64, 128, 256, 512], counts)):
@@ -92,18 +104,22 @@ class ResNet(nn.Layer):
                 down = None
                 if stride != 1 or in_c != c * block.expansion:
                     down = _conv_bn(in_c, c * block.expansion, 1, stride,
-                                    act=False)
-                blocks.append(block(in_c, c, stride, down))
+                                    act=False, data_format=df)
+                blocks.append(block(in_c, c, stride, down, data_format=df))
                 in_c = c * block.expansion
             stages.append(nn.Sequential(*blocks))
         self.layer1, self.layer2, self.layer3, self.layer4 = stages
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
         self.flatten = nn.Flatten()
 
     def forward(self, x):
+        if self._data_format == "NHWC" and x.shape[-1] != 3:
+            # accept standard NCHW input with one edge transpose
+            from .. import tensor as T
+            x = T.transpose(x, [0, 2, 3, 1])
         x = self.stem(x)
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:

@@ -41,7 +41,9 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                   "groups": groups, "padding_algorithm": algo,
                   "data_format": data_format}, out_slot="Output")
     if bias is not None:
-        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
+        axis = 1 if data_format == "NCHW" else -1
+        out = run_op("elementwise_add", {"X": out, "Y": bias},
+                     {"axis": axis})
     return out
 
 
@@ -74,12 +76,15 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                   "data_format": data_format},
                  out_slot="Output")
     if bias is not None:
-        out = run_op("elementwise_add", {"X": out, "Y": bias}, {"axis": 1})
+        axis = 1 if data_format == "NCHW" else -1
+        out = run_op("elementwise_add", {"X": out, "Y": bias},
+                     {"axis": axis})
     return out
 
 
 def _pool2d(x, pooling_type, kernel_size, stride, padding, ceil_mode,
-            exclusive=True, adaptive=False, global_pool=False):
+            exclusive=True, adaptive=False, global_pool=False,
+            data_format="NCHW"):
     k = [kernel_size] * 2 if isinstance(kernel_size, int) else list(kernel_size)
     s = k if stride is None else (
         [stride] * 2 if isinstance(stride, int) else list(stride))
@@ -88,25 +93,27 @@ def _pool2d(x, pooling_type, kernel_size, stride, padding, ceil_mode,
                   {"pooling_type": pooling_type, "ksize": k, "strides": s,
                    "paddings": p, "global_pooling": global_pool,
                    "ceil_mode": ceil_mode, "exclusive": exclusive,
-                   "adaptive": adaptive})
+                   "adaptive": adaptive, "data_format": data_format})
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    return _pool2d(x, "max", kernel_size, stride, padding, ceil_mode)
+    return _pool2d(x, "max", kernel_size, stride, padding, ceil_mode,
+                   data_format=data_format)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool2d(x, "avg", kernel_size, stride, padding, ceil_mode,
-                   exclusive)
+                   exclusive, data_format=data_format)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     os_ = [output_size] * 2 if isinstance(output_size, int) \
         else list(output_size)
-    return _pool2d(x, "avg", os_, None, 0, False, adaptive=True)
+    return _pool2d(x, "avg", os_, None, 0, False, adaptive=True,
+                   data_format=data_format)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):

@@ -96,6 +96,7 @@ class Conv2DTranspose(Layer):
         self._padding = padding
         self._dilation = dilation
         self._groups = groups
+        self._data_format = data_format
         self.weight = self.create_parameter(
             [in_channels, out_channels // groups] + k, attr=weight_attr)
         self.bias = self.create_parameter([out_channels], attr=bias_attr,
@@ -105,7 +106,8 @@ class Conv2DTranspose(Layer):
     def forward(self, x):
         return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
                                   self._padding, dilation=self._dilation,
-                                  groups=self._groups)
+                                  groups=self._groups,
+                                  data_format=self._data_format)
 
 
 class MaxPool2D(Layer):
@@ -114,9 +116,11 @@ class MaxPool2D(Layer):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride, padding
         self._ceil = ceil_mode
+        self._df = data_format
 
     def forward(self, x):
-        return F.max_pool2d(x, self._k, self._s, self._p, self._ceil)
+        return F.max_pool2d(x, self._k, self._s, self._p, self._ceil,
+                            data_format=self._df)
 
 
 class AvgPool2D(Layer):
@@ -126,19 +130,21 @@ class AvgPool2D(Layer):
         super().__init__()
         self._k, self._s, self._p = kernel_size, stride, padding
         self._ceil, self._excl = ceil_mode, exclusive
+        self._df = data_format
 
     def forward(self, x):
         return F.avg_pool2d(x, self._k, self._s, self._p, self._ceil,
-                            self._excl)
+                            self._excl, data_format=self._df)
 
 
 class AdaptiveAvgPool2D(Layer):
     def __init__(self, output_size, data_format="NCHW", name=None):
         super().__init__()
         self._os = output_size
+        self._df = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self._os)
+        return F.adaptive_avg_pool2d(x, self._os, data_format=self._df)
 
 
 class AdaptiveMaxPool2D(Layer):

@@ -96,6 +96,7 @@ class HybridParallelTrainStep:
             raise ValueError(
                 f"num_layers={cfg.num_layers} not divisible by pp={self.pp}")
         self._lr = lr
+        self._seed = seed
         self._hyper = dict(beta1=beta1, beta2=beta2, epsilon=epsilon)
         self._wd = weight_decay
         self._clip = grad_clip_norm
@@ -158,24 +159,24 @@ class HybridParallelTrainStep:
         self._jit_step = self._build(mesh)
 
     # ------------------------------------------------------------------
-    def loss_fn(self, params, ids):
+    def loss_fn(self, params, ids, key=None):
         cfg, mesh = self.cfg, self.mesh
         if cfg.num_experts > 0:
             from .moe import moe_context
             with moe_context(mesh, "ep"):
-                return self._loss_inner(params, ids)
-        return self._loss_inner(params, ids)
+                return self._loss_inner(params, ids, key)
+        return self._loss_inner(params, ids, key)
 
-    def _loss_inner(self, params, ids):
+    def _loss_inner(self, params, ids, key=None):
         cfg, mesh = self.cfg, self.mesh
         if self.sp > 1:
             from .sequence_parallel import ring_context
             ids = jax.lax.with_sharding_constraint(
                 ids, NamedSharding(mesh, P("dp", "sp")))
             with ring_context(mesh, "sp"):
-                return G.gpt_loss(params, ids, cfg)
+                return G.gpt_loss(params, ids, cfg, key=key)
         if self.pp == 1:
-            return G.gpt_loss(params, ids, cfg)
+            return G.gpt_loss(params, ids, cfg, key=key)
         M = self.n_micro
         B, T = ids.shape
         if B % M:
@@ -201,8 +202,8 @@ class HybridParallelTrainStep:
         wd, clip = self._wd, self._clip
         names = self._names
 
-        def step(params, opt_state, pows, ids, lr):
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, ids)
+        def step(params, opt_state, pows, ids, lr, key):
+            loss, grads = jax.value_and_grad(self.loss_fn)(params, ids, key)
             if clip:
                 leaves = jax.tree_util.tree_leaves(grads)
                 gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(
@@ -248,9 +249,12 @@ class HybridParallelTrainStep:
     def __call__(self, ids):
         ids = jax.device_put(jnp.asarray(ids), self._batch_sharding)
         lr = self._lr() if callable(self._lr) else float(self._lr)
+        self._step_no = getattr(self, "_step_no", 0) + 1
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 self._step_no)
         loss, self.params, self.opt_state, self._pows = self._jit_step(
             self.params, self.opt_state, self._pows, ids,
-            np.float32(lr))
+            np.float32(lr), key)
         return loss
 
     def unstacked_params(self):

@@ -76,3 +76,48 @@ def test_resnet_dp_train_smoke():
             np.asarray(step(img, lab[:, None].astype("int64"))))[0]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_resnet_nhwc_parity():
+    """NHWC trunk (TPU-native conv layout) matches the NCHW reference path
+    bit-for-bit up to fp32 conv reassociation; inputs stay NCHW and are
+    transposed once at the stem."""
+    m1 = models.resnet18(num_classes=10)
+    m2 = models.resnet18(num_classes=10, data_format="NHWC")
+    p2 = dict(m2.named_parameters())
+    for k, v in dict(m1.named_parameters()).items():
+        p2[k]._set_value(v._value)
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 3, 64, 64).astype("float32"))
+    for mode in ("train", "eval"):
+        getattr(m1, mode)(); getattr(m2, mode)()
+        y1, y2 = m1(x).numpy(), m2(x).numpy()
+        np.testing.assert_allclose(y1, y2, rtol=2e-4, atol=2e-4)
+
+
+def test_pool_conv_nhwc_ops_parity():
+    """data_format=NHWC on conv2d / pool2d / adaptive pool matches NCHW."""
+    import paddle_tpu.nn.functional as F
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 5, 13, 9).astype("float32")
+    w = rs.randn(4, 5, 3, 3).astype("float32")
+    b = rs.randn(4).astype("float32")
+    xt = paddle.to_tensor(x)
+    xh = paddle.to_tensor(x.transpose(0, 2, 3, 1))
+    wt = paddle.to_tensor(w)
+    bt = paddle.to_tensor(b)
+    y1 = F.conv2d(xt, wt, bt, stride=2, padding=1).numpy()
+    y2 = F.conv2d(xh, wt, bt, stride=2, padding=1,
+                  data_format="NHWC").numpy()
+    np.testing.assert_allclose(y1, y2.transpose(0, 3, 1, 2), rtol=1e-5,
+                               atol=1e-5)
+    for fn, kw in [(F.max_pool2d, dict(kernel_size=3, stride=2, padding=1)),
+                   (F.avg_pool2d, dict(kernel_size=2, stride=2))]:
+        z1 = fn(xt, **kw).numpy()
+        z2 = fn(xh, data_format="NHWC", **kw).numpy()
+        np.testing.assert_allclose(z1, z2.transpose(0, 3, 1, 2), rtol=1e-6,
+                                   atol=1e-6)
+    a1 = F.adaptive_avg_pool2d(xt, (4, 3)).numpy()
+    a2 = F.adaptive_avg_pool2d(xh, (4, 3), data_format="NHWC").numpy()
+    np.testing.assert_allclose(a1, a2.transpose(0, 3, 1, 2), rtol=1e-6,
+                               atol=1e-6)
