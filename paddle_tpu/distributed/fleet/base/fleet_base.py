@@ -153,6 +153,8 @@ class Fleet:
             acc = st.pipeline_configs.get("accumulate_steps") or 0
             micro = acc if acc > 1 else None
         kwargs.setdefault("n_microbatches", micro)
+        kwargs.setdefault("pipeline_schedule",
+                          st.pipeline_configs.get("schedule_mode", "1F1B"))
         ep = hc.get("ep_degree", 1)
         if st.expert_parallel and ep == 1:
             ep = st.expert_parallel_configs["ep_degree"]

@@ -59,7 +59,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
         # output_size disambiguates the stride-ambiguous output shape:
         # convert to output_padding over the default (reference
         # conv_transpose_op.cc)
-        hw = x.shape[2:]
+        hw = x.shape[1:3] if data_format == "NHWC" else x.shape[2:]
         os_ = [output_size] * 2 if isinstance(output_size, int) \
             else list(output_size)
         for i in (0, 1):

@@ -60,19 +60,28 @@ class HybridParallelTrainStep:
                  weight_decay: float = 0.01, beta1: float = 0.9,
                  beta2: float = 0.999, epsilon: float = 1e-8,
                  grad_clip_norm: float | None = 1.0, seed: int = 0,
-                 sharding: bool = False, devices=None):
+                 sharding: bool = False, devices=None,
+                 pipeline_schedule: str = "1F1B"):
         if mesh is None:
             mesh = make_hybrid_mesh(dp, pp, tp, sp, ep, devices)
         self.sp = mesh.shape.get("sp", 1)
         self.pp = mesh.shape.get("pp", 1)
         self.ep = mesh.shape.get("ep", 1)
+        # reference schedule_mode values: "1F1B" (SectionWorker interleave,
+        # here parallel/pipeline_1f1b.py) and "F-then-B" (GPipe, here the
+        # differentiable scan in parallel/pipeline.py)
+        if pipeline_schedule not in ("1F1B", "F-then-B", "gpipe"):
+            raise ValueError(f"unknown pipeline_schedule "
+                             f"{pipeline_schedule!r}")
+        self._schedule = "1F1B" if pipeline_schedule == "1F1B" else "gpipe"
         if self.ep > 1 and cfg.num_experts <= 0:
             raise ValueError("ep>1 needs a MoE model (cfg.num_experts>0)")
         if cfg.num_experts > 0:
-            if self.pp > 1:
+            if self.pp > 1 and self._schedule != "1F1B":
                 raise NotImplementedError(
-                    "MoE x pipeline: the stage scan drops the per-layer "
-                    "load-balance aux — shard experts OR layers (yet)")
+                    "MoE x pipeline needs schedule_mode='1F1B' (the GPipe "
+                    "scan drops the per-layer load-balance aux; the 1F1B "
+                    "engine threads it through each stage's vjp)")
             if self.ep > 1 and cfg.num_experts % self.ep:
                 raise ValueError(
                     f"num_experts={cfg.num_experts} not divisible by "
@@ -88,10 +97,11 @@ class HybridParallelTrainStep:
         self.cfg = cfg
         self.mesh = mesh
         self.n_micro = n_microbatches or max(2 * self.pp, 1)
-        if self.pp > 1 and cfg.dropout:
+        if self.pp > 1 and cfg.dropout and self._schedule != "1F1B":
             raise NotImplementedError(
-                "pipeline path is deterministic (dropout=0); the stage scan "
-                "carries no rng")
+                "pipeline dropout needs schedule_mode='1F1B' (its stage "
+                "functions re-derive per-(stage, microbatch) rng keys; the "
+                "GPipe scan carries no rng)")
         if cfg.num_layers % self.pp:
             raise ValueError(
                 f"num_layers={cfg.num_layers} not divisible by pp={self.pp}")
@@ -194,6 +204,91 @@ class HybridParallelTrainStep:
         logits = G._head(params, out, cfg)
         return G.gpt_loss(params, ids, cfg, logits=logits)
 
+    # ------------------------------------------------------------------
+    def _loss_and_grads_1f1b(self, params, ids, key):
+        """pp>1 1F1B path: loss/grads come from the schedule engine
+        (parallel/pipeline_1f1b.py), not from differentiating the forward;
+        the embedding is kept under outer autodiff via jax.vjp and its
+        cotangent routed from stage 0's input grads."""
+        cfg, mesh = self.cfg, self.mesh
+        M = self.n_micro
+        B, T = ids.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        ids_mb = ids.reshape(M, B // M, T)
+        lps = cfg.num_layers // self.pp
+        use_drop = bool(cfg.dropout) and key is not None
+        from .pipeline_1f1b import pipeline_1f1b_grads
+        n_auto = sum(1 for ax in ("dp", "tp", "sp", "ep")
+                     if mesh.shape.get(ax, 1) > 1)
+        if n_auto >= 2:
+            # see the partitioner-workaround comment below: the embedding
+            # table is consumed replicated throughout this step (its grad
+            # is resharded to the tp spec by the jit out_shardings), and
+            # the per-layer jax.checkpoint inside the stage scan is
+            # dropped (also a partitioner trigger on this combo) — the
+            # 1F1B engine already remats at stage granularity, so only
+            # the within-B-tick residual footprint grows
+            import dataclasses as _dc
+            cfg = _dc.replace(cfg, remat=False)
+            params = dict(params)
+            params["wte"] = jax.lax.with_sharding_constraint(
+                params["wte"], NamedSharding(mesh, P()))
+
+        def emb_fn(embp):
+            x = jnp.take(embp["wte"], ids_mb, axis=0) + embp["wpe"][:T]
+            if cfg.amp_dtype:
+                x = x.astype(jnp.dtype(cfg.amp_dtype))
+            if use_drop:
+                x = G._dropout(x, cfg.dropout,
+                               jax.random.fold_in(key, 0x5eed))
+            return x
+
+        embp = {"wte": params["wte"], "wpe": params["wpe"]}
+        x0, emb_vjp = jax.vjp(emb_fn, embp)
+        x0 = jax.lax.with_sharding_constraint(
+            x0, NamedSharding(mesh, P(None, "dp")))
+
+        def stage_fn(local, x, k):
+            if use_drop:
+                lkeys = jax.random.split(k, lps)
+                y, auxs = jax.lax.scan(G.block_body_keyed(cfg), x,
+                                       (local, lkeys))
+            else:
+                y, auxs = jax.lax.scan(G.block_body(cfg), x, local)
+            return y, jnp.sum(auxs)
+
+        def last_fn(local, sh, x, ids_one, k):
+            y, aux = stage_fn(local, x, k)
+            logits = G._head({"wte": sh["wte"], "lnf_s": sh["lnf_s"],
+                              "lnf_b": sh["lnf_b"]}, y, cfg)
+            loss = G.gpt_loss(None, ids_one, cfg, logits=logits)
+            return y, loss, aux
+
+        # XLA's SPMD partitioner Check-fails (spmd_partitioner_util.cc
+        # group bookkeeping) when TWO auto mesh axes (e.g. dp and tp) are
+        # active beside the manual pp axis and either (a) lax.cond
+        # branches carry tp collectives or (b) the tp-vocab-sharded head
+        # matmul sits inside the manual region. For that combo: run the
+        # cond-free uniform executor (blocks+head every B-tick, cotangent-
+        # masked) AND consume the embedding/head table replicated (one
+        # wte all-gather per step, applied above). Verified exact-loss/
+        # grad parity vs the sharded-head cond executor on
+        # single-auto-axis meshes.
+        shared = {"wte": params["wte"], "lnf_s": params["lnf_s"],
+                  "lnf_b": params["lnf_b"]}
+        aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
+        loss, gblocks, gshared, dx0 = pipeline_1f1b_grads(
+            stage_fn, last_fn, params["blocks"], shared, x0, ids_mb,
+            mesh, "pp", aux_weight=aux_w, key=key,
+            uniform_last=n_auto >= 2)
+        (gemb,) = emb_vjp(dx0)
+        grads = {"wte": gshared["wte"] + gemb["wte"].astype(jnp.float32),
+                 "wpe": gemb["wpe"].astype(jnp.float32),
+                 "lnf_s": gshared["lnf_s"], "lnf_b": gshared["lnf_b"],
+                 "blocks": gblocks}
+        return loss, grads
+
     def _build(self, mesh):
         from ..fluid import registry
         opdef = registry.require("adamw")
@@ -201,9 +296,16 @@ class HybridParallelTrainStep:
         opdef.fill_default_attrs(hyper)
         wd, clip = self._wd, self._clip
         names = self._names
+        use_1f1b = self.pp > 1 and self._schedule == "1F1B"
 
-        def step(params, opt_state, pows, ids, lr, key):
-            loss, grads = jax.value_and_grad(self.loss_fn)(params, ids, key)
+        def grads_1f1b(params, ids, key):
+            if self.cfg.num_experts > 0:
+                from .moe import moe_context
+                with moe_context(mesh, "ep"):
+                    return self._loss_and_grads_1f1b(params, ids, key)
+            return self._loss_and_grads_1f1b(params, ids, key)
+
+        def apply_update(params, opt_state, pows, grads, lr):
             if clip:
                 leaves = jax.tree_util.tree_leaves(grads)
                 gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(
@@ -234,12 +336,41 @@ class HybridParallelTrainStep:
                 np_, ns_, b1n, b2n = upd(p, g, st, n)
                 new_p.append(np_)
                 new_s.append(ns_)
-            return (loss,
-                    jax.tree_util.tree_unflatten(tdef, new_p),
+            return (jax.tree_util.tree_unflatten(tdef, new_p),
                     jax.tree_util.tree_unflatten(tdef, new_s),
                     (b1n, b2n))
 
         repl = NamedSharding(mesh, P())
+        if use_1f1b:
+            # TWO dispatches: the schedule+grads program, then the
+            # clip+AdamW program. Fusing them into one jit Check-fails
+            # XLA's SPMD partitioner when the pipeline's manual region,
+            # dropout rng and the global-norm reduction meet on a
+            # multi-auto-axis mesh; split programs compile clean and the
+            # extra dispatch is noise next to a pipeline step.
+            jit_grads = jax.jit(grads_1f1b, out_shardings=None)
+            jit_update = jax.jit(
+                apply_update, donate_argnums=(0, 1, 2, 3),
+                out_shardings=(self._shardings, self._opt_shardings,
+                               (repl, repl)))
+
+            def step2(params, opt_state, pows, ids, lr, key):
+                loss, grads = jit_grads(params, ids, key)
+                new_p, new_s, new_pows = jit_update(params, opt_state,
+                                                    pows, grads, lr)
+                return loss, new_p, new_s, new_pows
+
+            step2._jit_grads = jit_grads      # introspection (tests)
+            step2._jit_update = jit_update
+            return step2
+
+        def step(params, opt_state, pows, ids, lr, key):
+            loss, grads = jax.value_and_grad(self.loss_fn)(
+                params, ids, key)
+            new_p, new_s, new_pows = apply_update(params, opt_state, pows,
+                                                  grads, lr)
+            return loss, new_p, new_s, new_pows
+
         return jax.jit(
             step, donate_argnums=(0, 1, 2),
             out_shardings=(repl, self._shardings, self._opt_shardings,
