@@ -154,10 +154,63 @@ def _recv_msg(sock):
     return pickle.loads(bytes(buf))
 
 
+class _SyncRound:
+    """Sync-mode round state for one PS shard (reference
+    RunSyncLoop + send_barrier/fetch_barrier rounds,
+    operators/distributed/communicator.h:253 HalfAsync barrier logic):
+    push_sync only BUFFERS gradients; the last trainer through the send
+    barrier applies the whole round (mean over trainers) before anyone is
+    released; the fetch barrier then holds the next round's apply until
+    every trainer pulled the fresh values."""
+
+    def __init__(self, trainers: int):
+        self.trainers = trainers
+        self.cond = threading.Condition()
+        self.pending: list[tuple] = []
+        self.send_done: set[int] = set()
+        self.fetch_done: set[int] = set()
+        self.round = 0
+        self.fround = 0
+
+    def push(self, item):
+        with self.cond:
+            self.pending.append(item)
+
+    def send_barrier(self, worker: int, apply_fn) -> int:
+        with self.cond:
+            self.send_done.add(int(worker))
+            if len(self.send_done) >= self.trainers:
+                pending, self.pending = self.pending, []
+                apply_fn(pending)
+                self.send_done.clear()
+                self.round += 1
+                self.cond.notify_all()
+                return self.round
+            r = self.round
+            if not self.cond.wait_for(lambda: self.round > r, timeout=300):
+                raise TimeoutError("send_barrier: trainers missing")
+            return self.round
+
+    def fetch_barrier(self, worker: int) -> int:
+        with self.cond:
+            self.fetch_done.add(int(worker))
+            if len(self.fetch_done) >= self.trainers:
+                self.fetch_done.clear()
+                self.fround += 1
+                self.cond.notify_all()
+                return self.fround
+            fr = self.fround
+            if not self.cond.wait_for(lambda: self.fround > fr,
+                                      timeout=300):
+                raise TimeoutError("fetch_barrier: trainers missing")
+            return self.fround
+
+
 class PSServer(socketserver.ThreadingTCPServer):
     """One PS shard: serves pull/push/save/size for its tables (reference
     listen_and_serv_op RunAsyncLoop — apply-on-arrival, no global
-    barrier). Port 0 binds an ephemeral port; `endpoint` reports it."""
+    barrier; RunSyncLoop when the sync ops are used). Port 0 binds an
+    ephemeral port; `endpoint` reports it."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -166,6 +219,8 @@ class PSServer(socketserver.ThreadingTCPServer):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
+        self._sync: _SyncRound | None = None
+        self._sync_lock = threading.Lock()
         # worker liveness (reference operators/distributed/
         # heart_beat_monitor.h:54): last-seen stamp per worker id;
         # lost_workers() reports ids silent past the timeout
@@ -210,6 +265,24 @@ class PSServer(socketserver.ThreadingTCPServer):
         if op == "size":
             t = self.tables.get(req["table"])
             return 0 if t is None else t.size()
+        if op == "push_sync":
+            self._sync_state(req["trainers"]).push(
+                (req["table"], req["dim"], req["keys"], req["grads"],
+                 req.get("lr", 1.0)))
+            return True
+        if op == "send_barrier":
+            def apply_fn(pending):
+                n = max(int(req["trainers"]), 1)
+                for table, dim, keys, grads, lr in pending:
+                    # mean over trainers: matches the single-process
+                    # full-batch step when each trainer computes the mean
+                    # loss of its batch shard
+                    self.table(table, dim).push(keys, grads, lr / n)
+            return self._sync_state(req["trainers"]).send_barrier(
+                req["worker"], apply_fn)
+        if op == "fetch_barrier":
+            return self._sync_state(req["trainers"]).fetch_barrier(
+                req["worker"])
         if op == "ping":
             return "pong"
         if op == "heartbeat":
@@ -220,6 +293,24 @@ class PSServer(socketserver.ThreadingTCPServer):
         if op == "lost_workers":
             return self.lost_workers()
         raise ValueError(f"unknown PS op {op!r}")
+
+    def _sync_state(self, trainers: int) -> _SyncRound:
+        with self._sync_lock:
+            if self._sync is None:
+                self._sync = _SyncRound(int(trainers))
+            elif self._sync.trainers != int(trainers):
+                st = self._sync
+                with st.cond:
+                    idle = not st.pending and not st.send_done and \
+                        not st.fetch_done
+                if not idle:
+                    raise ValueError(
+                        f"sync trainer count changed mid-round "
+                        f"({st.trainers} -> {trainers}) with buffered "
+                        f"state — restart the job cleanly")
+                # a new job with a different world size: fresh round state
+                self._sync = _SyncRound(int(trainers))
+            return self._sync
 
     def lost_workers(self) -> list[int]:
         import time
@@ -248,7 +339,9 @@ class PSClient:
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
             host, port = self.endpoints[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)), timeout=60)
+            # generous timeout: sync-mode barrier calls block server-side
+            # until the whole trainer round arrives
+            s = socket.create_connection((host, int(port)), timeout=330)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
@@ -290,17 +383,38 @@ class PSClient:
             out[m] = r
         return out
 
-    def push(self, table: str, dim: int, keys, grads, lr: float = 1.0):
+    def push(self, table: str, dim: int, keys, grads, lr: float = 1.0,
+             sync: bool = False, trainers: int = 1):
         keys = np.asarray(keys, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(len(keys), dim)
         owner = self._route(keys)
+        op = "push_sync" if sync else "push"
         masks = [(i, owner == i) for i in range(len(self.endpoints))]
         self._fanout([
-            (lambda i=i, m=m: self._call(i, {"op": "push", "table": table,
+            (lambda i=i, m=m: self._call(i, {"op": op, "table": table,
                                              "dim": dim, "keys": keys[m],
                                              "grads": grads[m],
-                                             "lr": lr}))
+                                             "lr": lr,
+                                             "trainers": trainers}))
             for i, m in masks if m.any()])
+
+    def send_barrier(self, worker: int, trainers: int):
+        """Block until every trainer finished this round's pushes; the
+        last arrival applies the buffered round (reference
+        send_barrier round semantics)."""
+        self._fanout([
+            (lambda i=i: self._call(i, {"op": "send_barrier",
+                                        "worker": worker,
+                                        "trainers": trainers}))
+            for i in range(len(self.endpoints))])
+
+    def fetch_barrier(self, worker: int, trainers: int):
+        """Block until every trainer pulled the freshly applied params."""
+        self._fanout([
+            (lambda i=i: self._call(i, {"op": "fetch_barrier",
+                                        "worker": worker,
+                                        "trainers": trainers}))
+            for i in range(len(self.endpoints))])
 
     def size(self, table: str) -> int:
         return sum(self._call(i, {"op": "size", "table": table})

@@ -18,7 +18,8 @@ __all__ = [
     "elementwise_mod", "elementwise_floordiv", "scale", "clip",
     "cross_entropy", "softmax_with_cross_entropy", "accuracy", "range",
     "increment", "equal", "less_than", "greater_than", "where", "cond",
-    "while_loop",
+    "while_loop", "create_array", "array_write", "array_read",
+    "array_length", "tensor_array_to_tensor", "StaticRNN",
 ]
 
 
@@ -450,12 +451,62 @@ def cond(pred, true_fn, false_fn, name=None):
     return outs[0] if len(outs) == 1 else outs
 
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def _detect_trip_bound(parent, blk, pre, lvs):
+    """Static trip bound for the canonical counting loop:
+    cond = less_than(i, fill_constant C), i initialised by fill_constant
+    v0, body increments i by a positive constant step. Any bound >= the
+    true trip count is safe (the scan lowering masks the tail)."""
+    def producer(block, name):
+        for op in reversed(block.ops):
+            if name in op.output_arg_names:
+                return op
+        return None
+
+    lt = producer(parent, pre.name)
+    if lt is None or lt.type != "less_than":
+        return 0
+    xn = lt.input("X")[0]
+    yp = producer(parent, lt.input("Y")[0])
+    xp = producer(parent, xn)
+    if yp is None or yp.type != "fill_constant" or \
+            xp is None or xp.type != "fill_constant":
+        return 0
+    incs = [op for op in blk.ops
+            if op.type == "increment" and xn in op.output_arg_names]
+    if len(incs) != 1:
+        return 0
+    # the LAST writer of the counter in the body must be that increment
+    # (or a self-assign of it): a body that returns a different value for
+    # the carry would make the increment's step a lie and the scan bound
+    # silently truncate the loop
+    last = producer(blk, xn)
+    if last is not incs[0] and not (
+            last is not None and last.type == "assign"
+            and last.input("X")[0] == xn):
+        return 0
+    step = float(incs[0].attrs.get("step", 1.0))
+    if step <= 0:
+        return 0
+    try:
+        hi = float(yp.attrs.get("value"))
+        lo = float(xp.attrs.get("value"))
+    except (TypeError, ValueError):
+        return 0
+    return max(int(-(-(hi - lo) // step)), 0)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               max_trip_count=None):
     """Functional while (reference layers/control_flow.py while_loop /
     While): `body` is traced once into a sub-block of a `while` op that
     lax.while_loop steps until `cond` is false. Loop vars must keep shape
     and dtype across iterations (the XLA carry contract); variables read
-    inside but defined outside are loop-invariant captures."""
+    inside but defined outside are loop-invariant captures.
+
+    Reverse-mode gradients require a static trip bound (XLA's while has
+    no vjp): the canonical `less_than(i, constant)` counting loop is
+    detected automatically and lowered to a masked lax.scan; any other
+    loop shape is differentiable only when `max_trip_count` is given."""
     helper = LayerHelper("while_loop", name=name)
     program = helper.main_program
     parent = program.current_block()
@@ -492,6 +543,9 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     for o, lv in zip(outs, lvs):
         o.shape = lv.shape
     cond_out = helper.create_variable_for_type_inference("bool", True)
+    mt = max_trip_count
+    if mt is None:
+        mt = _detect_trip_bound(parent, blk, pre, lvs)
     parent.append_op(
         type="while",
         inputs={"Condition": [pre], "X": [lv.name for lv in lvs],
@@ -499,5 +553,227 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
         outputs={"Out": [o.name for o in outs], "CondOut": [cond_out]},
         attrs={"sub_block": blk, "cond_name": pre.name,
                "carry_names": [lv.name for lv in lvs],
-               "capture_names": caps})
+               "capture_names": caps,
+               "max_trip_count": int(mt or 0)})
     return outs[0] if single else outs
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray layers (reference layers/control_flow.py array_write /
+# array_read / array_length / create_array + tensor.py
+# tensor_array_to_tensor)
+# ---------------------------------------------------------------------------
+
+def create_array(dtype="float32", max_size=0, name=None):
+    """New tensor array. `max_size` pre-sizes the buffer — REQUIRED when
+    writes happen inside while_loop (XLA carries cannot grow); writes at
+    build-time-constant indices grow automatically. An array carried
+    through while_loop must also receive one write BEFORE the loop (the
+    carry needs a materialized buffer — XLA's fixed-structure contract)."""
+    helper = LayerHelper("create_array", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="create_array", inputs={},
+                     outputs={"Out": [out]},
+                     attrs={"dtype": dtype, "max_size": max_size})
+    return out
+
+
+def _build_time_index(i):
+    """Resolve a build-time-constant index (a fill_constant output) so
+    the buffer can grow at trace time; None when genuinely dynamic.
+    Only the CURRENT block is searched: a var filled in a parent block
+    may be a loop carry whose runtime value diverges from its one
+    build-time producer (e.g. the while counter)."""
+    blk = default_main_program().current_block()
+    writes = [op for op in blk.ops if i.name in op.output_arg_names]
+    if len(writes) == 1 and writes[0].type == "fill_constant":
+        try:
+            return int(writes[0].attrs.get("value", 0))
+        except (TypeError, ValueError):
+            return None
+    return None
+
+
+def array_write(x, i, array=None, max_size=0):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype or "float32", max_size=max_size)
+    attrs = {"max_size": max_size}
+    si = _build_time_index(i)
+    if si is not None:
+        attrs["static_index"] = si
+    helper.append_op(type="write_to_array",
+                     inputs={"X": [x], "I": [i], "Array": [array]},
+                     outputs={"Out": [array]},
+                     attrs=attrs)
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(
+        array.dtype or "float32")
+    helper.append_op(type="read_from_array",
+                     inputs={"X": [array], "I": [i]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="lod_array_length", inputs={"X": [array]},
+                     outputs={"Out": [out]})
+    out.shape = (1,)
+    return out
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=True, name=None):
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype or "float32")
+    idx = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="array_to_tensor", inputs={"X": [input]},
+                     outputs={"Out": [out], "OutIndex": [idx]},
+                     attrs={"axis": axis, "use_stack": use_stack})
+    return out, idx
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (reference layers/control_flow.py StaticRNN over
+# operators/controlflow/recurrent_op.cc): user writes one timestep in a
+# `with rnn.step()` block; it lowers to ONE `recurrent` op executed as a
+# lax.scan — compile time O(1) in sequence length, autodiff through the
+# scan is the backward recurrent pass.
+# ---------------------------------------------------------------------------
+
+class StaticRNN:
+    def __init__(self, name=None):
+        self._helper = LayerHelper("static_rnn", name=name)
+        self._block = None
+        self._seq_inputs = []      # (outer var, step var)
+        self._memories = []        # (pre var, init var)
+        self._updates = {}         # pre name -> update var
+        self._outputs = []
+        self._done = False
+
+    class _Step:
+        def __init__(self, rnn):
+            self._rnn = rnn
+
+        def __enter__(self):
+            prog = self._rnn._helper.main_program
+            self._rnn._parent = prog.current_block()
+            self._rnn._block = prog._create_block()
+            return self._rnn
+
+        def __exit__(self, *exc):
+            prog = self._rnn._helper.main_program
+            prog._rollback()
+            if exc[0] is None:
+                self._rnn._complete()
+            return False
+
+    def step(self):
+        return StaticRNN._Step(self)
+
+    def step_input(self, x):
+        """x [T, ...] -> the current timestep's slice [...]."""
+        blk = self._block
+        sv = blk.create_var(
+            name=f"{x.name}@step", dtype=x.dtype,
+            shape=tuple(x.shape[1:]) if x.shape else None)
+        self._seq_inputs.append((x, sv))
+        return sv
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        if init is None:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            # the Init input must exist in the PARENT block (memory() is
+            # called inside the step sub-block, but the recurrent op
+            # consumes inits from outside the scan)
+            from .. import unique_name
+            parent = self._parent
+            init = parent.create_var(
+                name=unique_name.generate("static_rnn_mem_init"),
+                shape=tuple(shape), dtype="float32")
+            parent.append_op(
+                type="fill_constant", inputs={},
+                outputs={"Out": [init.name]},
+                attrs={"shape": list(shape), "dtype": "float32",
+                       "value": float(init_value)})
+        blk = self._block
+        # unique per memory: two memories may share one init var (LSTM
+        # h0/c0 from a single zeros tensor)
+        pre = blk.create_var(
+            name=f"{init.name}@pre_mem_{len(self._memories)}",
+            dtype=init.dtype, shape=init.shape)
+        self._memories.append((pre, init))
+        return pre
+
+    def update_memory(self, mem, var):
+        self._updates[mem.name] = var
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        if self._done:
+            return
+        self._done = True
+        blk = self._block
+        helper = self._helper
+        parent = helper.main_program.current_block()
+        pre_names = [p.name for p, _ in self._memories]
+        upd_names = []
+        for p, _ in self._memories:
+            if p.name not in self._updates:
+                raise ValueError(f"memory {p.name} never update_memory()d")
+            upd_names.append(self._updates[p.name].name)
+        seq_names = [sv.name for _, sv in self._seq_inputs]
+        known = set(seq_names) | set(pre_names)
+        caps, defined = [], set()
+        for op in blk.ops:
+            for n in op.input_arg_names:
+                if n not in defined and n not in known \
+                        and not blk.has_var(n) and n not in caps:
+                    caps.append(n)
+            defined.update(op.output_arg_names)
+        self._caps = caps
+        self._outs = []
+        T = self._seq_inputs[0][0].shape[0] if self._seq_inputs and \
+            self._seq_inputs[0][0].shape else -1
+        for o in self._outputs:
+            ov = helper.create_variable_for_type_inference(
+                o.dtype or "float32")
+            if o.shape is not None:
+                ov.shape = (T,) + tuple(o.shape)
+            self._outs.append(ov)
+        finals = [helper.create_variable_for_type_inference(
+            i.dtype or "float32") for _, i in self._memories]
+        parent.append_op(
+            type="recurrent",
+            inputs={"X": [x.name for x, _ in self._seq_inputs],
+                    "Init": [i.name for _, i in self._memories],
+                    "Captures": caps},
+            outputs={"Out": [o.name for o in self._outs],
+                     "FinalStates": [f.name for f in finals]},
+            attrs={"sub_block": blk,
+                   "seq_input_names": seq_names,
+                   "pre_mem_names": pre_names,
+                   "mem_update_names": upd_names,
+                   "step_output_names": [o.name for o in self._outputs],
+                   "capture_names": caps})
+
+    def __call__(self):
+        if not self._done:
+            raise RuntimeError("StaticRNN used before its step() block "
+                               "completed")
+        return self._outs[0] if len(self._outs) == 1 else self._outs

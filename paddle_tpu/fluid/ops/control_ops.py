@@ -126,6 +126,25 @@ def _while(ctx, ins, attrs):
                      if hasattr(o, "shape") else n_
                      for n_, o in zip(new, state))
 
+    mt = int(attrs.get("max_trip_count", 0) or 0)
+    if mt > 0:
+        # bounded loop -> masked lax.scan of exactly mt ticks: iterations
+        # past the cond are computed but discarded. This is the
+        # REVERSE-DIFFERENTIABLE lowering (lax.while_loop has no vjp);
+        # the bound comes from the canonical `less_than(i, const)` +
+        # `increment` pattern or an explicit while_loop(max_trip_count=).
+        def tick(state, _):
+            pred = state[0].reshape(()).astype(bool)
+            new = body_fn(state)
+            sel = tuple(
+                jax.tree_util.tree_map(
+                    lambda n_, o_: jnp.where(pred, n_, o_), n, o)
+                for n, o in zip(new, state))
+            return sel, None
+
+        final, _ = jax.lax.scan(tick, tuple(init), None, length=mt)
+        return {"Out": list(final[1:]), "CondOut": [final[0]]}
+
     final = jax.lax.while_loop(cond_fn, body_fn, tuple(init))
     return {"Out": list(final[1:]), "CondOut": [final[0]]}
 
@@ -146,3 +165,171 @@ def _py_func(ctx, ins, attrs):
     res = jax.pure_callback(
         fn, [jax.ShapeDtypeStruct(tuple(s), d) for s, d in result_shapes], *xs)
     return {"Out": list(res)}
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray tier (reference operators/controlflow/
+# lod_tensor_array ops + recurrent_op.cc). TPU design: an array is a
+# fixed-capacity stacked dense buffer + a length scalar, registered as a
+# jax pytree so it rides through while-loop carries and autodiff; writes
+# are dynamic_update_slice (growing at trace time only while the index is
+# still concrete — inside lax loops the capacity is fixed, the XLA carry
+# contract).
+# ---------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+class TensorArray:
+    """(buffer [CAP, ...] | None, length int32). Functional: every write
+    returns a new TensorArray. `static_len` mirrors `length` while every
+    write index has been build-time-constant (None once a traced index
+    is written) — it lets array_to_tensor produce a static shape."""
+
+    def __init__(self, buffer, length, static_len=0):
+        self.buffer = buffer
+        self.length = length
+        self.static_len = static_len
+
+    def tree_flatten(self):
+        # static_len is deliberately NOT part of the pytree (neither leaf
+        # nor aux): aux must match exactly across while-loop carries, and
+        # a traced leaf could never be read statically. It survives only
+        # while the object flows through the op env unflattened — exactly
+        # the build-time-constant regime it describes.
+        if self.buffer is None:
+            return (self.length,), False
+        return (self.buffer, self.length), True
+
+    @classmethod
+    def tree_unflatten(cls, has_buf, leaves):
+        if has_buf:
+            return cls(leaves[0], leaves[1], None)
+        return cls(None, leaves[0], None)
+
+    def __repr__(self):
+        shp = None if self.buffer is None else self.buffer.shape
+        return f"TensorArray(cap={shp}, len={self.length})"
+
+
+def _concrete_int(v):
+    try:
+        return int(v)
+    except Exception:
+        return None
+
+
+@register("create_array", grad=None, attrs={"dtype": "float32",
+                                            "max_size": 0})
+def _create_array(ctx, ins, attrs):
+    return {"Out": [TensorArray(None, jnp.zeros((), jnp.int32))]}
+
+
+@register("write_to_array", no_grad_slots=("I",),
+          attrs={"max_size": 0, "static_index": None})
+def _write_to_array(ctx, ins, attrs):
+    v, i = x(ins, "X"), x(ins, "I")
+    arr = x(ins, "Array") or TensorArray(None, jnp.zeros((), jnp.int32))
+    iv = jnp.asarray(i).reshape(()).astype(jnp.int32)
+    ci = _concrete_int(iv)
+    if ci is None:
+        # the layer resolved a build-time fill_constant index (the whole
+        # block is traced, so even constants arrive as tracers here)
+        ci = attrs.get("static_index")
+    buf = arr.buffer
+    if buf is None:
+        cap = int(attrs.get("max_size") or 0)
+        if not cap:
+            if ci is None:
+                raise ValueError(
+                    "write_to_array with a traced index needs a "
+                    "pre-sized array: create_array(..., max_size=N) "
+                    "(XLA buffers cannot grow inside compiled loops)")
+            cap = max(ci + 1, 8)
+        buf = jnp.zeros((cap,) + tuple(jnp.shape(v)), jnp.asarray(v).dtype)
+    elif ci is not None and ci >= buf.shape[0]:
+        grow = jnp.zeros((max(ci + 1, 2 * buf.shape[0]),) + buf.shape[1:],
+                         buf.dtype)
+        buf = grow.at[:buf.shape[0]].set(buf)
+    cap = buf.shape[0]
+    if ci is not None and ci >= cap:
+        raise ValueError(f"write_to_array index {ci} >= capacity {cap}")
+    buf2 = jax.lax.dynamic_update_index_in_dim(buf, jnp.asarray(v), iv, 0)
+    if jnp.issubdtype(buf.dtype, jnp.floating):
+        # a traced index past capacity would otherwise be silently
+        # CLAMPED by dynamic_update_slice (XLA semantics) and corrupt the
+        # last slot; poisoning the whole buffer with NaN turns that into
+        # an unmissable failure (reference LoDTensorArray raises)
+        buf2 = jnp.where(iv < cap, buf2, jnp.full_like(buf2, jnp.nan))
+    buf = buf2
+    length = jnp.maximum(arr.length, iv + 1)
+    sl = None if (ci is None or arr.static_len is None) \
+        else max(arr.static_len, ci + 1)
+    return {"Out": [TensorArray(buf, length, sl)]}
+
+
+@register("read_from_array", no_grad_slots=("I",))
+def _read_from_array(ctx, ins, attrs):
+    arr, i = x(ins, "X"), x(ins, "I")
+    if arr is None or arr.buffer is None:
+        raise ValueError("read_from_array on an empty TensorArray")
+    iv = jnp.asarray(i).reshape(()).astype(jnp.int32)
+    return {"Out": [jax.lax.dynamic_index_in_dim(arr.buffer, iv, 0,
+                                                 keepdims=False)]}
+
+
+@register("lod_array_length", grad=None)
+def _lod_array_length(ctx, ins, attrs):
+    arr = x(ins, "X")
+    ln = jnp.zeros((), jnp.int32) if arr is None else arr.length
+    return {"Out": [jnp.asarray(ln).reshape((1,)).astype(jnp.int64)]}
+
+
+@register("array_to_tensor", attrs={"axis": 0, "use_stack": True},
+          no_grad_out_slots=("OutIndex",))
+def _array_to_tensor(ctx, ins, attrs):
+    """Stack the written prefix ([length, ...]); length must be concrete
+    at trace time (static shapes) — inside loops keep the TensorArray."""
+    arr = x(ins, "X")
+    ln = _concrete_int(arr.length)
+    if ln is None:
+        ln = arr.static_len
+    if not ln:
+        raise ValueError(
+            "array_to_tensor needs a static length: either all writes at "
+            "build-time-constant indices, or slice the buffer explicitly "
+            "after the loop (XLA shapes are static)")
+    buf = arr.buffer[:ln]
+    if not attrs.get("use_stack", True):
+        buf = jnp.concatenate(list(buf), axis=attrs.get("axis", 0))
+    return {"Out": [buf], "OutIndex": [jnp.full((ln,), 1, jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# recurrent: StaticRNN's op (reference operators/controlflow/
+# recurrent_op.cc) — one lax.scan over the step sub-block.
+# ---------------------------------------------------------------------------
+
+@register("recurrent")
+def _recurrent(ctx, ins, attrs):
+    from ..framework import Block
+    block: Block = attrs["sub_block"]
+    seq_names = attrs["seq_input_names"]
+    pre_names = attrs["pre_mem_names"]
+    upd_names = attrs["mem_update_names"]
+    out_names = attrs["step_output_names"]
+    cap_names = attrs.get("capture_names", [])
+    seqs = list(ins.get("X", []))
+    inits = list(ins.get("Init", []))
+    caps = list(ins.get("Captures", []))
+
+    def body(carry, xs):
+        env = dict(zip(cap_names, caps))
+        env.update(zip(seq_names, xs))
+        env.update(zip(pre_names, carry))
+        ctx.exec_block(block, env)
+        new_carry = tuple(
+            jnp.asarray(env[n]).astype(o.dtype).reshape(o.shape)
+            for n, o in zip(upd_names, carry))
+        return new_carry, tuple(env[n] for n in out_names)
+
+    carry, ys = jax.lax.scan(body, tuple(inits), tuple(seqs))
+    return {"Out": list(ys), "FinalStates": list(carry)}

@@ -236,6 +236,14 @@ def _sum(ctx, ins, attrs):
                 jnp.concatenate([s.values for s in srs]), srs[0].height))
         vals = [v.to_dense() if isinstance(v, SelectedRows) else v
                 for v in vals]
+    from .control_ops import TensorArray
+    if isinstance(vals[0], TensorArray):
+        # TensorArray cotangent fan-in (e.g. two array_reads of one
+        # array): add the buffers; length is carried, not summed
+        buf = vals[0].buffer
+        for v in vals[1:]:
+            buf = buf + v.buffer
+        return out(TensorArray(buf, vals[0].length, vals[0].static_len))
     r = vals[0]
     for v in vals[1:]:
         r = r + v

@@ -35,22 +35,28 @@ def _client(endpoints):
 
 @register("send", grad=None,
           no_grad_slots=("X", "LearningRate"),
-          attrs={"table_name": "", "endpoints": [], "is_sparse": False})
+          attrs={"table_name": "", "endpoints": [], "is_sparse": False,
+                 "sync_mode": False, "trainers": 1})
 def _send(ctx, ins, attrs):
-    """Push a (dense or sparse-rows) gradient to the PS, which applies
-    -lr * grad on arrival."""
+    """Push a (dense or sparse-rows) gradient to the PS. Async mode: the
+    server applies -lr * grad on arrival. Sync mode: the server only
+    buffers it — the round is applied (mean over trainers) when the last
+    trainer passes `send_barrier` (reference RunSyncLoop)."""
     g = x(ins, "X")
     lr = x(ins, "LearningRate")
     lr = jnp.ones((), jnp.float32) if lr is None else lr.reshape(())
     endpoints = tuple(attrs["endpoints"])
     table = attrs["table_name"]
+    sync = bool(attrs.get("sync_mode", False))
+    trainers = int(attrs.get("trainers", 1))
 
     def do_push(gv, lrv):
         gv = np.asarray(gv)
         rows = gv.reshape(gv.shape[0], -1)
         _client(endpoints).push(table, rows.shape[1],
                                 np.arange(rows.shape[0], dtype=np.int64),
-                                rows, float(lrv))
+                                rows, float(lrv), sync=sync,
+                                trainers=trainers)
         return np.zeros((1,), np.float32)
 
     from jax.experimental import io_callback
@@ -58,6 +64,77 @@ def _send(ctx, ins, attrs):
                        jax.ShapeDtypeStruct((1,), jnp.float32),
                        g, lr, ordered=True)
     return {"Out": [done]}
+
+
+def _barrier_op(kind):
+    def impl(ctx, ins, attrs):
+        endpoints = tuple(attrs["endpoints"])
+        worker = int(attrs.get("trainer_id", 0))
+        trainers = int(attrs.get("trainers", 1))
+
+        def do(_):
+            getattr(_client(endpoints), kind)(worker, trainers)
+            return np.zeros((1,), np.float32)
+
+        from jax.experimental import io_callback
+        done = io_callback(do, jax.ShapeDtypeStruct((1,), jnp.float32),
+                           np.zeros((1,), np.float32), ordered=True)
+        return {"Out": [done]}
+    return impl
+
+
+register("send_barrier", _barrier_op("send_barrier"), grad=None,
+         attrs={"endpoints": [], "trainer_id": 0, "trainers": 1})
+register("fetch_barrier", _barrier_op("fetch_barrier"), grad=None,
+         attrs={"endpoints": [], "trainer_id": 0, "trainers": 1})
+
+
+_geo_state: dict = {}
+
+
+@register("geo_send", grad=None, no_grad_slots=("X",),
+          attrs={"table_name": "", "endpoints": [], "k_steps": 100,
+                 "shape": [], "trainer_id": 0})
+def _geo_send(ctx, ins, attrs):
+    """GEO-SGD (reference GeoCommunicator, operators/distributed/
+    communicator.h:396): the trainer optimizes LOCALLY; every k_steps it
+    pushes the accumulated delta (local - last_synced) to the server
+    (which adds it) and adopts the merged global value. On the very first
+    call the trainer adopts the server-side value so all trainers start
+    from one consistent model (same contract as async recv-overwrites-
+    init)."""
+    p = x(ins, "X")
+    endpoints = tuple(attrs["endpoints"])
+    table = attrs["table_name"]
+    k = max(int(attrs.get("k_steps", 100)), 1)
+    shape = tuple(attrs["shape"])
+    skey = (endpoints, table, int(attrs.get("trainer_id", 0)))
+
+    def do(pv):
+        pv = np.asarray(pv, np.float32)
+        rows = pv.reshape(pv.shape[0], -1)
+        dim = rows.shape[1]
+        cl = _client(endpoints)
+        idx = np.arange(rows.shape[0], dtype=np.int64)
+        st = _geo_state.get(skey)
+        if st is None:
+            fresh = cl.pull(table, dim, idx).reshape(pv.shape)
+            _geo_state[skey] = {"n": 0, "old": fresh.copy()}
+            return fresh
+        st["n"] += 1
+        if st["n"] % k:
+            return pv
+        delta = rows - st["old"].reshape(rows.shape)
+        # server applies -lr*grad; lr=-1 turns the push into "+= delta"
+        cl.push(table, dim, idx, delta, lr=-1.0)
+        fresh = cl.pull(table, dim, idx).reshape(pv.shape)
+        st["old"] = fresh.copy()
+        return fresh
+
+    from jax.experimental import io_callback
+    val = io_callback(do, jax.ShapeDtypeStruct(shape, jnp.float32),
+                      p, ordered=True)
+    return {"Out": [val]}
 
 
 @register("recv", grad=None, attrs={"table_name": "", "endpoints": [],

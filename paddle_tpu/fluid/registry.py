@@ -144,14 +144,27 @@ def _make_auto_grad_opdef(fwd: OpDef) -> OpDef:
         out_grads = {k[: -len(GRAD_SUFFIX)]: v
                      for k, v in ins.items() if k.endswith(GRAD_SUFFIX)}
 
-        # differentiable leaf selection: float arrays in non-excluded slots
+        # differentiable leaf selection: float arrays in non-excluded
+        # slots; registered pytree containers (TensorArray) count when
+        # they hold float leaves
+        def _diffable(v):
+            if v is None:
+                return False
+            try:
+                return core.is_float_dtype(jnp.result_type(v))
+            except TypeError:
+                pass
+            leaves = jax.tree_util.tree_leaves(v)
+            return any(core.is_float_dtype(jnp.result_type(l))
+                       for l in leaves)
+
         diff_keys: list[tuple[str, int]] = []
         primals: list = []
         for slot, vals in fwd_ins.items():
             if slot in fwd.no_grad_slots:
                 continue
             for i, v in enumerate(vals):
-                if v is not None and core.is_float_dtype(jnp.result_type(v)):
+                if _diffable(v):
                     diff_keys.append((slot, i))
                     primals.append(v)
 
@@ -172,6 +185,20 @@ def _make_auto_grad_opdef(fwd: OpDef) -> OpDef:
                     flat.append(o)
             return tuple(flat)
 
+        def _zero_ct(o):
+            # cotangent zeros for an arbitrary output: float leaves get
+            # float zeros, integer leaves float0 (the vjp contract for
+            # non-differentiable leaves — hit by pytree outputs like
+            # TensorArray, whose length is int32)
+            import numpy as _np
+
+            def z(l):
+                dt = jnp.result_type(l)
+                if core.is_float_dtype(dt):
+                    return jnp.zeros(jnp.shape(l), dt)
+                return _np.zeros(jnp.shape(l), jax.dtypes.float0)
+            return jax.tree_util.tree_map(z, o)
+
         flat_out, vjp_fn = jax.vjp(f, *primals)
         cts = []
         for (slot, i), o in zip(out_slots, flat_out):
@@ -179,7 +206,10 @@ def _make_auto_grad_opdef(fwd: OpDef) -> OpDef:
             gv = g[i] if g is not None and i < len(g) and g[i] is not None \
                 else None
             if gv is None:
-                gv = jnp.zeros_like(o)
+                try:
+                    gv = jnp.zeros_like(o)
+                except TypeError:
+                    gv = _zero_ct(o)
             cts.append(jnp.asarray(gv, o.dtype) if hasattr(o, "dtype") else gv)
         in_grads = vjp_fn(tuple(cts))
 

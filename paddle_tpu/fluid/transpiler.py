@@ -13,9 +13,20 @@ local init, so every trainer sees one consistent model without a
 broadcast. Sharding across multiple pservers is row-hash routing inside
 PSClient (one table per param, rows 0..m-1).
 
-Sync mode (send_barrier/fetch_barrier rounds) is not implemented — the
-mesh-collective data-parallel path covers synchronous training natively;
-transpiler mode exists for the sparse/async regime.
+Sync mode (reference distribute_transpiler.py:545,813 send_barrier/
+fetch_barrier rounds + RunSyncLoop): sends only BUFFER on the server;
+a `send_barrier` op blocks until every trainer pushed, the last arrival
+applies the round as the mean over trainers, recvs pull the fresh
+values, and a `fetch_barrier` holds the next round until everyone
+pulled — one synchronous optimization step per round, equal to the
+single-process full-batch step.
+
+GEO-SGD mode (reference GeoSgdTranspiler + GeoCommunicator,
+communicator.h:396): the trainer KEEPS its local optimizer ops and a
+`geo_send` op per parameter pushes the accumulated local delta every
+`geo_sgd_need_push_nums` steps, adopting the merged global value —
+a distinct convergence behavior (local steps + periodic averaging),
+not a transport detail.
 """
 from __future__ import annotations
 
@@ -40,6 +51,9 @@ class DistributeTranspilerConfig:
         self.sync_mode = False
         self.runtime_split_send_recv = False
         self.mode = "pserver"
+        # GEO-SGD (reference GeoSgdTranspiler config)
+        self.geo_sgd_mode = False
+        self.geo_sgd_need_push_nums = 100
 
 
 class DistributeTranspiler:
@@ -52,19 +66,41 @@ class DistributeTranspiler:
     def transpile(self, trainer_id, program=None, pservers="",
                   trainers=1, sync_mode=False, startup_program=None,
                   current_endpoint=""):
-        if sync_mode or self.config.sync_mode:
-            raise NotImplementedError(
-                "sync PS rounds: use the mesh-collective DP path; the "
-                "transpiler implements the async regime")
         program = program or framework.default_main_program()
         self._origin_program = program
         self._pservers = [e for e in pservers.split(",") if e]
         self.trainer_id = trainer_id
         self.trainer_num = trainers
+        self.sync_mode = bool(sync_mode or self.config.sync_mode)
 
+        from .framework import Operator
         t = program.clone()
         gb = t.global_block()
+
+        if self.config.geo_sgd_mode:
+            # GEO: keep local optimizer ops; append a geo_send per param
+            params = []
+            for op in gb.ops:
+                if op.type in _OPT_OPS:
+                    params.append(op.input("Param")[0])
+            for param_name in dict.fromkeys(params):
+                pvar = gb._var_recursive(param_name)
+                shape = list(pvar.shape) if pvar is not None and \
+                    pvar.shape else []
+                gb.ops.append(Operator(
+                    gb, "geo_send", inputs={"X": [param_name]},
+                    outputs={"Out": [param_name]},
+                    attrs={"table_name": param_name,
+                           "endpoints": self._pservers,
+                           "k_steps": self.config.geo_sgd_need_push_nums,
+                           "shape": shape,
+                           "trainer_id": trainer_id}))
+            t._bump_version()
+            self._trainer_program = t
+            return self
+
         new_ops = []
+        recvs = []
         for op in gb.ops:
             if op.type not in _OPT_OPS:
                 new_ops.append(op)
@@ -75,7 +111,6 @@ class DistributeTranspiler:
             pvar = gb._var_recursive(param_name)
             shape = list(pvar.shape) if pvar is not None and pvar.shape \
                 else []
-            from .framework import Operator
             send_out = gb.create_var(
                 name=f"{param_name}.send_done", persistable=False)
             ins = {"X": [grad_name]}
@@ -84,11 +119,35 @@ class DistributeTranspiler:
             new_ops.append(Operator(
                 gb, "send", inputs=ins, outputs={"Out": [send_out.name]},
                 attrs={"table_name": param_name,
-                       "endpoints": self._pservers}))
-            new_ops.append(Operator(
+                       "endpoints": self._pservers,
+                       "sync_mode": self.sync_mode,
+                       "trainers": trainers}))
+            recvs.append(Operator(
                 gb, "recv", inputs={}, outputs={"Out": [param_name]},
                 attrs={"table_name": param_name,
                        "endpoints": self._pservers, "shape": shape}))
+        if self.sync_mode:
+            # reference distribute_transpiler.py:545,813: one
+            # send_barrier after all sends, recvs, then a fetch_barrier
+            def _marker(kind):
+                v = gb.create_var(name=f"{kind}.done", persistable=False)
+                return Operator(
+                    gb, kind, inputs={}, outputs={"Out": [v.name]},
+                    attrs={"endpoints": self._pservers,
+                           "trainer_id": trainer_id,
+                           "trainers": trainers})
+            new_ops.append(_marker("send_barrier"))
+            new_ops.extend(recvs)
+            new_ops.append(_marker("fetch_barrier"))
+        else:
+            # async: recv immediately after each send (apply-on-arrival)
+            merged = []
+            ri = iter(recvs)
+            for op in new_ops:
+                merged.append(op)
+                if op.type == "send":
+                    merged.append(next(ri))
+            new_ops = merged
         gb.ops[:] = new_ops
         t._bump_version()
         self._trainer_program = t
@@ -107,7 +166,8 @@ class DistributeTranspiler:
         gb.ops.append(Operator(
             gb, "listen_and_serv", inputs={},
             outputs={"Out": [dummy.name]},
-            attrs={"endpoint": endpoint, "sync_mode": False}))
+            attrs={"endpoint": endpoint,
+                   "sync_mode": getattr(self, "sync_mode", False)}))
         p._bump_version()
         return p
 

@@ -1,7 +1,9 @@
 """paddle.slim — model compression (reference
 python/paddle/fluid/contrib/slim/)."""
+from .qat import ImperativeQuantAware, QuantizationTransformPass
 from .quantization import (PostTrainingQuantization, load_quantized_weights,
                            quant_dequant, QUANTIZABLE_OP_TYPES)
 
-__all__ = ["PostTrainingQuantization", "load_quantized_weights",
+__all__ = ["ImperativeQuantAware", "QuantizationTransformPass",
+           "PostTrainingQuantization", "load_quantized_weights",
            "quant_dequant", "QUANTIZABLE_OP_TYPES"]

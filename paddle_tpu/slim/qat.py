@@ -1,0 +1,155 @@
+"""Quantization-aware training (reference contrib/slim/quantization/
+quantization_pass.py:1 QuantizationTransformPass +
+imperative/qat.py ImperativeQuantAware).
+
+QAT simulates int8 rounding DURING training so the model learns weights
+robust to quantization: fake_quantize_dequantize ops (already in the op
+registry, with straight-through-estimator gradients —
+fluid/ops/nn_ops.py) are inserted on the weight and activation inputs of
+every quantizable op. On TPU the training math stays float (XLA has no
+public int8 matmul path; SURVEY §7) — the value is the same as the
+reference's: the exported int8 weights have been trained under rounding,
+so post-export accuracy matches the QAT accuracy.
+
+Static flow (apply the pass BEFORE optimizer.minimize so autodiff builds
+the STE backward through the fake-quant ops):
+
+    pass_ = QuantizationTransformPass()
+    pass_.apply(main_program)
+    optimizer.SGD(...).minimize(loss)
+
+Dygraph flow (reference ImperativeQuantAware.quantize):
+
+    qat = ImperativeQuantAware()
+    qat.quantize(model)          # wraps Conv2D/Linear forwards in place
+    ... train ...
+    qat.save_quantized_model(model, path)   # int8 weight export (PTQ
+                                            # shared path)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .quantization import (QUANTIZABLE_OP_TYPES, _W_SLOT, _X_SLOT,
+                           _channel_scales, quant_dequant)
+
+__all__ = ["QuantizationTransformPass", "ImperativeQuantAware"]
+
+_FQ_OP = "fake_quantize_dequantize_abs_max"
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant on weights + activations of quantizable ops in a
+    (forward) Program. Apply before building backward; the registered
+    STE gradient then trains through the rounding."""
+
+    def __init__(self, scope=None, place=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 skip_pattern=("skip_quant",),
+                 quantizable_op_type=QUANTIZABLE_OP_TYPES):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._types = tuple(quantizable_op_type)
+        self._skip = tuple(skip_pattern)
+
+    def apply(self, program):
+        from ..fluid.framework import Operator
+        n = 0
+        for block in program.blocks:
+            quanted: dict[tuple[str, int], str] = {}
+            new_ops = []
+            for op in block.ops:
+                if op.type in self._types and not any(
+                        s in op.attrs.get("name_scope", "")
+                        for s in self._skip):
+                    for slot, bits in ((_X_SLOT[op.type], self._abits),
+                                       (_W_SLOT[op.type], self._wbits)):
+                        names = op.input(slot)
+                        if not names:
+                            continue
+                        vn = names[0]
+                        key = (vn, bits)
+                        if key not in quanted:
+                            qn = f"{vn}.quant_dequant"
+                            src = block._var_recursive(vn)
+                            block.create_var(
+                                name=qn,
+                                shape=getattr(src, "shape", None),
+                                dtype=getattr(src, "dtype", "float32"))
+                            sn = f"{vn}.quant_dequant@scale"
+                            block.create_var(name=sn, shape=(1,),
+                                             dtype="float32")
+                            new_ops.append(Operator(
+                                block, _FQ_OP, inputs={"X": [vn]},
+                                outputs={"Out": [qn], "OutScale": [sn]},
+                                attrs={"bit_length": bits}))
+                            quanted[key] = qn
+                            n += 1
+                        op.inputs[slot] = [quanted[key]]
+                new_ops.append(op)
+            block.ops[:] = new_ops
+        program._bump_version()
+        return n
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT (reference imperative/qat.py): wraps each quantizable
+    sublayer's forward so weights and inputs pass through fake-quant
+    (with STE gradients) before the real compute."""
+
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 quantizable_layer_type=("Conv2D", "Linear")):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._types = tuple(quantizable_layer_type)
+        self._wrapped: list = []
+
+    def _fq(self, t, bits):
+        from ..common_ops import run_op
+        return run_op(_FQ_OP, {"X": t}, {"bit_length": bits})
+
+    def quantize(self, model):
+        from .. import nn
+        import paddle_tpu.nn.functional as F
+        for _, layer in model.named_sublayers():
+            kind = type(layer).__name__
+            if kind not in self._types or getattr(layer, "_qat_wrapped",
+                                                  False):
+                continue
+            if kind == "Linear":
+                def fwd(x, _l=layer):
+                    return F.linear(self._fq(x, self._abits),
+                                    self._fq(_l.weight, self._wbits),
+                                    _l.bias)
+            else:  # Conv2D
+                def fwd(x, _l=layer):
+                    return F.conv2d(
+                        self._fq(x, self._abits),
+                        self._fq(_l.weight, self._wbits), _l.bias,
+                        _l._stride, _l._padding, _l._dilation, _l._groups,
+                        _l._data_format)
+            layer.forward = fwd
+            layer._qat_wrapped = True
+            self._wrapped.append(layer)
+        return model
+
+    def save_quantized_model(self, model, path: str, input_spec=None):
+        """Export int8 weights of the wrapped layers (shared PTQ int8
+        format: {path}.int8.npz with per-channel scales) plus the full
+        fp32 state_dict for everything else."""
+        blobs = {}
+        for i, layer in enumerate(self._wrapped):
+            w = np.asarray(layer.weight._value)
+            axis = 0  # Conv2D OIHW out-channels / Linear rows
+            scales = _channel_scales(w, axis)
+            qmax = 2 ** (self._wbits - 1) - 1
+            sh = scales.reshape((-1,) + (1,) * (w.ndim - 1))
+            q = np.clip(np.round(w / np.maximum(sh, 1e-8) * qmax),
+                        -qmax, qmax).astype(np.int8)
+            blobs[f"w{i}.int8"] = q
+            blobs[f"w{i}.scale"] = scales.astype(np.float32)
+        np.savez(path + ".int8.npz", **blobs)
+        state = {k: np.asarray(getattr(v, "_value", v))
+                 for k, v in model.state_dict().items()}
+        np.savez(path + ".state.npz", **state)
+        return path

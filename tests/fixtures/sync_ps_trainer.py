@@ -1,0 +1,72 @@
+"""Subprocess trainer for the sync-PS parity test (reference multi-trainer
+RunSyncLoop round semantics). Driven by env vars:
+  PS_ENDPOINT, TRAINER_ID, TRAINERS, ROUNDS
+Feeds shard `trainer_id::trainers` of a deterministic full batch and
+prints one JSON line: {"losses": [...], "param": [...]}.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.fluid import (DistributeTranspiler, Executor, framework,
+                              layers, optimizer, unique_name)  # noqa: E402
+from paddle_tpu.fluid.scope import Scope, scope_guard  # noqa: E402
+
+
+def main():
+    ep = os.environ["PS_ENDPOINT"]
+    tid = int(os.environ["TRAINER_ID"])
+    trainers = int(os.environ["TRAINERS"])
+    rounds = int(os.environ.get("ROUNDS", "6"))
+
+    paddle.enable_static()
+    with unique_name.guard():
+        main_p, startup = framework.Program(), framework.Program()
+        main_p.random_seed = startup.random_seed = 3
+        with framework.program_guard(main_p, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=tid, program=main_p, pservers=ep,
+                trainers=trainers, sync_mode=True)
+    trainer = t.get_trainer_program()
+    param_name = [op.attrs["table_name"]
+                  for op in trainer.global_block().ops
+                  if op.type == "send"][0]
+
+    rng = np.random.RandomState(42)
+    w_true = rng.randn(4, 1).astype("float32")
+    xb_full = rng.randn(32, 4).astype("float32")
+    yb_full = xb_full @ w_true
+    xb, yb = xb_full[tid::trainers], yb_full[tid::trainers]
+
+    losses = []
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(rounds):
+            lv, = exe.run(trainer, feed={"x": xb, "y": yb},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+        from paddle_tpu.fluid.scope import global_scope
+        pv = global_scope().numpy(param_name)
+    print(json.dumps({"losses": losses, "param": pv.ravel().tolist()}))
+
+
+if __name__ == "__main__":
+    main()

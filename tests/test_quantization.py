@@ -156,3 +156,103 @@ def test_ptq_requires_calibration_data(tmp_path):
             ptq.quantize()
     finally:
         paddle.disable_static()
+
+# ---------------------------------------------------------------------------
+# QAT (reference quantization_pass.py QuantizationTransformPass +
+# imperative/qat.py ImperativeQuantAware)
+# ---------------------------------------------------------------------------
+
+def _class_batches(rng, n=64):
+    lab = rng.randint(0, 4, (n, 1))
+    img = rng.randn(n, 1, 16, 16).astype("float32") * 0.1
+    for i, l in enumerate(lab[:, 0]):
+        img[i, 0, (l // 2) * 8:(l // 2) * 8 + 8,
+            (l % 2) * 8:(l % 2) * 8 + 8] += 1.0
+    return img, lab.astype("int64")
+
+
+def _train_small_convnet(qat):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    import paddle_tpu.nn.functional as F
+    np.random.seed(0)
+    model = nn.Sequential(
+        nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(),
+        nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(8 * 8 * 8, 4))
+    if qat is not None:
+        qat.quantize(model)
+        # wrapped layer forwards insert fake-quant ops
+        assert getattr(model[0], "_qat_wrapped", False)
+        assert getattr(model[4], "_qat_wrapped", False)
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(7)
+    for _ in range(40):
+        img, lab = _class_batches(rng)
+        loss = F.cross_entropy(model(paddle.to_tensor(img)),
+                               paddle.to_tensor(lab))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    img, lab = _class_batches(np.random.RandomState(123), 128)
+    pred = np.argmax(model(paddle.to_tensor(img)).numpy(), axis=1)
+    return model, (pred == lab[:, 0]).mean()
+
+
+def test_imperative_qat_trains_close_to_fp32(tmp_path):
+    from paddle_tpu.slim import ImperativeQuantAware
+    _, acc_fp32 = _train_small_convnet(None)
+    qat = ImperativeQuantAware()
+    model, acc_qat = _train_small_convnet(qat)
+    # done-bar from the reference QAT examples: within 1% of fp32
+    assert acc_fp32 > 0.95, acc_fp32
+    assert acc_qat >= acc_fp32 - 0.01, (acc_fp32, acc_qat)
+    # int8 export round-trips
+    path = str(tmp_path / "qat_model")
+    qat.save_quantized_model(model, path)
+    blob = np.load(path + ".int8.npz")
+    assert blob["w0.int8"].dtype == np.int8
+    w0 = np.asarray(model[0].weight._value)
+    deq = blob["w0.int8"].astype(np.float32) * \
+        blob["w0.scale"].reshape(-1, 1, 1, 1) / 127.0
+    assert np.abs(deq - w0).max() <= blob["w0.scale"].max() / 127.0 + 1e-6
+
+
+def test_static_quantization_transform_pass(fresh_programs):
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid import (Executor, framework, layers, optimizer,
+                                  unique_name)
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    from paddle_tpu.slim import QuantizationTransformPass
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 11
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 8], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            h = layers.fc(x, 16, act="relu")
+            pred = layers.fc(h, 1)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            n = QuantizationTransformPass().apply(main)
+            assert n >= 4   # two fc ops x (activation + weight)
+            types = [op.type for op in main.global_block().ops]
+            assert "fake_quantize_dequantize_abs_max" in types
+            optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8, 1).astype("float32")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            xb = rng.randn(64, 8).astype("float32")
+            lv, = exe.run(main, feed={"x": xb, "y": xb @ w_true},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    # STE gradients train through the rounding
+    assert losses[-1] < losses[2] * 0.3, (losses[2], losses[-1])
+    paddle.disable_static()

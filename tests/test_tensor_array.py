@@ -1,0 +1,193 @@
+"""LoDTensorArray tier + StaticRNN (reference
+operators/controlflow/recurrent_op.cc:1, layers/control_flow.py StaticRNN,
+lod_tensor_array ops). TPU design: fixed-capacity stacked buffers as jax
+pytrees; StaticRNN lowers to one lax.scan."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import (Executor, framework, layers, optimizer,
+                              unique_name)
+from paddle_tpu.fluid.scope import Scope, scope_guard
+
+
+def _static(fn):
+    paddle.enable_static()
+    try:
+        with unique_name.guard():
+            main, startup = framework.Program(), framework.Program()
+            main.random_seed = startup.random_seed = 7
+            with framework.program_guard(main, startup):
+                fetches = fn(main, startup)
+        return main, startup, fetches
+    finally:
+        paddle.disable_static()
+
+
+def test_array_write_read_length():
+    def build(main, startup):
+        x = layers.data("x", [3, 4], "float32")
+        arr = layers.create_array("float32")
+        i0 = layers.fill_constant([1], "int64", 0)
+        i1 = layers.fill_constant([1], "int64", 1)
+        arr = layers.array_write(x, i0, array=arr)
+        arr = layers.array_write(layers.scale(x, 2.0), i1, array=arr)
+        ln = layers.array_length(arr)
+        r0 = layers.array_read(arr, i0)
+        r1 = layers.array_read(arr, i1)
+        stacked, _ = layers.tensor_array_to_tensor(arr)
+        return [ln, r0, r1, stacked]
+
+    main, startup, fetches = _static(build)
+    xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        ln, r0, r1, st = exe.run(main, feed={"x": xv},
+                                 fetch_list=fetches)
+    assert int(np.ravel(ln)[0]) == 2
+    np.testing.assert_allclose(r0, xv, rtol=1e-6)
+    np.testing.assert_allclose(r1, 2 * xv, rtol=1e-6)
+    assert st.shape == (2, 3, 4)
+    np.testing.assert_allclose(st[1], 2 * xv, rtol=1e-6)
+
+
+def test_array_write_inside_while_loop_with_grad():
+    """Dynamic decode-style loop: write x*w^t into a pre-sized array each
+    iteration; gradients flow back through the while into w."""
+    def build(main, startup):
+        x = layers.data("x", [2, 3], "float32", stop_gradient=False)
+        w = layers.create_parameter([1], "float32",
+                                    default_initializer=None)
+        i0 = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 5)
+        acc = layers.scale(x, 1.0)
+        # the XLA while carry needs a materialized buffer: seed index 0
+        # before the loop (create_array max_size pre-sizes the capacity)
+        arr = layers.create_array("float32", max_size=8)
+        arr = layers.array_write(acc, i0, array=arr, max_size=8)
+        i = layers.fill_constant([1], "int64", 1)
+
+        def cond(i, acc, arr):
+            return layers.less_than(i, n)
+
+        def body(i, acc, arr):
+            acc2 = layers.elementwise_mul(
+                acc, layers.expand(layers.reshape(w, [1, 1]), [2, 3]))
+            arr2 = layers.array_write(acc2, i, array=arr)
+            return layers.increment(i), acc2, arr2
+
+        i, acc, arr = layers.while_loop(cond, body, [i, acc, arr])
+        last = layers.array_read(arr, layers.fill_constant([1], "int64",
+                                                           4))
+        loss = layers.mean(last)
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return [loss, w]
+
+    main, startup, fetches = _static(build)
+    xv = np.ones((2, 3), "float32")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        w_before = None
+        for _ in range(3):
+            lv, wv = exe.run(main, feed={"x": xv}, fetch_list=fetches)
+            if w_before is None:
+                w_before = float(np.ravel(wv)[0])
+        w_after = float(np.ravel(wv)[0])
+    # d(mean(x*w^5))/dw != 0 => sgd moved w
+    assert w_after != w_before
+
+
+def test_static_rnn_matches_manual_scan():
+    """StaticRNN h_t = tanh(x_t W + h_{t-1} U) == numpy recurrence."""
+    T, B, D, H = 5, 2, 3, 4
+
+    def build(main, startup):
+        x = layers.data("x", [T, B, D], "float32")
+        h0 = layers.data("h0", [B, H], "float32")
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(init=h0)
+            w = layers.create_parameter([D, H], "float32")
+            u = layers.create_parameter([H, H], "float32")
+            h = layers.elementwise_add(layers.mul(xt, w),
+                                       layers.mul(prev, u))
+            from paddle_tpu.fluid.layers import nn as lnn
+            h = lnn.tanh(h)
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+        loss = layers.mean(out)
+        return [out, loss, "w", "u"]
+
+    main, startup, f = _static(build)
+    # resolve created param names from the recurrent sub-block captures
+    rec = [op for op in main.global_block().ops
+           if op.type == "recurrent"][0]
+    pnames = [n for n in rec.attrs["capture_names"]]
+    rng = np.random.RandomState(0)
+    xv = rng.randn(T, B, D).astype("float32")
+    h0 = rng.randn(B, H).astype("float32")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        from paddle_tpu.fluid.scope import global_scope
+        out, loss = exe.run(main, feed={"x": xv, "h0": h0},
+                            fetch_list=f[:2])
+        vals = {n: global_scope().numpy(n) for n in pnames}
+    ws = [v for v in vals.values() if v.shape == (D, H)]
+    us = [v for v in vals.values() if v.shape == (H, H)]
+    assert len(ws) == 1 and len(us) == 1
+    h = h0.copy()
+    for t in range(T):
+        h = np.tanh(xv[t] @ ws[0] + h @ us[0])
+        np.testing.assert_allclose(out[t], h, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_static_rnn_language_model_trains():
+    """Reference-style StaticRNN char LM: embedding + recurrence + fc,
+    trained with Adam — loss must drop (recurrent backward through the
+    scan)."""
+    T, B, V, D, H = 6, 8, 32, 16, 24
+
+    def build(main, startup):
+        ids = layers.data("ids", [T, B], "int64")
+        labels = layers.data("labels", [T, B, 1], "int64")
+        from paddle_tpu.fluid.layers import nn as lnn
+        emb = lnn.embedding(ids, size=[V, D])     # [T, B, D]
+        h0v = layers.fill_constant([B, H], "float32", 0.0)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(emb)
+            prev = rnn.memory(init=h0v)
+            w = layers.create_parameter([D, H], "float32")
+            u = layers.create_parameter([H, H], "float32")
+            from paddle_tpu.fluid.layers import nn as lnn2
+            h = lnn2.tanh(layers.elementwise_add(layers.mul(xt, w),
+                                                 layers.mul(prev, u)))
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        hs = rnn()                                # [T, B, H]
+        logits = lnn.fc(layers.reshape(hs, [T * B, H]), V)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.reshape(labels, [T * B, 1])))
+        optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        return [loss]
+
+    main, startup, f = _static(build)
+    rng = np.random.RandomState(0)
+    seq = rng.randint(0, 32, (T + 1, B)).astype("int64")
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = []
+        for _ in range(40):
+            lv, = exe.run(main, feed={"ids": seq[:-1],
+                                      "labels": seq[1:, :, None]},
+                          fetch_list=f)
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])

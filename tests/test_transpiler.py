@@ -87,11 +87,98 @@ def test_pserver_program_shape(fresh_programs):
     paddle.disable_static()
 
 
-def test_sync_mode_rejected():
-    with pytest.raises(NotImplementedError, match="sync"):
-        DistributeTranspiler().transpile(
-            0, program=framework.Program(), pservers="a:1",
-            sync_mode=True)
+@pytest.mark.slow
+def test_sync_ps_multiprocess_matches_baseline(ps_server):
+    """Two real trainer processes in sync mode == the single-process
+    full-batch SGD trajectory (reference distribute_transpiler.py:545,813
+    send_barrier/fetch_barrier + RunSyncLoop: the round applies the MEAN
+    of the trainers' gradients, so sharded-batch sync == full batch)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "sync_ps_trainer.py")
+    rounds, trainers = 6, 2
+    procs = []
+    for tid in range(trainers):
+        env = dict(os.environ)
+        env.update({"PS_ENDPOINT": ps_server, "TRAINER_ID": str(tid),
+                    "TRAINERS": str(trainers), "ROUNDS": str(rounds),
+                    "PYTHONPATH": os.path.dirname(
+                        os.path.dirname(__file__))})
+        procs.append(subprocess.Popen(
+            [sys.executable, fixture], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for pr in procs:
+        out, err = pr.communicate(timeout=600)
+        assert pr.returncode == 0, err[-2000:]
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    # sync rounds leave every trainer holding the identical model
+    np.testing.assert_allclose(outs[0]["param"], outs[1]["param"],
+                               rtol=0, atol=0)
+
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient
+    # the trainers' final recv must equal the server-side model: pull the
+    # fc weight table (4 rows, dim 1)
+    cl = PSClient([ps_server])
+    pname = "fc_0.w_0"
+    w_final = cl.pull(pname, 1, np.arange(4))
+    cl.close()
+    np.testing.assert_allclose(np.asarray(outs[0]["param"]).reshape(4, 1),
+                               w_final.reshape(4, 1), rtol=1e-5,
+                               atol=1e-6)
+    # the round applies the MEAN of trainer grads == the full-batch
+    # gradient (each trainer feeds an interleaved half of one batch), so
+    # sync training converges like single-process full-batch SGD
+    losses0 = outs[0]["losses"]
+    assert losses0[-1] < losses0[1] * 0.2, losses0
+
+
+def test_geo_sgd_converges(ps_server, fresh_programs):
+    """GEO-SGD (reference GeoSgdTranspiler + GeoCommunicator
+    communicator.h:396): local SGD steps with a delta push/merged pull
+    every k steps still converges."""
+    from paddle_tpu.fluid.transpiler import DistributeTranspilerConfig
+    paddle.enable_static()
+    with unique_name.guard():
+        main, startup = framework.Program(), framework.Program()
+        main.random_seed = startup.random_seed = 5
+        with framework.program_guard(main, startup):
+            x = layers.data("x", [-1, 4], "float32")
+            y = layers.data("y", [-1, 1], "float32")
+            pred = layers.fc(x, 1, bias_attr=False)
+            d = layers.elementwise_sub(pred, y)
+            loss = layers.mean(layers.elementwise_mul(d, d))
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.geo_sgd_mode = True
+    cfg.geo_sgd_need_push_nums = 4
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id=0, program=main, pservers=ps_server,
+                trainers=1)
+    trainer = t.get_trainer_program()
+    types = [op.type for op in trainer.global_block().ops]
+    assert "sgd" in types       # local optimizer kept (GEO contract)
+    assert "geo_send" in types
+
+    rng = np.random.RandomState(1)
+    w_true = rng.randn(4, 1).astype("float32")
+    losses = []
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        for _ in range(60):
+            xb = rng.randn(32, 4).astype("float32")
+            lv, = exe.run(trainer, feed={"x": xb, "y": xb @ w_true},
+                          fetch_list=[loss])
+            losses.append(float(np.ravel(lv)[0]))
+    assert losses[-1] < losses[2] * 0.2, (losses[2], losses[-1])
 
 
 def test_fleet1x_incubate_api(ps_server, fresh_programs):
