@@ -135,6 +135,21 @@ def bench_lenet(batch=256, steps=30, warmup=5):
 
 
 def bench_bert(cfg_name="base", batch=16, seq=128, steps=32, warmup=3):
+    """BERT pretrain step (BASELINE config 3).
+
+    r04 bandwidth profile (v5e, batch 64, s128, measured 2026-07-30):
+    the compiled step accesses ~48.7 GB per step (XLA cost analysis); at
+    the chip's 819 GB/s that is a ~59 ms bandwidth floor against a
+    ~70 ms measured step — the program runs at ~85% of its own floor,
+    which caps MFU at ~38-39% for this op structure. Experiments that
+    did NOT move the number (all within run-to-run variance of the
+    shared tunnel chip, ±5%): layer_norm/softmax off the f32 AMP
+    blacklist (the Pallas LN/flash kernels already keep their f32 math
+    internal), batch 128. The attention path already runs the Pallas
+    flash kernel fwd+bwd; dropout+residual+LN runs the fused Pallas
+    epilogue. Pushing past ~39% requires cutting activation-revisits
+    across the matmul boundaries (fusing the FFN pair into one kernel,
+    i.e. Pallas matmul chains), not better elementwise fusion."""
     import jax
     from paddle_tpu.jit.functional import make_train_step
     from paddle_tpu.models.bert import BertConfig, BertForPretraining

@@ -1,0 +1,3 @@
+module paddle_tpu/go/paddle
+
+go 1.20

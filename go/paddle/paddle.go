@@ -1,0 +1,186 @@
+// Package paddle — Go inference/training binding over the paddle_tpu C
+// ABI (reference go/paddle/{config,predictor,tensor}.go over
+// paddle_fluid_c; here one file over libpaddle_tpu_capi).
+//
+// Build: the cgo directives expect the header dir and library path via
+//   CGO_CFLAGS="-I<repo>/paddle_tpu/capi"
+//   CGO_LDFLAGS="-L<repo>/paddle_tpu/capi/build -lpaddle_tpu_capi \
+//                -Wl,-rpath,<repo>/paddle_tpu/capi/build"
+// (tests/test_capi.py sets these when a Go toolchain is present).
+package paddle
+
+// #include <stdlib.h>
+// #include <stdint.h>
+// #include "paddle_c_api.h"
+import "C"
+
+import (
+	"fmt"
+	"unsafe"
+)
+
+// DataType mirrors PD_DataType.
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int32
+	Int64
+)
+
+func dtypeSize(t DataType) int {
+	if t == Int64 {
+		return 8
+	}
+	return 4
+}
+
+// Tensor is a dense array handed to / received from the runtime.
+type Tensor struct {
+	Shape []int64
+	Dtype DataType
+	Data  []byte // raw little-endian buffer, len = numel * dtype size
+}
+
+func (t *Tensor) numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}
+
+func toC(t *Tensor, c *C.PD_Tensor) error {
+	if len(t.Shape) > 8 {
+		return fmt.Errorf("paddle: ndim %d > 8", len(t.Shape))
+	}
+	if int64(len(t.Data)) != t.numel()*int64(dtypeSize(t.Dtype)) {
+		return fmt.Errorf("paddle: data length %d != numel*itemsize",
+			len(t.Data))
+	}
+	c.data = unsafe.Pointer(&t.Data[0])
+	c.ndim = C.int(len(t.Shape))
+	c.dtype = C.PD_DataType(t.Dtype)
+	for i, d := range t.Shape {
+		c.shape[i] = C.int64_t(d)
+	}
+	return nil
+}
+
+func fromC(c *C.PD_Tensor) Tensor {
+	var t Tensor
+	t.Dtype = DataType(c.dtype)
+	n := int64(1)
+	for i := 0; i < int(c.ndim); i++ {
+		d := int64(c.shape[i])
+		t.Shape = append(t.Shape, d)
+		n *= d
+	}
+	size := n * int64(dtypeSize(t.Dtype))
+	t.Data = C.GoBytes(unsafe.Pointer(c.data), C.int(size))
+	return t
+}
+
+func lastError() error {
+	return fmt.Errorf("paddle: %s", C.GoString(C.PD_GetLastError()))
+}
+
+// Predictor wraps PD_Predictor (an exported inference model dir).
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func NewPredictor(modelDir string) (*Predictor, error) {
+	cs := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cs))
+	p := C.PD_NewPredictor(cs)
+	if p == nil {
+		return nil, lastError()
+	}
+	return &Predictor{p: p}, nil
+}
+
+func (p *Predictor) Delete() { C.PD_DeletePredictor(p.p) }
+
+func (p *Predictor) InputNum() int  { return int(C.PD_GetInputNum(p.p)) }
+func (p *Predictor) OutputNum() int { return int(C.PD_GetOutputNum(p.p)) }
+
+// Run executes the model on the inputs (model feed order).
+func (p *Predictor) Run(inputs []Tensor) ([]Tensor, error) {
+	cin := make([]C.PD_Tensor, len(inputs))
+	for i := range inputs {
+		if err := toC(&inputs[i], &cin[i]); err != nil {
+			return nil, err
+		}
+	}
+	nOut := p.OutputNum()
+	if nOut < 0 {
+		return nil, lastError()
+	}
+	cout := make([]C.PD_Tensor, nOut)
+	var inPtr *C.PD_Tensor
+	if len(cin) > 0 {
+		inPtr = &cin[0]
+	}
+	var outPtr *C.PD_Tensor
+	if len(cout) > 0 {
+		outPtr = &cout[0]
+	}
+	if C.PD_PredictorRun(p.p, inPtr, C.int(len(cin)), outPtr,
+		C.int(nOut)) != 0 {
+		return nil, lastError()
+	}
+	outs := make([]Tensor, nOut)
+	for i := range cout {
+		outs[i] = fromC(&cout[i])
+	}
+	return outs, nil
+}
+
+// Trainer wraps PD_Trainer (a fluid.io.save_train_model dir) — the
+// language-free training loop (reference train/demo_trainer.cc).
+type Trainer struct {
+	t *C.PD_Trainer
+}
+
+func NewTrainer(modelDir string) (*Trainer, error) {
+	cs := C.CString(modelDir)
+	defer C.free(unsafe.Pointer(cs))
+	t := C.PD_NewTrainer(cs)
+	if t == nil {
+		return nil, lastError()
+	}
+	return &Trainer{t: t}, nil
+}
+
+func (t *Trainer) Delete()      { C.PD_DeleteTrainer(t.t) }
+func (t *Trainer) FeedNum() int { return int(C.PD_TrainerFeedNum(t.t)) }
+
+// Run performs one optimizer step and returns the loss.
+func (t *Trainer) Run(feeds []Tensor) (float32, error) {
+	cin := make([]C.PD_Tensor, len(feeds))
+	for i := range feeds {
+		if err := toC(&feeds[i], &cin[i]); err != nil {
+			return 0, err
+		}
+	}
+	var loss C.float
+	var inPtr *C.PD_Tensor
+	if len(cin) > 0 {
+		inPtr = &cin[0]
+	}
+	if C.PD_TrainerRun(t.t, inPtr, C.int(len(cin)), &loss) != 0 {
+		return 0, lastError()
+	}
+	return float32(loss), nil
+}
+
+// Save persists the trained parameters.
+func (t *Trainer) Save(dir string) error {
+	cs := C.CString(dir)
+	defer C.free(unsafe.Pointer(cs))
+	if C.PD_TrainerSave(t.t, cs) != 0 {
+		return lastError()
+	}
+	return nil
+}

@@ -1,0 +1,77 @@
+/* C-only training demo (reference fluid/train/demo/demo_trainer.cc:1):
+ * load a program pair saved by fluid.io.save_train_model and run SGD
+ * steps with data generated in C — no Python in this translation unit.
+ *
+ * Usage: demo_trainer <model_dir> <steps>
+ * Prints "first_loss <f>\nlast_loss <f>" and exits 0 when the loss
+ * dropped, 2 otherwise. Built and executed by tests/test_capi.py.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "paddle_c_api.h"
+
+/* tiny deterministic LCG so the demo needs no libs */
+static unsigned int rng_state = 12345u;
+static float frand(void) {
+  rng_state = rng_state * 1664525u + 1013904223u;
+  return ((float)(rng_state >> 8) / (float)(1u << 24)) * 2.0f - 1.0f;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <steps>\n", argv[0]);
+    return 1;
+  }
+  int steps = atoi(argv[2]);
+  PD_Trainer *t = PD_NewTrainer(argv[1]);
+  if (t == NULL) {
+    fprintf(stderr, "PD_NewTrainer: %s\n", PD_GetLastError());
+    return 1;
+  }
+  if (PD_TrainerFeedNum(t) != 2) {
+    fprintf(stderr, "expected 2 feeds, got %d\n", PD_TrainerFeedNum(t));
+    return 1;
+  }
+  const float w_true[4] = {0.5f, -1.25f, 2.0f, 0.75f};
+  enum { B = 32 };
+  float xbuf[B * 4], ybuf[B];
+  float first = 0.0f, last = 0.0f;
+  for (int s = 0; s < steps; ++s) {
+    for (int i = 0; i < B; ++i) {
+      float acc = 0.0f;
+      for (int d = 0; d < 4; ++d) {
+        xbuf[i * 4 + d] = frand();
+        acc += xbuf[i * 4 + d] * w_true[d];
+      }
+      ybuf[i] = acc;
+    }
+    PD_Tensor feeds[2];
+    feeds[0].data = xbuf;
+    feeds[0].ndim = 2;
+    feeds[0].shape[0] = B;
+    feeds[0].shape[1] = 4;
+    feeds[0].dtype = PD_FLOAT32;
+    feeds[1].data = ybuf;
+    feeds[1].ndim = 2;
+    feeds[1].shape[0] = B;
+    feeds[1].shape[1] = 1;
+    feeds[1].dtype = PD_FLOAT32;
+    float loss = 0.0f;
+    if (PD_TrainerRun(t, feeds, 2, &loss) != 0) {
+      fprintf(stderr, "PD_TrainerRun: %s\n", PD_GetLastError());
+      PD_DeleteTrainer(t);
+      return 1;
+    }
+    if (s == 0) first = loss;
+    last = loss;
+  }
+  printf("first_loss %g\nlast_loss %g\n", first, last);
+  if (argc > 3 && PD_TrainerSave(t, argv[3]) != 0) {
+    fprintf(stderr, "PD_TrainerSave: %s\n", PD_GetLastError());
+    PD_DeleteTrainer(t);
+    return 1;
+  }
+  PD_DeleteTrainer(t);
+  return last < first * 0.1f ? 0 : 2;
+}

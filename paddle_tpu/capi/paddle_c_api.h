@@ -50,6 +50,29 @@ int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
 
 const char *PD_GetLastError(void);
 
+/* ---- training without Python on the host side (reference
+ * fluid/train/demo/demo_trainer.cc): load a directory written by
+ * fluid.io.save_train_model (startup.program + main.program with
+ * backward/optimizer ops + optional params/) and drive train steps. */
+
+typedef struct PD_Trainer PD_Trainer;
+
+PD_Trainer *PD_NewTrainer(const char *model_dir);
+
+void PD_DeleteTrainer(PD_Trainer *t);
+
+int PD_TrainerFeedNum(PD_Trainer *t);
+
+/* One optimizer step on the given feeds (model feed order). On success
+ * *loss receives the first fetch (the loss) as float. Returns 0 on
+ * success, nonzero on error. */
+int PD_TrainerRun(PD_Trainer *t, const PD_Tensor *feeds, int n_feeds,
+                  float *loss);
+
+/* Persist the trained parameters (fluid.io.save_persistables layout,
+ * reloadable from Python or PD_NewTrainer's params/ dir). */
+int PD_TrainerSave(PD_Trainer *t, const char *dirname);
+
 #ifdef __cplusplus
 }
 #endif

@@ -201,7 +201,16 @@ int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
       }
       PyObject *shape = PyObject_GetAttrString(ca, "shape");
       int nd = static_cast<int>(PyTuple_Size(shape));
-      outputs[i].ndim = nd > 8 ? 8 : nd;
+      if (nd > 8) {
+        // the fixed shape[8] cannot represent this output; truncating
+        // would desync declared shape vs buffer length
+        Py_DECREF(shape);
+        Py_DECREF(ca);
+        set_err("output ndim > 8 unsupported by PD_Tensor");
+        ok = false;
+        break;
+      }
+      outputs[i].ndim = nd;
       size_t numel = 1;
       for (int d = 0; d < outputs[i].ndim; ++d) {
         outputs[i].shape[d] = PyLong_AsLongLong(
@@ -215,7 +224,12 @@ int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
       if (!bytes) { set_err_from_python(); ok = false; break; }
       char *buf = nullptr;
       Py_ssize_t len = 0;
-      PyBytes_AsStringAndSize(bytes, &buf, &len);
+      if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+        Py_DECREF(bytes);
+        set_err_from_python();
+        ok = false;
+        break;
+      }
       p->out_buffers[i].assign(buf, buf + len);
       Py_DECREF(bytes);
       outputs[i].data = p->out_buffers[i].data();
@@ -230,5 +244,144 @@ int PD_PredictorRun(PD_Predictor *p, const PD_Tensor *inputs,
 }
 
 const char *PD_GetLastError(void) { return g_last_error.c_str(); }
+
+/* ---- PD_Trainer: the C-only training loop (reference
+ * fluid/train/demo/demo_trainer.cc) over capi/train_host.py. ---- */
+
+struct PD_Trainer {
+  PyObject *trainer = nullptr;  // paddle_tpu.capi.train_host.CTrainer
+  PyObject *np = nullptr;
+};
+
+static PyObject *tensor_to_ndarray(PyObject *np, const PD_Tensor &t) {
+  size_t numel = 1;
+  for (int d = 0; d < t.ndim; ++d) numel *= t.shape[d];
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<void *>(t.data)),
+      numel * dtype_size(t.dtype), PyBUF_READ);
+  if (!mv) return nullptr;
+  PyObject *flat = PyObject_CallMethod(np, "frombuffer", "Os", mv,
+                                       np_dtype_name(t.dtype));
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject *shape = PyTuple_New(t.ndim);
+  for (int d = 0; d < t.ndim; ++d)
+    PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+  PyObject *arr = PyObject_CallMethod(flat, "reshape", "O", shape);
+  Py_DECREF(flat);
+  Py_DECREF(shape);
+  return arr;
+}
+
+PD_Trainer *PD_NewTrainer(const char *model_dir) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Trainer *t = nullptr;
+  PyObject *mod = nullptr, *np = nullptr, *tr = nullptr;
+  do {
+    mod = PyImport_ImportModule("paddle_tpu.capi.train_host");
+    if (!mod) { set_err_from_python(); break; }
+    np = PyImport_ImportModule("numpy");
+    if (!np) { set_err_from_python(); break; }
+    tr = PyObject_CallMethod(mod, "create_trainer", "s", model_dir);
+    if (!tr) { set_err_from_python(); break; }
+    t = new PD_Trainer();
+    t->trainer = tr;
+    t->np = np;
+    tr = nullptr;
+    np = nullptr;
+  } while (false);
+  Py_XDECREF(mod);
+  Py_XDECREF(np);
+  Py_XDECREF(tr);
+  PyGILState_Release(gil);
+  return t;
+}
+
+void PD_DeleteTrainer(PD_Trainer *t) {
+  if (t == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(t->trainer);
+  Py_XDECREF(t->np);
+  PyGILState_Release(gil);
+  delete t;
+}
+
+int PD_TrainerFeedNum(PD_Trainer *t) {
+  if (t == nullptr || t->trainer == nullptr) {
+    set_err("null trainer");
+    return -1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n = -1;
+  PyObject *names = PyObject_CallMethod(t->trainer, "get_feed_names",
+                                        nullptr);
+  if (names) {
+    n = static_cast<int>(PyList_Size(names));
+    Py_DECREF(names);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+int PD_TrainerRun(PD_Trainer *t, const PD_Tensor *feeds, int n_feeds,
+                  float *loss) {
+  if (t == nullptr || t->trainer == nullptr) {
+    set_err("null trainer");
+    return 1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *args = nullptr, *result = nullptr;
+  do {
+    args = PyTuple_New(n_feeds);
+    if (!args) { set_err_from_python(); break; }
+    bool ok = true;
+    for (int i = 0; i < n_feeds; ++i) {
+      PyObject *arr = tensor_to_ndarray(t->np, feeds[i]);
+      if (!arr) { set_err_from_python(); ok = false; break; }
+      PyTuple_SET_ITEM(args, i, arr);  // steals
+    }
+    if (!ok) break;
+    PyObject *run = PyObject_GetAttrString(t->trainer, "run");
+    if (!run) { set_err_from_python(); break; }
+    result = PyObject_CallObject(run, args);
+    Py_DECREF(run);
+    if (!result) { set_err_from_python(); break; }
+    if (loss != nullptr && PyList_Check(result) &&
+        PyList_Size(result) > 0) {
+      PyObject *first = PyList_GET_ITEM(result, 0);  // borrowed
+      PyObject *item = PyObject_CallMethod(first, "item", "i", 0);
+      if (!item) { set_err_from_python(); break; }
+      *loss = static_cast<float>(PyFloat_AsDouble(item));
+      Py_DECREF(item);
+    }
+    rc = 0;
+  } while (false);
+  Py_XDECREF(args);
+  Py_XDECREF(result);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_TrainerSave(PD_Trainer *t, const char *dirname) {
+  if (t == nullptr || t->trainer == nullptr) {
+    set_err("null trainer");
+    return 1;
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *r = PyObject_CallMethod(t->trainer, "save", "s", dirname);
+  if (r) {
+    rc = 0;
+    Py_DECREF(r);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
 
 }  // extern "C"

@@ -1,0 +1,64 @@
+"""Python host for the C-API *training* surface (reference
+fluid/train/demo/demo_trainer.cc:1 — load a saved Program and train with
+no Python on the user's side; the embedded interpreter here is an
+implementation detail behind the C ABI, mirroring how the reference
+embeds its C++ runtime behind libpaddle_fluid).
+
+Format (written by fluid.io.save_train_model): a directory with
+  startup.program / main.program  — serialized Program blobs
+  params/                         — persistables (optional, resume)
+  meta of feed/fetch names embedded in the main program blob.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["create_trainer", "CTrainer"]
+
+
+class CTrainer:
+    def __init__(self, model_dir: str):
+        from ..fluid.executor import Executor
+        from ..fluid.proto import deserialize_program
+        from ..fluid.scope import Scope
+
+        with open(os.path.join(model_dir, "main.program"), "rb") as f:
+            self.main, meta = deserialize_program(f.read())
+        with open(os.path.join(model_dir, "startup.program"), "rb") as f:
+            self.startup, _ = deserialize_program(f.read())
+        self.feed_names = list(meta.get("feed_names", []))
+        self.fetch_names = list(meta.get("fetch_names", []))
+        self.scope = Scope()
+        self.exe = Executor()
+        from ..fluid.scope import scope_guard
+        self._guard = scope_guard
+        with scope_guard(self.scope):
+            self.exe.run(self.startup)
+            params_dir = os.path.join(model_dir, "params")
+            if os.path.isdir(params_dir):
+                from ..fluid import io as fio
+                fio.load_persistables(self.exe, params_dir, self.main)
+
+    def get_feed_names(self):
+        return self.feed_names
+
+    def run(self, *arrays):
+        """arrays align with feed_names; returns the fetch values
+        (loss first) as float32 numpy arrays."""
+        feed = {n: np.asarray(a) for n, a in zip(self.feed_names, arrays)}
+        with self._guard(self.scope):
+            outs = self.exe.run(self.main, feed=feed,
+                                fetch_list=self.fetch_names)
+        return [np.asarray(o, np.float32).ravel() for o in outs]
+
+    def save(self, dirname: str):
+        from ..fluid import io as fio
+        os.makedirs(dirname, exist_ok=True)
+        with self._guard(self.scope):
+            fio.save_persistables(self.exe, dirname, self.main)
+
+
+def create_trainer(model_dir: str) -> CTrainer:
+    return CTrainer(model_dir)

@@ -241,18 +241,21 @@ class PSServer(socketserver.ThreadingTCPServer):
         super().__init__((host, int(port)), Handler)
         self.endpoint = f"{host}:{self.server_address[1]}"
 
-    def table(self, name: str, dim: int) -> LargeScaleKV:
+    def table(self, name: str, dim: int,
+              init_std: float = 0.01) -> LargeScaleKV:
         with self._tables_lock:
             if name not in self.tables:
-                self.tables[name] = LargeScaleKV(dim)
+                self.tables[name] = LargeScaleKV(dim, init_std=init_std)
             return self.tables[name]
 
     def _dispatch(self, req: dict):
         op = req["op"]
         if op == "pull":
-            return self.table(req["table"], req["dim"]).pull(req["keys"])
+            return self.table(req["table"], req["dim"],
+                              req.get("init_std", 0.01)).pull(req["keys"])
         if op == "push":
-            self.table(req["table"], req["dim"]).push(
+            self.table(req["table"], req["dim"],
+                       req.get("init_std", 0.01)).push(
                 req["keys"], req["grads"], req.get("lr", 1.0))
             return True
         if op == "save":
@@ -338,10 +341,24 @@ class PSClient:
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
+            import time
             host, port = self.endpoints[i].rsplit(":", 1)
-            # generous timeout: sync-mode barrier calls block server-side
-            # until the whole trainer round arrives
-            s = socket.create_connection((host, int(port)), timeout=330)
+            # retry the connect: workers routinely start before their
+            # server finished binding (reference brpc channel retries)
+            last = None
+            for attempt in range(30):
+                try:
+                    # generous timeout: sync-mode barrier calls block
+                    # server-side until the whole trainer round arrives
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=330)
+                    break
+                except OSError as e:
+                    last = e
+                    time.sleep(min(0.2 * (attempt + 1), 2.0))
+            else:
+                raise ConnectionError(
+                    f"PS server {self.endpoints[i]} unreachable: {last}")
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks[i] = s
         return self._socks[i]
@@ -368,7 +385,8 @@ class PSClient:
                 thread_name_prefix="ps-client")
         return list(self._pool.map(lambda fn: fn(), calls))
 
-    def pull(self, table: str, dim: int, keys) -> np.ndarray:
+    def pull(self, table: str, dim: int, keys,
+             init_std: float = 0.01) -> np.ndarray:
         keys = np.asarray(keys, np.int64).ravel()
         owner = self._route(keys)
         out = np.empty((len(keys), dim), np.float32)
@@ -377,14 +395,16 @@ class PSClient:
         res = self._fanout([
             (lambda i=i, m=m: self._call(i, {"op": "pull", "table": table,
                                              "dim": dim,
-                                             "keys": keys[m]}))
+                                             "keys": keys[m],
+                                             "init_std": init_std}))
             for i, m in masks])
         for (i, m), r in zip(masks, res):
             out[m] = r
         return out
 
     def push(self, table: str, dim: int, keys, grads, lr: float = 1.0,
-             sync: bool = False, trainers: int = 1):
+             sync: bool = False, trainers: int = 1,
+             init_std: float = 0.01):
         keys = np.asarray(keys, np.int64).ravel()
         grads = np.asarray(grads, np.float32).reshape(len(keys), dim)
         owner = self._route(keys)
@@ -395,7 +415,8 @@ class PSClient:
                                              "dim": dim, "keys": keys[m],
                                              "grads": grads[m],
                                              "lr": lr,
-                                             "trainers": trainers}))
+                                             "trainers": trainers,
+                                             "init_std": init_std}))
             for i, m in masks if m.any()])
 
     def send_barrier(self, worker: int, trainers: int):

@@ -20,7 +20,7 @@ __all__ = [
     "DataLoader",
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "save", "load",
+    "load_inference_model", "save", "load", "save_train_model",
 ]
 
 
@@ -247,3 +247,27 @@ class DataLoader:
             yield item
         if err:
             raise err[0]
+
+
+def save_train_model(dirname, feeded_var_names, loss, executor,
+                     main_program=None, startup_program=None):
+    """Save a TRAINABLE program pair for language-free training hosts
+    (reference fluid/train/demo/demo_trainer.cc loads exactly this:
+    startup + main with backward/optimizer ops + persistables). Consumed
+    by capi/train_host.py behind the PD_Trainer C ABI."""
+    from .proto import serialize_program
+    from . import framework as fw
+    main_program = main_program or fw.default_main_program()
+    startup_program = startup_program or fw.default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    meta = {"feed_names": list(feeded_var_names),
+            "fetch_names": [loss.name if hasattr(loss, "name") else
+                            str(loss)]}
+    with open(os.path.join(dirname, "main.program"), "wb") as f:
+        f.write(serialize_program(main_program, meta))
+    with open(os.path.join(dirname, "startup.program"), "wb") as f:
+        f.write(serialize_program(startup_program))
+    if executor is not None:
+        pdir = os.path.join(dirname, "params")
+        os.makedirs(pdir, exist_ok=True)
+        save_persistables(executor, pdir, main_program)

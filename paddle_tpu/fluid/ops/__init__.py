@@ -8,3 +8,4 @@ from . import (math_ops, nn_ops, tensor_ops, random_ops, optimizer_ops,
                control_ops, metric_ops, sequence_ops,
                structured_loss_ops, detection_ops, misc_ops,
                ps_ops)  # noqa: F401
+from . import tail_ops  # noqa: F401,E402

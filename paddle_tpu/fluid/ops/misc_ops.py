@@ -387,7 +387,9 @@ def _add_position_encoding(ctx, ins, attrs):
                   * (-math.log(10000.0) / D))
     pe = jnp.zeros((T, D), jnp.float32)
     pe = pe.at[:, 0::2].set(jnp.sin(pos * div))
-    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[:(D - D // 2)]))
+    # odd D: there are only D//2 odd (cos) columns; div has ceil(D/2)
+    # entries, so slice to the cos-column count
+    pe = pe.at[:, 1::2].set(jnp.cos(pos * div[:D // 2]))
     return out(attrs["alpha"] * v + attrs["beta"] * pe[None])
 
 

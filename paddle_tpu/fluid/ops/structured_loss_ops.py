@@ -105,7 +105,12 @@ def _warpctc(ctx, ins, attrs):
     logp = jax.nn.log_softmax(logits, axis=-1).transpose(1, 0, 2)
     nll = _ctc_loss_batch(logp, labels, llen, tlen, attrs["blank"])
     if attrs.get("norm_by_times"):
-        nll = nll / jnp.maximum(llen.astype(jnp.float32), 1.0)
+        # warp-ctc applies time normalization to the GRADIENT only; the
+        # reported loss stays unnormalized. Value = nll, gradient =
+        # d(nll/T): value-from-A-grad-from-B via stop_gradient algebra.
+        scaled = nll / jnp.maximum(llen.astype(jnp.float32), 1.0)
+        nll = jax.lax.stop_gradient(nll) + scaled - \
+            jax.lax.stop_gradient(scaled)
     return {"Loss": [nll[:, None]],
             "WarpCTCGrad": [jnp.zeros((1,), jnp.float32)]}
 

@@ -1,0 +1,936 @@
+"""Long-tail operator sweep (VERDICT r03 item 10): math/linalg/index/NN/
+sequence/detection stragglers of the reference op zoo, each a thin jnp
+kernel under the registry contract (grads auto-vjp unless noted).
+
+Reference kernel families replaced (one .cc/.cu pair each under
+/root/reference/paddle/fluid/operators/): prelu_op, maxout_op, pad3d_op,
+gather_tree_op, unfold_op, fold(im2col/col2im via math/im2col),
+interpolate_op (bilinear/trilinear/bicubic/nearest v1+v2),
+sequence_ops/{sequence_conv,slice,erase,enumerate,scatter}_op,
+detection/{generate_proposals,psroi_pool,roi_pool,box_clip,
+polygon_box_transform,density_prior_box}_op, deformable_conv_op,
+take_along_axis/put_along_axis, linalg (inverse, qr, svd, eigh, lu,
+matrix_rank, multi_dot), cum(max,min,logsumexp), searchsorted,
+bincount, spectral_norm_op, affine_channel_op, space_to_depth_op,
+*_batch_size_like, frame/overlap_add, complex ops, dist_op,
+index_sample/index_select.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register, same_shape_as
+from .common import x, out
+
+F32 = jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# elementwise math
+# ---------------------------------------------------------------------------
+
+for _name, _fn in [
+        ("expm1", jnp.expm1),
+        ("lgamma", jax.lax.lgamma),
+        ("digamma", jax.lax.digamma),
+        ("rad2deg", jnp.rad2deg),
+        ("deg2rad", jnp.deg2rad),
+        ("angle", jnp.angle),
+]:
+    register(_name, (lambda f: lambda ctx, ins, attrs: out(f(x(ins))))(_fn),
+             infer_shape=same_shape_as("X"))
+
+register("atan2",
+         lambda ctx, ins, attrs: out(jnp.arctan2(x(ins, "X1"),
+                                                 x(ins, "X2"))),
+         infer_shape=same_shape_as("X1"))
+
+
+@register("nan_to_num", attrs={"nan": 0.0, "posinf": None, "neginf": None})
+def _nan_to_num(ctx, ins, attrs):
+    return out(jnp.nan_to_num(x(ins), nan=attrs.get("nan", 0.0),
+                              posinf=attrs.get("posinf"),
+                              neginf=attrs.get("neginf")))
+
+
+@register("logsumexp", attrs={"axis": [], "keepdim": False,
+                              "reduce_all": False})
+def _logsumexp(ctx, ins, attrs):
+    v = x(ins)
+    ax = attrs.get("axis") or None
+    if attrs.get("reduce_all") or ax is None or list(ax) == []:
+        ax = None
+    else:
+        ax = tuple(int(a) for a in ax)
+    return out(jax.nn.logsumexp(v, axis=ax,
+                                keepdims=attrs.get("keepdim", False)))
+
+
+@register("logcumsumexp", attrs={"axis": -1, "flatten": False,
+                                 "exclusive": False, "reverse": False})
+def _logcumsumexp(ctx, ins, attrs):
+    v = x(ins)
+    if attrs.get("flatten"):
+        v = v.ravel()
+    ax = int(attrs.get("axis", -1))
+    if attrs.get("reverse"):
+        v = jnp.flip(v, ax)
+    r = jax.lax.cumlogsumexp(v, axis=ax)
+    if attrs.get("reverse"):
+        r = jnp.flip(r, ax)
+    return out(r)
+
+
+def _cum_minmax(fn):
+    def impl(ctx, ins, attrs):
+        v = x(ins)
+        ax = int(attrs.get("axis", -1))
+        if attrs.get("flatten"):
+            v = v.ravel()
+            ax = 0
+        val = fn(v, axis=ax)
+        # indices output (paddle returns the arg positions)
+        n = v.shape[ax]
+        eq = val == v
+        idx = jnp.arange(n).reshape(
+            [-1 if i == (ax % v.ndim) else 1 for i in range(v.ndim)])
+        idx = jnp.broadcast_to(idx, v.shape)
+        # last position where the running extreme equals the element
+        run = jax.lax.associative_scan(jnp.maximum,
+                                       jnp.where(eq, idx, -1), axis=ax)
+        return {"Out": [val], "Indices": [run.astype(jnp.int64)]}
+    return impl
+
+
+register("cummax", _cum_minmax(jax.lax.cummax),
+         attrs={"axis": -1, "flatten": False},
+         no_grad_out_slots=("Indices",))
+register("cummin", _cum_minmax(jax.lax.cummin),
+         attrs={"axis": -1, "flatten": False},
+         no_grad_out_slots=("Indices",))
+
+
+@register("dist", attrs={"p": 2.0})
+def _dist(ctx, ins, attrs):
+    d = (x(ins, "X") - x(ins, "Y")).ravel()
+    p = float(attrs.get("p", 2.0))
+    if p == float("inf"):
+        return out(jnp.max(jnp.abs(d)).reshape(()))
+    if p == float("-inf"):
+        return out(jnp.min(jnp.abs(d)).reshape(()))
+    if p == 0:
+        return out(jnp.sum(d != 0).astype(d.dtype).reshape(()))
+    return out((jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)).reshape(()))
+
+
+register("cosine_similarity",
+         lambda ctx, ins, attrs: out(
+             jnp.sum(x(ins, "X") * x(ins, "Y"), attrs.get("axis", 1)) /
+             (jnp.linalg.norm(x(ins, "X"), axis=attrs.get("axis", 1)) *
+              jnp.linalg.norm(x(ins, "Y"), axis=attrs.get("axis", 1))
+              ).clip(attrs.get("eps", 1e-8))),
+         attrs={"axis": 1, "eps": 1e-8})
+
+
+@register("pairwise_distance", attrs={"p": 2.0, "epsilon": 1e-6,
+                                      "keepdim": False})
+def _pairwise_distance(ctx, ins, attrs):
+    d = x(ins, "X") - x(ins, "Y") + attrs.get("epsilon", 1e-6)
+    p = float(attrs.get("p", 2.0))
+    r = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    if attrs.get("keepdim"):
+        r = r[..., None]
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# linalg (XLA-native decompositions; reference operators/*_op.cc over
+# LAPACK/cuSolver)
+# ---------------------------------------------------------------------------
+
+register("inverse", lambda ctx, ins, attrs: {
+    "Output": [jnp.linalg.inv(x(ins, "Input"))]},
+    infer_shape=same_shape_as("Input", out_slot="Output"))
+
+register("trace",
+         lambda ctx, ins, attrs: out(jnp.trace(
+             x(ins), offset=attrs.get("offset", 0),
+             axis1=attrs.get("axis1", 0), axis2=attrs.get("axis2", 1))),
+         attrs={"offset": 0, "axis1": 0, "axis2": 1})
+
+def _cross(ctx, ins, attrs):
+    a, b = x(ins, "X"), x(ins, "Y")
+    dim = attrs.get("dim", 9)
+    if dim == 9:  # unset sentinel: first axis of length 3 (reference)
+        dim = next(i for i, d in enumerate(a.shape) if d == 3)
+    return out(jnp.cross(a, b, axis=dim))
+
+
+register("cross", _cross, attrs={"dim": 9},
+         infer_shape=same_shape_as("X"))
+
+
+@register("multi_dot")
+def _multi_dot(ctx, ins, attrs):
+    return out(jnp.linalg.multi_dot(list(ins["X"])))
+
+
+@register("qr", grad=None, attrs={"mode": "reduced"})
+def _qr(ctx, ins, attrs):
+    q, r = jnp.linalg.qr(x(ins), mode=attrs.get("mode", "reduced"))
+    return {"Q": [q], "R": [r]}
+
+
+@register("svd", grad=None, attrs={"full_matrices": False})
+def _svd(ctx, ins, attrs):
+    u, s, vh = jnp.linalg.svd(
+        x(ins), full_matrices=attrs.get("full_matrices", False))
+    return {"U": [u], "S": [s], "VH": [vh]}
+
+
+@register("eigh", grad=None, attrs={"UPLO": "L"})
+def _eigh(ctx, ins, attrs):
+    v = x(ins)
+    # honor the UPLO contract: only the named triangle is read
+    if attrs.get("UPLO", "L") == "U":
+        up = jnp.triu(v)
+        sym = up + jnp.swapaxes(up, -1, -2) - \
+            jnp.triu(jnp.tril(v))  # diag counted once
+    else:
+        lo = jnp.tril(v)
+        sym = lo + jnp.swapaxes(lo, -1, -2) - jnp.triu(jnp.tril(v))
+    w, vec = jnp.linalg.eigh(sym, symmetrize_input=False)
+    return {"Eigenvalues": [w], "Eigenvectors": [vec]}
+
+
+@register("lu", grad=None, attrs={"pivots": True})
+def _lu(ctx, ins, attrs):
+    import jax.scipy.linalg as jsl
+    lu, piv = jsl.lu_factor(x(ins))
+    return {"Out": [lu], "Pivots": [piv.astype(jnp.int32)]}
+
+
+@register("matrix_rank", grad=None,
+          attrs={"tol": 0.0, "use_default_tol": True, "hermitian": False})
+def _matrix_rank(ctx, ins, attrs):
+    v = x(ins)
+    tol = None if attrs.get("use_default_tol", True) \
+        else attrs.get("tol", 0.0)
+    return out(jnp.linalg.matrix_rank(v, tol=tol).astype(jnp.int64))
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+register("take_along_axis",
+         lambda ctx, ins, attrs: {"Result": [jnp.take_along_axis(
+             x(ins, "Input"), x(ins, "Index").astype(jnp.int64),
+             axis=attrs.get("Axis", 0))]},
+         attrs={"Axis": 0}, no_grad_slots=("Index",))
+
+
+@register("put_along_axis", no_grad_slots=("Index",),
+          attrs={"Axis": 0, "Reduce": "assign"})
+def _put_along_axis(ctx, ins, attrs):
+    v, idx, val = x(ins, "Input"), x(ins, "Index"), x(ins, "Value")
+    ax = attrs.get("Axis", 0)
+    idx = idx.astype(jnp.int64)
+    mode = attrs.get("Reduce", "assign")
+    dims = [jnp.arange(s) for s in idx.shape]
+    mesh = jnp.meshgrid(*dims, indexing="ij")
+    mesh[ax] = idx
+    if mode == "add":
+        r = v.at[tuple(mesh)].add(jnp.broadcast_to(val, idx.shape))
+    elif mode == "multiply" or mode == "mul":
+        r = v.at[tuple(mesh)].multiply(jnp.broadcast_to(val, idx.shape))
+    else:
+        r = v.at[tuple(mesh)].set(jnp.broadcast_to(val, idx.shape))
+    return {"Result": [r]}
+
+
+register("broadcast_to",
+         lambda ctx, ins, attrs: out(jnp.broadcast_to(
+             x(ins), tuple(attrs["shape"]))),
+         attrs={"shape": []})
+
+register("searchsorted",
+         lambda ctx, ins, attrs: out(jnp.searchsorted(
+             x(ins, "SortedSequence"), x(ins, "Values"),
+             side="right" if attrs.get("right", False) else "left"
+         ).astype(jnp.int32 if attrs.get("out_int32") else jnp.int64)),
+         grad=None, attrs={"out_int32": False, "right": False})
+
+register("bucketize",
+         lambda ctx, ins, attrs: out(jnp.searchsorted(
+             x(ins, "SortedSequence"), x(ins, "InputTensor"),
+             side="right" if attrs.get("right", False) else "left"
+         ).astype(jnp.int32 if attrs.get("out_int32") else jnp.int64)),
+         grad=None, attrs={"out_int32": False, "right": False})
+
+
+@register("bincount", grad=None, attrs={"minlength": 0})
+def _bincount(ctx, ins, attrs):
+    v = x(ins).astype(jnp.int32).ravel()
+    w = x(ins, "Weights")
+    # static shape contract: length = minlength (XLA needs a bound; the
+    # reference sizes by max(x)+1 at runtime — pass minlength >= that)
+    n = int(attrs.get("minlength") or 0)
+    if n <= 0:
+        cv = np.asarray(v) if not isinstance(v, jax.core.Tracer) else None
+        if cv is None:
+            raise ValueError("bincount under tracing needs minlength>0 "
+                             "(static shapes)")
+        n = int(cv.max()) + 1 if cv.size else 1
+    if w is None:
+        return out(jnp.zeros((n,), jnp.int64).at[v].add(1))
+    return out(jnp.zeros((n,), w.dtype).at[v].add(w.ravel()))
+
+
+@register("unique_consecutive", grad=None,
+          attrs={"dtype": "int64", "return_inverse": False,
+                 "return_counts": False, "axis": []})
+def _unique_consecutive(ctx, ins, attrs):
+    """Static-shape redesign: output keeps x's length with repeats
+    compacted to the front and the tail zero-padded; Counts/Index share
+    that convention (XLA cannot return data-dependent shapes)."""
+    v = x(ins).ravel()
+    keep = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]])
+    pos = jnp.cumsum(keep) - 1
+    n = v.shape[0]
+    # every element of a run writes its run slot; scatter order makes the
+    # LAST write win, but all writes in a run carry the same value
+    outv = jnp.zeros_like(v).at[pos].set(v)
+    inv = pos
+    counts = jnp.zeros((n,), jnp.int64).at[pos].add(1)
+    return {"Out": [outv], "Index": [inv.astype(jnp.int64)],
+            "Counts": [counts]}
+
+
+# ---------------------------------------------------------------------------
+# NN tail
+# ---------------------------------------------------------------------------
+
+@register("prelu", attrs={"mode": "all", "data_format": "NCHW"})
+def _prelu(ctx, ins, attrs):
+    v, alpha = x(ins), x(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        caxis = 1 if attrs.get("data_format", "NCHW") == "NCHW" \
+            else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[caxis] = -1
+        alpha = alpha.reshape(shape)
+    elif mode == "element":
+        alpha = alpha.reshape((1,) + v.shape[1:])
+    else:
+        alpha = alpha.reshape(())
+    return out(jnp.where(v > 0, v, alpha * v))
+
+
+@register("maxout", attrs={"groups": 1, "axis": 1})
+def _maxout(ctx, ins, attrs):
+    v = x(ins)
+    g = int(attrs["groups"])
+    ax = int(attrs.get("axis", 1)) % v.ndim
+    c = v.shape[ax]
+    shp = v.shape[:ax] + (c // g, g) + v.shape[ax + 1:]
+    return out(jnp.max(v.reshape(shp), axis=ax + 1))
+
+
+@register("pad3d", attrs={"paddings": [0] * 6, "mode": "constant",
+                          "value": 0.0, "data_format": "NCDHW"})
+def _pad3d(ctx, ins, attrs):
+    v = x(ins)
+    p = list(attrs["paddings"])  # [l, r, top, bottom, front, back]
+    ncdhw = attrs.get("data_format", "NCDHW") == "NCDHW"
+    sp = [(p[4], p[5]), (p[2], p[3]), (p[0], p[1])]  # D, H, W
+    pads = ([(0, 0), (0, 0)] + sp) if ncdhw else \
+        ([(0, 0)] + sp + [(0, 0)])
+    mode = attrs.get("mode", "constant")
+    if mode == "constant":
+        return out(jnp.pad(v, pads, constant_values=attrs.get("value",
+                                                              0.0)))
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return out(jnp.pad(v, pads, mode=jmode))
+
+
+@register("gather_tree", grad=None)
+def _gather_tree(ctx, ins, attrs):
+    """Beam-search backtrace (reference gather_tree_op): ids/parents
+    [T, B, W] -> full sequences re-threaded along parent pointers."""
+    ids, parents = x(ins, "Ids"), x(ins, "Parents")
+    T = ids.shape[0]
+
+    def step(beams, t):
+        # beams: [B, W] current beam index per output slot
+        idx = jnp.take_along_axis(ids[t], beams, axis=-1)
+        nxt = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return nxt, idx
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return out(jnp.flip(outs, 0).astype(ids.dtype))
+
+
+@register("fold", attrs={"output_sizes": [0, 0], "kernel_sizes": [3, 3],
+                         "strides": [1, 1], "paddings": [0, 0, 0, 0],
+                         "dilations": [1, 1]})
+def _fold(ctx, ins, attrs):
+    """col2im — scatter-add of unfold patches back to the image."""
+    v = x(ins)  # [N, C*kh*kw, L]
+    oh, ow = attrs["output_sizes"]
+    kh, kw = attrs["kernel_sizes"]
+    sh, sw = attrs["strides"]
+    p = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    n, ckk, L = v.shape
+    c = ckk // (kh * kw)
+    ph, pw = oh + p[0] + p[2], ow + p[1] + p[3]
+    lh = (ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (pw - (dw * (kw - 1) + 1)) // sw + 1
+    img = jnp.zeros((n, c, ph, pw), v.dtype)
+    cols = v.reshape(n, c, kh, kw, lh, lw)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dh
+            xs = j * dw
+            img = img.at[:, :, ys:ys + lh * sh:sh,
+                         xs:xs + lw * sw:sw].add(cols[:, :, i, j])
+    return {"Y": [img[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]]}
+
+
+@register("affine_channel", attrs={"data_layout": "NCHW"})
+def _affine_channel(ctx, ins, attrs):
+    v, s, b = x(ins, "X"), x(ins, "Scale"), x(ins, "Bias")
+    caxis = 1 if attrs.get("data_layout", "NCHW") == "NCHW" else v.ndim - 1
+    shape = [1] * v.ndim
+    shape[caxis] = -1
+    return out(v * s.reshape(shape) + b.reshape(shape))
+
+
+@register("space_to_depth", attrs={"blocksize": 1})
+def _space_to_depth(ctx, ins, attrs):
+    v = x(ins)
+    bs = int(attrs["blocksize"])
+    n, c, h, w = v.shape
+    v = v.reshape(n, c, h // bs, bs, w // bs, bs)
+    v = v.transpose(0, 3, 5, 1, 2, 4)
+    return out(v.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+@register("spectral_norm", no_grad_slots=("U", "V"),
+          attrs={"dim": 0, "power_iters": 1, "eps": 1e-12})
+def _spectral_norm(ctx, ins, attrs):
+    w, u, v = x(ins, "Weight"), x(ins, "U"), x(ins, "V")
+    dim = int(attrs.get("dim", 0))
+    eps = attrs.get("eps", 1e-12)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(int(attrs.get("power_iters", 1)), 0)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return out(w / sigma)
+
+
+@register("deformable_conv", no_grad_slots=("Mask",),
+          attrs={"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1,
+                 "deformable_groups": 1, "im2col_step": 64})
+def _deformable_conv(ctx, ins, attrs):
+    """Deformable conv v2 (reference deformable_conv_op.cu): sample the
+    input at offset-shifted taps with bilinear interpolation, modulate
+    by the mask, then contract with the filter."""
+    v = x(ins, "Input")          # [N, C, H, W]
+    offset = x(ins, "Offset")    # [N, 2*dg*kh*kw, OH, OW]
+    mask = x(ins, "Mask")        # [N, dg*kh*kw, OH, OW] or None
+    flt = x(ins, "Filter")       # [OC, C/g, kh, kw]
+    sh, sw = attrs["strides"]
+    ph, pw = attrs["paddings"]
+    dh, dw = attrs["dilations"]
+    g = attrs.get("groups", 1) or 1
+    n, c, h, w = v.shape
+    oc, cpg, kh, kw = flt.shape
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    base_y = (jnp.arange(oh) * sh - ph)[:, None, None]   # [OH,1,1]
+    base_x = (jnp.arange(ow) * sw - pw)[None, :, None]   # [1,OW,1]
+    ky = (jnp.arange(kh) * dh)[None, None, :, None]      # [1,1,kh,1]
+    kx = (jnp.arange(kw) * dw)[None, None, None, :]      # [1,1,1,kw]
+    off = offset.reshape(n, -1, 2, kh, kw, oh, ow)
+    oy = off[:, 0, 0].transpose(0, 3, 4, 1, 2)  # dg=1: [N,OH,OW,kh,kw]
+    ox = off[:, 0, 1].transpose(0, 3, 4, 1, 2)
+    # sampling coords [N, OH, OW, kh, kw]
+    ys = base_y[None, :, :, :, None] + ky[None] + oy
+    xs = base_x[None, :, :, None, :] + kx[None] + ox
+
+    def bilinear(img, ys, xs):
+        y0 = jnp.floor(ys)
+        x0 = jnp.floor(xs)
+        wy = ys - y0
+        wx = xs - x0
+        def at(yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            val = img[:, yi, xi]
+            ok = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            return jnp.where(ok, val, 0.0)
+        return (at(y0, x0) * (1 - wy) * (1 - wx) +
+                at(y0, x0 + 1) * (1 - wy) * wx +
+                at(y0 + 1, x0) * wy * (1 - wx) +
+                at(y0 + 1, x0 + 1) * wy * wx)
+
+    # vmap over batch: img [C,H,W], ys/xs [OH,OW,kh,kw]
+    samp = jax.vmap(bilinear)(v, ys, xs)  # [N, C, OH, OW, kh, kw]
+    if mask is not None:
+        m = mask.reshape(n, 1, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+        samp = samp * m
+    samp = samp.reshape(n, g, c // g, oh, ow, kh, kw)
+    fg = flt.reshape(g, oc // g, cpg, kh, kw)
+    r = jnp.einsum("ngcyxhw,gochw->ngoyx", samp, fg)
+    return {"Output": [r.reshape(n, oc, oh, ow)]}
+
+
+# ---------------------------------------------------------------------------
+# interpolation family (reference interpolate_op.* v1+v2) — jax.image
+# ---------------------------------------------------------------------------
+
+def _interp(method):
+    def impl(ctx, ins, attrs):
+        v = x(ins)
+        size_t = x(ins, "OutSize")
+        oh, ow, od = attrs.get("out_h", 0), attrs.get("out_w", 0), \
+            attrs.get("out_d", 0)
+        scale = attrs.get("scale") or attrs.get("scale_factor") or []
+        if isinstance(scale, (int, float)):
+            scale = [scale]
+        is3d = v.ndim == 5
+        if size_t is not None:
+            tgt = tuple(int(s) for s in np.asarray(size_t).tolist())
+        elif (od or 0) > 0 or (oh or 0) > 0 or (ow or 0) > 0:
+            tgt = ((od, oh, ow) if is3d else (oh, ow))
+        else:
+            sp = v.shape[2:]
+            if len(scale) == 1:
+                scale = list(scale) * len(sp)
+            tgt = tuple(int(round(s * f)) for s, f in zip(sp, scale))
+        meth = {"nearest": "nearest", "bilinear": "linear",
+                "trilinear": "linear", "bicubic": "cubic"}[method]
+        r = jax.image.resize(v, v.shape[:2] + tgt, method=meth)
+        return out(r.astype(v.dtype))
+    return impl
+
+
+for _m in ("nearest", "bilinear", "trilinear", "bicubic"):
+    for _suffix in ("_interp", "_interp_v2"):
+        _name = _m + _suffix
+        register(_name, _interp(_m), no_grad_slots=("OutSize", "Scale"),
+                 attrs={"out_h": 0, "out_w": 0, "out_d": 0, "scale": [],
+                        "align_corners": False, "align_mode": 1,
+                        "data_layout": "NCHW"})
+
+
+# ---------------------------------------------------------------------------
+# sequence tail (dense+length design per SURVEY; reference
+# operators/sequence_ops/*)
+# ---------------------------------------------------------------------------
+
+def _steps_mask(lengths, T):
+    return jnp.arange(T)[None, :] < lengths[:, None]
+
+
+@register("sequence_conv", no_grad_slots=("SeqLen",),
+          attrs={"contextLength": 3, "contextStart": -1,
+                 "contextStride": 1})
+def _sequence_conv(ctx, ins, attrs):
+    """[B, T, D] dense+mask layout; context window conv along T
+    (reference sequence_conv_op: im2col over the sequence axis)."""
+    v, flt = x(ins, "X"), x(ins, "Filter")
+    lens = x(ins, "SeqLen")
+    L = int(attrs.get("contextLength", 3))
+    start = int(attrs.get("contextStart", -L // 2))
+    B, T, D = v.shape
+    cols = []
+    for i in range(L):
+        shift = start + i
+        cols.append(jnp.roll(v, -shift, axis=1) *
+                    ((jnp.arange(T) + shift >= 0) &
+                     (jnp.arange(T) + shift < T))[None, :, None])
+    col = jnp.concatenate(cols, axis=-1)           # [B, T, L*D]
+    r = col @ flt                                   # [B, T, OC]
+    if lens is not None:
+        r = r * _steps_mask(lens.ravel(), T)[..., None]
+    return out(r)
+
+
+@register("sequence_slice", grad=None, no_grad_slots=("Offset", "Length"))
+def _sequence_slice(ctx, ins, attrs):
+    """Per-row slice, left-aligned into a zero-padded buffer (static
+    shapes: output keeps T)."""
+    v = x(ins, "X")
+    off = x(ins, "Offset").ravel().astype(jnp.int32)
+    ln = x(ins, "Length").ravel().astype(jnp.int32)
+    T = v.shape[1]
+    idx = jnp.clip(jnp.arange(T)[None, :] + off[:, None], 0, T - 1)
+    keep = jnp.arange(T)[None, :] < ln[:, None]
+    idx = idx.reshape(idx.shape + (1,) * (v.ndim - 2))
+    g = jnp.take_along_axis(v, jnp.broadcast_to(
+        idx, v.shape[:2] + (1,) * (v.ndim - 2)), axis=1)
+    mask = keep.reshape(keep.shape + (1,) * (v.ndim - 2))
+    return out(jnp.where(mask, g, 0))
+
+
+@register("sequence_erase", grad=None, attrs={"tokens": []})
+def _sequence_erase(ctx, ins, attrs):
+    """Remove listed tokens, compact left, zero-pad (reference
+    sequence_erase_op; static-length output + Length tensor)."""
+    v = x(ins).astype(jnp.int64)
+    toks = jnp.asarray(list(attrs.get("tokens", [])), jnp.int64)
+    B, T = v.shape
+    keep = ~jnp.isin(v, toks)
+    pos = jnp.cumsum(keep, axis=1) - 1
+    # erased tokens contribute 0 at (clipped) slot pos; kept tokens
+    # scatter-ADD their value at their compacted slot — each slot
+    # receives exactly one nonzero contribution
+    res = jnp.zeros_like(v).at[
+        jnp.arange(B)[:, None], jnp.clip(pos, 0, T - 1)].add(
+        jnp.where(keep, v, 0))
+    return {"Out": [res], "Length": [keep.sum(1).astype(jnp.int64)]}
+
+
+@register("sequence_enumerate", grad=None,
+          attrs={"win_size": 2, "pad_value": 0})
+def _sequence_enumerate(ctx, ins, attrs):
+    v = x(ins)
+    W = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    B, T = v.shape
+    cols = []
+    for i in range(W):
+        shifted = jnp.roll(v, -i, axis=1)
+        valid = (jnp.arange(T) + i < T)[None, :]
+        cols.append(jnp.where(valid, shifted, pad))
+    return out(jnp.stack(cols, axis=-1))
+
+
+@register("sequence_scatter", no_grad_slots=("Ids",))
+def _sequence_scatter(ctx, ins, attrs):
+    v, ids, upd = x(ins, "X"), x(ins, "Ids"), x(ins, "Updates")
+    B = v.shape[0]
+    return out(v.at[jnp.arange(B)[:, None],
+                    ids.astype(jnp.int32)].add(upd))
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+# ---------------------------------------------------------------------------
+
+@register("box_clip", grad=None)
+def _box_clip(ctx, ins, attrs):
+    boxes, im = x(ins, "Input"), x(ins, "ImInfo")
+    h = im[..., 0:1] - 1
+    w = im[..., 1:2] - 1
+    while h.ndim < boxes.ndim:
+        h = h[:, None]
+        w = w[:, None]
+    x1 = boxes[..., 0::2].clip(0) - jnp.maximum(
+        boxes[..., 0::2] - w, 0).clip(0)
+    y1 = boxes[..., 1::2].clip(0) - jnp.maximum(
+        boxes[..., 1::2] - h, 0).clip(0)
+    r = jnp.stack([x1[..., 0], y1[..., 0], x1[..., 1], y1[..., 1]],
+                  axis=-1)
+    return {"Output": [r]}
+
+
+@register("polygon_box_transform", grad=None)
+def _polygon_box_transform(ctx, ins, attrs):
+    v = x(ins, "Input")  # [N, 8, H, W] offsets (EAST-style)
+    n, c, h, w = v.shape
+    gy = jnp.arange(h).reshape(1, 1, h, 1)
+    gx = jnp.arange(w).reshape(1, 1, 1, w)
+    xs = 4 * gx - v[:, 0::2]
+    ys = 4 * gy - v[:, 1::2]
+    r = jnp.stack([xs, ys], axis=2).reshape(n, c, h, w)
+    return {"Output": [r]}
+
+
+@register("roi_pool", grad=None, no_grad_slots=("ROIs", "RoisNum"),
+          attrs={"pooled_height": 1, "pooled_width": 1,
+                 "spatial_scale": 1.0})
+def _roi_pool(ctx, ins, attrs):
+    v, rois = x(ins, "X"), x(ins, "ROIs")
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = v.shape
+    nr = rois.shape[0]
+
+    def one(roi):
+        x1, y1, x2, y2 = [jnp.round(roi[i] * scale) for i in range(4)]
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        img = v[0]  # single-image contract (batch via RoisNum upstream)
+        ys = y1 + jnp.arange(ph + 1) * rh / ph
+        xs = x1 + jnp.arange(pw + 1) * rw / pw
+        gy = jnp.arange(h)[None, :]
+        gx = jnp.arange(w)[None, :]
+        my = (gy >= jnp.floor(ys[:-1, None])) & (gy < jnp.ceil(
+            ys[1:, None]))
+        mx = (gx >= jnp.floor(xs[:-1, None])) & (gx < jnp.ceil(
+            xs[1:, None]))
+        big = jnp.finfo(v.dtype).min
+        r = jnp.where(my[None, :, None, :, None] &
+                      mx[None, None, :, None, :],
+                      img[:, None, None, :, :], big)
+        return jnp.max(r, axis=(3, 4))
+
+    r = jax.vmap(one)(rois)
+    return out(r)
+
+
+@register("psroi_pool", grad=None, no_grad_slots=("ROIs", "RoisNum"),
+          attrs={"output_channels": 1, "pooled_height": 1,
+                 "pooled_width": 1, "spatial_scale": 1.0})
+def _psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pool (reference psroi_pool_op):
+    channel block (i,j) serves output bin (i,j)."""
+    v, rois = x(ins, "X"), x(ins, "ROIs")
+    oc = int(attrs["output_channels"])
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = attrs.get("spatial_scale", 1.0)
+    n, c, h, w = v.shape
+
+    def one(roi):
+        x1, y1, x2, y2 = [roi[i] * scale for i in range(4)]
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        img = v[0].reshape(oc, ph, pw, h, w)
+        ys = y1 + jnp.arange(ph + 1) * rh / ph
+        xs = x1 + jnp.arange(pw + 1) * rw / pw
+        gy = jnp.arange(h)[None, :]
+        gx = jnp.arange(w)[None, :]
+        my = (gy >= jnp.floor(ys[:-1, None])) & (gy < jnp.ceil(
+            ys[1:, None]))
+        mx = (gx >= jnp.floor(xs[:-1, None])) & (gx < jnp.ceil(
+            xs[1:, None]))
+        m = my[:, None, :, None] & mx[None, :, None, :]  # [ph,pw,h,w]
+        cnt = jnp.maximum(m.sum(axis=(2, 3)), 1)
+        s = jnp.einsum("opqhw,pqhw->opq", img, m.astype(v.dtype))
+        return s / cnt
+
+    return out(jax.vmap(one)(rois))
+
+
+@register("generate_proposals_v2", grad=None,
+          no_grad_slots=("Scores", "BboxDeltas", "ImShape", "Anchors",
+                         "Variances"),
+          attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                 "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0,
+                 "pixel_offset": True})
+def _generate_proposals_v2(ctx, ins, attrs):
+    """RPN proposal generation (reference generate_proposals_op):
+    decode anchors, clip, filter tiny boxes, topk + NMS. Static-shape
+    contract: returns exactly post_nms_topN rows (suppressed rows
+    zeroed), plus the valid count."""
+    scores = x(ins, "Scores")       # [N, A, H, W]
+    deltas = x(ins, "BboxDeltas")   # [N, 4A, H, W]
+    im = x(ins, "ImShape")          # [N, 2] (v2) / ImInfo [N, 3] (v1)
+    if im is None:
+        im = x(ins, "ImInfo")
+    anchors = x(ins, "Anchors").reshape(-1, 4)
+    var = x(ins, "Variances")
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    thresh = attrs.get("nms_thresh", 0.5)
+    min_size = attrs.get("min_size", 0.1)
+    off = 1.0 if attrs.get("pixel_offset", True) else 0.0
+    n = scores.shape[0]
+    sc = scores.reshape(n, -1)
+    dl = deltas.reshape(n, -1, 4)
+    K = sc.shape[1]
+    pre_n = min(pre_n, K)
+    post_n = min(post_n, pre_n)
+    v = var.reshape(-1, 4) if var is not None else jnp.ones((1, 4), F32)
+
+    def decode(d):
+        aw = anchors[:, 2] - anchors[:, 0] + off
+        ah = anchors[:, 3] - anchors[:, 1] + off
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * v[:, 0] * aw + acx
+        cy = d[:, 1] * v[:, 1] * ah + acy
+        bw = jnp.exp(jnp.clip(d[:, 2] * v[:, 2], -10, 10)) * aw
+        bh = jnp.exp(jnp.clip(d[:, 3] * v[:, 3], -10, 10)) * ah
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - off, cy + bh * 0.5 - off], -1)
+
+    def one(sc_i, dl_i, im_i):
+        boxes = decode(dl_i)
+        boxes = jnp.stack([boxes[:, 0].clip(0, im_i[1] - 1),
+                           boxes[:, 1].clip(0, im_i[0] - 1),
+                           boxes[:, 2].clip(0, im_i[1] - 1),
+                           boxes[:, 3].clip(0, im_i[0] - 1)], -1)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        valid = (ws >= min_size) & (hs >= min_size)
+        sc_m = jnp.where(valid, sc_i, -jnp.inf)
+        top_sc, top_ix = jax.lax.top_k(sc_m, pre_n)
+        top_bx = boxes[top_ix]
+        # greedy NMS over the pre-topk (static loop post_n picks)
+        def pick(state, _):
+            alive, sel_s = state
+            cand = jnp.where(alive, sel_s, -jnp.inf)
+            i = jnp.argmax(cand)
+            ok = cand[i] > -jnp.inf
+            bi = top_bx[i]
+            xx1 = jnp.maximum(top_bx[:, 0], bi[0])
+            yy1 = jnp.maximum(top_bx[:, 1], bi[1])
+            xx2 = jnp.minimum(top_bx[:, 2], bi[2])
+            yy2 = jnp.minimum(top_bx[:, 3], bi[3])
+            inter = jnp.clip(xx2 - xx1 + off, 0) * \
+                jnp.clip(yy2 - yy1 + off, 0)
+            a1 = (top_bx[:, 2] - top_bx[:, 0] + off) * \
+                (top_bx[:, 3] - top_bx[:, 1] + off)
+            ai = (bi[2] - bi[0] + off) * (bi[3] - bi[1] + off)
+            iou = inter / jnp.maximum(a1 + ai - inter, 1e-10)
+            alive = alive & (iou <= thresh)
+            return (alive, sel_s), (jnp.where(ok, i, -1),
+                                    jnp.where(ok, top_sc[i], 0.0))
+        alive0 = top_sc > -jnp.inf
+        (_, _), (picks, psc) = jax.lax.scan(
+            pick, (alive0, top_sc), None, length=post_n)
+        ok = picks >= 0
+        rois = jnp.where(ok[:, None],
+                         top_bx[jnp.clip(picks, 0)], 0.0)
+        return rois, jnp.where(ok, psc, 0.0), ok.sum().astype(jnp.int32)
+
+    rois, psc, cnt = jax.vmap(one)(sc, dl, im)
+    return {"RpnRois": [rois], "RpnRoiProbs": [psc],
+            "RpnRoisNum": [cnt]}
+
+
+register("generate_proposals", _generate_proposals_v2, grad=None,
+         no_grad_slots=("Scores", "BboxDeltas", "ImInfo", "Anchors",
+                        "Variances"),
+         attrs={"pre_nms_topN": 6000, "post_nms_topN": 1000,
+                "nms_thresh": 0.5, "min_size": 0.1, "eta": 1.0,
+                "pixel_offset": True})
+
+
+@register("density_prior_box", grad=None,
+          attrs={"densities": [], "fixed_sizes": [], "fixed_ratios": [],
+                 "variances": [0.1, 0.1, 0.2, 0.2], "clip": False,
+                 "step_w": 0.0, "step_h": 0.0, "offset": 0.5})
+def _density_prior_box(ctx, ins, attrs):
+    feat, img = x(ins, "Input"), x(ins, "Image")
+    fh, fw = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = attrs.get("step_w") or iw / fw
+    sh = attrs.get("step_h") or ih / fh
+    offset = attrs.get("offset", 0.5)
+    boxes = []
+    for dens, fs in zip(attrs["densities"], attrs["fixed_sizes"]):
+        for ratio in (attrs["fixed_ratios"] or [1.0]):
+            bw = fs * np.sqrt(ratio)
+            bh = fs / np.sqrt(ratio)
+            step = fs / dens
+            for di in range(dens):
+                for dj in range(dens):
+                    shift_x = (dj + 0.5) * step - fs / 2.0
+                    shift_y = (di + 0.5) * step - fs / 2.0
+                    cx = (jnp.arange(fw) + offset) * sw + shift_x
+                    cy = (jnp.arange(fh) + offset) * sh + shift_y
+                    cxg, cyg = jnp.meshgrid(cx, cy)
+                    b = jnp.stack([(cxg - bw / 2) / iw,
+                                   (cyg - bh / 2) / ih,
+                                   (cxg + bw / 2) / iw,
+                                   (cyg + bh / 2) / ih], -1)
+                    boxes.append(b)
+    bx = jnp.stack(boxes, axis=2)  # [fh, fw, nprior, 4]
+    if attrs.get("clip"):
+        bx = bx.clip(0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(attrs["variances"], F32),
+                           bx.shape)
+    return {"Boxes": [bx], "Variances": [var]}
+
+
+# ---------------------------------------------------------------------------
+# batch-size-like fills + frame/overlap_add + complex views
+# ---------------------------------------------------------------------------
+
+@register("fill_constant_batch_size_like", grad=None,
+          attrs={"shape": [], "value": 0.0, "dtype": "float32",
+                 "input_dim_idx": 0, "output_dim_idx": 0})
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    return out(jnp.full(tuple(shape), attrs.get("value", 0.0),
+                        jnp.dtype(attrs.get("dtype", "float32"))))
+
+
+@register("gaussian_random_batch_size_like", grad=None, stochastic=True,
+          attrs={"shape": [], "mean": 0.0, "std": 1.0,
+                 "input_dim_idx": 0, "output_dim_idx": 0, "seed": 0,
+                 "dtype": "float32"})
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = x(ins, "Input")
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ref.shape[attrs.get("input_dim_idx", 0)]
+    key = ctx.rng(attrs)
+    r = attrs.get("mean", 0.0) + attrs.get("std", 1.0) * \
+        jax.random.normal(key, tuple(shape))
+    return out(r.astype(jnp.dtype(attrs.get("dtype", "float32"))))
+
+
+@register("frame", attrs={"frame_length": 1, "hop_length": 1, "axis": -1})
+def _frame(ctx, ins, attrs):
+    v = x(ins)
+    fl = int(attrs["frame_length"])
+    hop = int(attrs["hop_length"])
+    n = v.shape[-1]
+    nf = (n - fl) // hop + 1
+    idx = jnp.arange(fl)[:, None] + hop * jnp.arange(nf)[None, :]
+    return out(v[..., idx])
+
+
+@register("overlap_add", attrs={"hop_length": 1, "axis": -1})
+def _overlap_add(ctx, ins, attrs):
+    v = x(ins)  # [..., frame_length, n_frames]
+    hop = int(attrs["hop_length"])
+    fl, nf = v.shape[-2], v.shape[-1]
+    n = (nf - 1) * hop + fl
+    idx = (jnp.arange(fl)[:, None] + hop * jnp.arange(nf)[None, :])
+    res = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+    return out(res.at[..., idx].add(v))
+
+
+register("complex", lambda ctx, ins, attrs: out(
+    jax.lax.complex(x(ins, "X").astype(F32),
+                    x(ins, "Y").astype(F32))), grad=None)
+register("as_complex", lambda ctx, ins, attrs: out(
+    jax.lax.complex(x(ins)[..., 0], x(ins)[..., 1])), grad=None)
+register("as_real", lambda ctx, ins, attrs: out(
+    jnp.stack([jnp.real(x(ins)), jnp.imag(x(ins))], -1)), grad=None)
+
+
+@register("renorm", attrs={"p": 2.0, "axis": 0, "max_norm": 1.0})
+def _renorm(ctx, ins, attrs):
+    v = x(ins)
+    p = float(attrs.get("p", 2.0))
+    ax = int(attrs.get("axis", 0)) % v.ndim
+    mx = attrs.get("max_norm", 1.0)
+    red = tuple(i for i in range(v.ndim) if i != ax)
+    norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1 / p)
+    scale = jnp.where(norms > mx, mx / jnp.maximum(norms, 1e-12), 1.0)
+    return out(v * scale)

@@ -778,14 +778,17 @@ class LookaheadOptimizer:
             raise NotImplementedError(
                 "static-graph Lookahead: wrap the train loop with "
                 "ExponentialMovingAverage or run dygraph")
-        res = self.inner_optimizer.minimize(
-            loss, parameter_list=parameter_list, no_grad_set=no_grad_set)
         import jax.numpy as jnp
         params = parameter_list or \
             self.inner_optimizer._parameter_list or []
+        # snapshot the slow weights from the INITIAL params (reference
+        # Lookahead: slow state starts at phi_0, not at phi after the
+        # first fast step)
         for p in params:
             if p.name not in self._slow:
                 self._slow[p.name] = jnp.asarray(p._value)
+        res = self.inner_optimizer.minimize(
+            loss, parameter_list=parameter_list, no_grad_set=no_grad_set)
         self._step += 1
         if self._step % self.k == 0:
             for p in params:

@@ -2,10 +2,15 @@
 (reference python/paddle/hapi/model.py:788 fit, :1243 evaluate, :1443
 predict, :1539 save).
 
-One code path serves dygraph networks: train_batch runs the eager tape
-(every op kernel is a jax fn, so XLA still fuses the per-op graphs), and
-`prepare` wires a 2.0 optimizer + loss + paddle.metric metrics. Callbacks
-mirror hapi/callbacks.py (ProgBarLogger, ModelCheckpoint, EarlyStopping).
+Both execution modes serve through ONE surface (reference
+_has_fluid/_run_static split at hapi/model.py:788): in dygraph,
+train_batch runs the eager tape (every op kernel is a jax fn, so XLA
+still fuses the per-op graphs); under paddle.enable_static() at
+prepare() time, the network + loss + optimizer build train/eval/predict
+Programs from the `inputs`/`labels` InputSpecs and batches run through
+the whole-block-jit Executor. `prepare` wires a 2.0 optimizer + loss +
+paddle.metric metrics. Callbacks mirror hapi/callbacks.py (ProgBarLogger,
+ModelCheckpoint, EarlyStopping).
 """
 from __future__ import annotations
 
@@ -46,10 +51,109 @@ class Model:
         self._loss = loss
         ms = metrics or []
         self._metrics = ms if isinstance(ms, (list, tuple)) else [ms]
+        from ..fluid.framework import in_dygraph_mode
+        self._static = not in_dygraph_mode()
+        if self._static:
+            self._build_static()
         return self
+
+    def _build_static(self):
+        """Static-graph mode (reference hapi/model.py static adapter):
+        InputSpecs -> feed vars, the network traces into a Program, the
+        optimizer's minimize builds the train program; eval/predict are
+        test-mode clones taken BEFORE backward ops are appended."""
+        if not self._inputs:
+            raise ValueError("static-mode Model needs inputs=[InputSpec]")
+        from ..fluid import framework, unique_name
+        from ..fluid.executor import Executor
+        from ..fluid.scope import Scope
+
+        def _data(spec):
+            shape = tuple(-1 if d is None else d for d in spec.shape)
+            return framework.default_main_program().current_block() \
+                .create_var(name=spec.name, shape=shape,
+                            dtype=spec.dtype, is_data=True,
+                            stop_gradient=True)
+
+        self._scope = Scope()
+        main, startup = framework.Program(), framework.Program()
+        with framework.program_guard(main, startup), unique_name.guard():
+            # the network's Parameters were registered in the session's
+            # default program at layer construction; declare them here so
+            # the Executor seeds them from the scope (persistable)
+            blk = main.global_block()
+            for p in self.network.parameters():
+                if not blk.has_var(p.name):
+                    blk.create_var(name=p.name,
+                                   shape=tuple(p.shape or ()),
+                                   dtype=str(getattr(p, "dtype", None)
+                                             or "float32"),
+                                   persistable=True)
+            feed_ins = [_data(s) for s in self._inputs]
+            outs = _as_batch_list(self.network(*feed_ins))
+            self._static_fetch_outs = [o.name for o in outs]
+            self._predict_prog = main.clone(for_test=True)
+            loss_var = None
+            lab_vars = []
+            if self._labels:
+                lab_vars = [_data(s) for s in self._labels]
+                if self._loss is not None:
+                    loss_var = self._loss(*outs, *lab_vars)
+            self._eval_prog = main.clone(for_test=True)
+            if loss_var is not None and self._optimizer is not None:
+                self._optimizer.minimize(loss_var)
+        self._train_prog, self._startup_prog = main, startup
+        self._static_loss_name = loss_var.name if loss_var is not None \
+            else None
+        self._feed_names = [s.name for s in self._inputs]
+        self._label_names = [s.name for s in (self._labels or [])]
+        self._exe = Executor()
+        from ..fluid.scope import scope_guard
+        with scope_guard(self._scope):
+            # the network's layers were constructed BEFORE prepare(), so
+            # their parameter-init ops live in the session's default
+            # startup program; run both
+            self._exe.run(framework.default_startup_program())
+            self._exe.run(startup)
+
+    def _static_feed(self, inputs, labels):
+        feed = {n: np.asarray(getattr(v, "numpy", lambda: v)())
+                for n, v in zip(self._feed_names,
+                                _as_batch_list(inputs))}
+        if labels is not None:
+            for n, v in zip(self._label_names, _as_batch_list(labels)):
+                feed[n] = np.asarray(getattr(v, "numpy", lambda: v)())
+        return feed
+
+    def _static_batch(self, prog, inputs, labels, with_loss):
+        from ..fluid.scope import scope_guard
+        fetch = list(self._static_fetch_outs)
+        if with_loss and self._static_loss_name:
+            fetch = [self._static_loss_name] + fetch
+        with scope_guard(self._scope):
+            res = self._exe.run(prog,
+                                feed=self._static_feed(inputs, labels),
+                                fetch_list=fetch)
+        metrics = {}
+        outs = res
+        if with_loss and self._static_loss_name:
+            metrics["loss"] = float(np.ravel(res[0])[0])
+            outs = res[1:]
+        if labels is not None and self._metrics:
+            outs_t = [Tensor(np.asarray(o), stop_gradient=True)
+                      for o in outs]
+            labs_t = [Tensor(np.asarray(getattr(v, "numpy",
+                                                lambda: v)()),
+                             stop_gradient=True)
+                      for v in _as_batch_list(labels)]
+            self._update_metrics(outs_t, labs_t, metrics)
+        return metrics, [np.asarray(o) for o in outs]
 
     # -- per-batch ------------------------------------------------------
     def train_batch(self, inputs, labels=None):
+        if getattr(self, "_static", False):
+            return self._static_batch(self._train_prog, inputs, labels,
+                                      with_loss=True)[0]
         self.network.train()
         ins = [_to_tensor(v) for v in _as_batch_list(inputs)]
         outs = self.network(*ins)
@@ -66,6 +170,10 @@ class Model:
         return metrics
 
     def eval_batch(self, inputs, labels=None):
+        if getattr(self, "_static", False):
+            return self._static_batch(self._eval_prog, inputs, labels,
+                                      with_loss=self._loss is not None
+                                      and labels is not None)[0]
         self.network.eval()
         from ..fluid.dygraph.base import no_grad
         with no_grad():
@@ -81,6 +189,9 @@ class Model:
         return metrics
 
     def predict_batch(self, inputs):
+        if getattr(self, "_static", False):
+            return self._static_batch(self._predict_prog, inputs, None,
+                                      with_loss=False)[1]
         self.network.eval()
         from ..fluid.dygraph.base import no_grad
         with no_grad():

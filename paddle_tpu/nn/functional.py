@@ -16,7 +16,7 @@ __all__ = [
     "mse_loss", "l1_loss", "nll_loss", "kl_div", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "smooth_l1_loss", "one_hot", "pad",
     "label_smooth", "normalize", "sigmoid_focal_loss", "square_error_cost",
-    "log_loss", "margin_ranking_loss", "unfold", "interpolate", "upsample",
+    "log_loss", "margin_ranking_loss", "unfold", "fold", "interpolate", "upsample",
     "conv3d", "max_pool3d", "avg_pool3d", "ctc_loss", "hsigmoid_loss",
 ]
 
@@ -463,19 +463,61 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
 
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
-    raise NotImplementedError("unfold lands with the vision op batch")
+    k = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) \
+        else list(kernel_sizes)
+    s = [strides] * 2 if isinstance(strides, int) else list(strides)
+    d = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    p = [paddings] * 4 if isinstance(paddings, int) else list(paddings)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    return run_op("unfold", {"X": x},
+                  {"kernel_sizes": k, "strides": s, "paddings": p,
+                   "dilations": d}, out_slot="Y")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    k = [kernel_sizes] * 2 if isinstance(kernel_sizes, int) \
+        else list(kernel_sizes)
+    s = [strides] * 2 if isinstance(strides, int) else list(strides)
+    d = [dilations] * 2 if isinstance(dilations, int) else list(dilations)
+    p = [paddings] * 4 if isinstance(paddings, int) else list(paddings)
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    os_ = [output_sizes] * 2 if isinstance(output_sizes, int) \
+        else list(output_sizes)
+    return run_op("fold", {"X": x},
+                  {"output_sizes": os_, "kernel_sizes": k, "strides": s,
+                   "paddings": p, "dilations": d}, out_slot="Y")
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
-    if mode != "nearest":
-        raise NotImplementedError("only nearest interpolation in this build")
-    oh, ow = (int(size[0]), int(size[1])) if size is not None else (-1, -1)
-    return run_op("interp_nearest", {"X": x},
-                  {"out_h": oh, "out_w": ow,
-                   "scale": float(scale_factor or 0.0),
-                   "align_corners": align_corners})
+    mode = mode.lower()
+    if mode == "nearest" and x.ndim == 4:
+        oh, ow = (int(size[0]), int(size[1])) if size is not None \
+            else (-1, -1)
+        return run_op("interp_nearest", {"X": x},
+                      {"out_h": oh, "out_w": ow,
+                       "scale": float(scale_factor or 0.0),
+                       "align_corners": align_corners})
+    op = {"nearest": "nearest_interp_v2", "bilinear": "bilinear_interp_v2",
+          "trilinear": "trilinear_interp_v2",
+          "bicubic": "bicubic_interp_v2"}.get(mode)
+    if op is None:
+        raise ValueError(f"unknown interpolate mode {mode!r}")
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
+    if size is not None:
+        dims = list(int(v) for v in size)
+        if len(dims) == 3:
+            attrs.update(out_d=dims[0], out_h=dims[1], out_w=dims[2])
+        else:
+            attrs.update(out_h=dims[0], out_w=dims[1])
+    else:
+        attrs["scale"] = scale_factor if isinstance(
+            scale_factor, (list, tuple)) else [float(scale_factor)]
+    return run_op(op, {"X": x}, attrs)
 
 
 upsample = interpolate

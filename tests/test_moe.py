@@ -6,6 +6,9 @@ fresh EP design). Runs on the virtual 8-device CPU mesh."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P

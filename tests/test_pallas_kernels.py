@@ -70,6 +70,7 @@ def test_flash_bf16_tolerance():
                                rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_flash_dropout_statistics_and_determinism():
     q, k, v, _ = _qkv(B=1, H=2)
     outs = [flash_attention(q, k, v, dropout_p=0.3, dropout_seed=s,

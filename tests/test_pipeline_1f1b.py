@@ -53,6 +53,7 @@ def _ref_loss_grads(cfg, params, ids, n_micro):
     return jax.value_and_grad(ref_loss)(params)
 
 
+@pytest.mark.slow
 def test_1f1b_matches_single_device_autodiff():
     np.random.seed(0)
     cfg = G.GPTConfig.tiny(num_layers=4, remat=False)

@@ -200,6 +200,7 @@ def _train_small_convnet(qat):
     return model, (pred == lab[:, 0]).mean()
 
 
+@pytest.mark.slow
 def test_imperative_qat_trains_close_to_fp32(tmp_path):
     from paddle_tpu.slim import ImperativeQuantAware
     _, acc_fp32 = _train_small_convnet(None)

@@ -5,6 +5,9 @@ and numerically identical to the plain path."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu import fluid
 from paddle_tpu.fluid import layers

@@ -4,6 +4,9 @@ redesign)."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

@@ -3,6 +3,9 @@ functionalized one-XLA-computation train step."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.models.bert import BertConfig, BertForPretraining, BertModel
 

@@ -4,6 +4,9 @@ train smoke on the virtual mesh (BASELINE config 2)."""
 import numpy as np
 import pytest
 
+# model-scale suite: excluded from the <2-min core lane
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
