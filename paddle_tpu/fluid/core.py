@@ -32,6 +32,37 @@ _DTYPE_ALIASES = {
 }
 
 
+def batched_to_numpy(arrays):
+    """Device→host gather with ONE blocking synchronization.
+
+    The TPU transport in this environment (axon PJRT tunnel) charges one
+    relay round-trip (~100 ms) per *blocked* host read once any D2H
+    transfer has completed in the process — ``np.asarray`` per fetch is
+    N serial RTTs. Starting every copy async and then gathering costs a
+    single RTT for the whole batch (measured: 8 fetches 975 ms → 159 ms).
+
+    Reference bar: the predictor/executor fetch loop is zero-copy per op
+    (/root/reference/paddle/fluid/inference/api/analysis_predictor.h:120);
+    this is the TPU-tunnel equivalent — amortize the sync, not the copy.
+
+    Non-jax entries (numpy arrays, scalars) pass through unchanged.
+    """
+    for a in arrays:
+        if hasattr(a, "copy_to_host_async"):
+            try:
+                a.copy_to_host_async()
+            except Exception:
+                pass  # committed-elsewhere / deleted buffers: asarray below
+    return [np.asarray(a) for a in arrays]
+
+
+def batched_to_numpy_dict(named):
+    """``{name: np.ndarray}`` from ``[(name, device_array), ...]`` with one
+    device synchronization (see batched_to_numpy)."""
+    return dict(zip([n for n, _ in named],
+                    batched_to_numpy([v for _, v in named])))
+
+
 def convert_dtype(dtype: Any) -> str:
     """Normalise any dtype spec (str/np/jnp) to a canonical string."""
     if dtype is None:

@@ -267,17 +267,24 @@ class Executor:
             # whole block is ONE fused computation, so the reference's
             # per-op sweep maps to a per-step output sweep; for op-level
             # isolation run dygraph eager where the tracer checks per op)
-            bad = [n for n, v in
-                   list(zip(fetch_names, fetches))
-                   + list(zip(upd_names, updates))
-                   if jnp.issubdtype(jnp.result_type(v), jnp.floating)
-                   and not bool(jnp.all(jnp.isfinite(v)))]
+            floats = [(n, v) for n, v in
+                      list(zip(fetch_names, fetches))
+                      + list(zip(upd_names, updates))
+                      if jnp.issubdtype(jnp.result_type(v), jnp.floating)]
+            # one stacked device reduction + one host read, not one blocked
+            # fetch per var (~100 ms each through the TPU tunnel)
+            if floats:
+                flags = core.batched_to_numpy([jnp.stack(
+                    [jnp.all(jnp.isfinite(v)) for _, v in floats])])[0]
+                bad = [n for (n, _), ok in zip(floats, flags) if not ok]
+            else:
+                bad = []
             if bad:
                 raise RuntimeError(
                     f"NaN/Inf detected in {bad[:8]} after executor step "
                     f"(FLAGS_check_nan_inf)")
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            return core.batched_to_numpy(fetches)
         return list(fetches)
 
     # -- data-parallel sharding --------------------------------------------

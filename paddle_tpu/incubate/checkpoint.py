@@ -11,7 +11,6 @@ import os
 import pickle
 import tempfile
 
-import numpy as np
 
 __all__ = ["TrainEpochRange", "CheckpointSaver"]
 
@@ -26,14 +25,12 @@ class CheckpointSaver:
         return os.path.join(self.dir, f"ckpt-{no}")
 
     def save_checkpoint(self, program, epoch_no: int, extra: dict | None = None):
+        from ..fluid import core
         from ..fluid.executor import global_scope
         scope = global_scope()
-        blob = {}
-        for v in program.list_vars():
-            if v.persistable:
-                val = scope.find_var(v.name)
-                if val is not None:
-                    blob[v.name] = np.asarray(val)
+        blob = core.batched_to_numpy_dict(
+            [(v.name, val) for v in program.list_vars() if v.persistable
+             and (val := scope.find_var(v.name)) is not None])
         path = self._ckpt_path(epoch_no)
         tmp = tempfile.mkdtemp(dir=self.dir)
         with open(os.path.join(tmp, "params.pkl"), "wb") as f:

@@ -77,8 +77,17 @@ class Config:
         pass
 
 
+def _upcast(a):
+    """Host-side output convention: bf16 compute results surface as f32."""
+    return a.astype(np.float32) if a.dtype.name == "bfloat16" else a
+
+
 class PredictorTensor:
-    """ZeroCopyTensor equivalent: numpy in / numpy out handle."""
+    """ZeroCopyTensor equivalent (reference
+    inference/api/analysis_predictor.h:120 ZeroCopy path): the handle may
+    hold a *device-side* jax array after ``run()``; ``copy_to_cpu`` is the
+    one host synchronization, so a caller that chains predictions and
+    fetches only what it needs never pays a per-step device round-trip."""
 
     def __init__(self, name):
         self.name = name
@@ -88,7 +97,8 @@ class PredictorTensor:
         self._value = np.ascontiguousarray(arr)
 
     def copy_to_cpu(self):
-        return np.asarray(self._value)
+        from ..fluid import core
+        return _upcast(core.batched_to_numpy([self._value])[0])
 
     def reshape(self, shape):
         if self._value is not None:
@@ -176,18 +186,22 @@ class Predictor:
                         if v.dtype == np.float32 else v)
                     for n, v in feed.items()}
         # the executor compiles+caches per input signature — no separate
-        # warmup pass needed
+        # warmup pass needed. Outputs stay DEVICE-SIDE here (ZeroCopyRun
+        # semantics): the handle's copy_to_cpu is the one sync point. The
+        # positional convenience API below converts with a single batched
+        # sync (core.batched_to_numpy) rather than one blocked fetch per
+        # output — on the tunneled TPU runtime each blocked fetch costs a
+        # full relay round-trip (~100 ms, see README "runtime notes").
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=dict(feed),
-                                 fetch_list=self._fetch_names)
-        res = []
+                                 fetch_list=self._fetch_names,
+                                 return_numpy=False)
         for n, v in zip(self._fetch_names, outs):
-            a = np.asarray(v)
-            if a.dtype.name == "bfloat16":
-                a = a.astype(np.float32)
-            self._outputs[n]._value = a
-            res.append(a)
-        return res
+            self._outputs[n]._value = v
+        if inputs is None:
+            return True  # handle-style ZeroCopyRun: fetch via handles
+        from ..fluid import core
+        return [_upcast(a) for a in core.batched_to_numpy(outs)]
 
     def clone(self):
         return Predictor(self._config)

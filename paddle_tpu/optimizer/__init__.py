@@ -136,10 +136,11 @@ class Optimizer:
 
     # -- state ---------------------------------------------------------------
     def state_dict(self):
-        sd = {}
-        for pname, accs in self._accum.items():
-            for aname, val in accs.items():
-                sd[f"{pname}_{aname}"] = np.asarray(val)
+        from ..fluid import core
+        sd = core.batched_to_numpy_dict(
+            [(f"{pname}_{aname}", val)
+             for pname, accs in self._accum.items()
+             for aname, val in accs.items()])
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         if self._static is not None:
