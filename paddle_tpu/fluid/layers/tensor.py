@@ -495,6 +495,30 @@ def _detect_trip_bound(parent, blk, pre, lvs):
     return max(int(-(-(hi - lo) // step)), 0)
 
 
+# ops whose kernels reach outside the device program via io_callback —
+# running them on a masked scan tick still fires the external effect
+_SIDE_EFFECT_OPS = {"send", "recv", "geo_send", "send_barrier",
+                    "fetch_barrier", "py_func", "listen_and_serv"}
+
+
+def _has_side_effect_op(blk, _seen=None):
+    """True if the block or any nested sub-block (cond branches, inner
+    whiles) contains an io_callback-backed op."""
+    _seen = _seen if _seen is not None else set()
+    if id(blk) in _seen:
+        return False
+    _seen.add(id(blk))
+    for op in blk.ops:
+        if op.type in _SIDE_EFFECT_OPS:
+            return True
+        for key in ("sub_block", "sub_block_true", "sub_block_false"):
+            sub = op.attr(key)
+            if sub is not None and hasattr(sub, "ops") \
+                    and _has_side_effect_op(sub, _seen):
+                return True
+    return False
+
+
 def while_loop(cond, body, loop_vars, is_test=False, name=None,
                max_trip_count=None):
     """Functional while (reference layers/control_flow.py while_loop /
@@ -546,6 +570,14 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
     mt = max_trip_count
     if mt is None:
         mt = _detect_trip_bound(parent, blk, pre, lvs)
+        # the masked-scan lowering RUNS the body for every tick and
+        # discards masked results — io_callback-backed ops (PS transport,
+        # host callbacks) would duplicate external effects on masked
+        # ticks. Only lower to masked scan when the caller opted in with
+        # an explicit max_trip_count; auto-detected bounds fall back to
+        # lax.while_loop (forward-only) for side-effecting bodies.
+        if mt and _has_side_effect_op(blk):
+            mt = None
     parent.append_op(
         type="while",
         inputs={"Condition": [pre], "X": [lv.name for lv in lvs],

@@ -448,6 +448,10 @@ def _deformable_conv(ctx, ins, attrs):
     """Deformable conv v2 (reference deformable_conv_op.cu): sample the
     input at offset-shifted taps with bilinear interpolation, modulate
     by the mask, then contract with the filter."""
+    if int(attrs.get("deformable_groups", 1) or 1) != 1:
+        raise NotImplementedError(
+            "deformable_conv: only deformable_groups=1 is implemented "
+            "(the sampler reads one offset group)")
     v = x(ins, "Input")          # [N, C, H, W]
     offset = x(ins, "Offset")    # [N, 2*dg*kh*kw, OH, OW]
     mask = x(ins, "Mask")        # [N, dg*kh*kw, OH, OW] or None
@@ -502,6 +506,72 @@ def _deformable_conv(ctx, ins, attrs):
 # interpolation family (reference interpolate_op.* v1+v2) — jax.image
 # ---------------------------------------------------------------------------
 
+def _interp_axis_nearest(v, axis, out_n, align_corners):
+    """Reference nearest_interp coordinate map (interpolate_op.cc): with
+    align_corners the source index is round(i·(in-1)/(out-1)); without it
+    floor(i·in/out) — NOT jax.image's half-pixel rounding."""
+    in_n = v.shape[axis]
+    i = jnp.arange(out_n, dtype=jnp.float32)
+    if align_corners:
+        idx = jnp.rint(i * ((in_n - 1) / max(out_n - 1, 1)))
+    else:
+        idx = jnp.floor(i * (in_n / out_n))
+    return jnp.take(v, jnp.clip(idx.astype(jnp.int32), 0, in_n - 1),
+                    axis=axis)
+
+
+def _interp_axis_linear(v, axis, out_n, align_corners, align_mode):
+    """1-D linear resample along `axis` with the reference's three
+    coordinate maps: align_corners (i·(in-1)/(out-1)), half-pixel
+    (align_mode=0), asymmetric (align_mode=1, the op default)."""
+    in_n = v.shape[axis]
+    i = jnp.arange(out_n, dtype=jnp.float32)
+    if align_corners:
+        c = i * ((in_n - 1) / max(out_n - 1, 1))
+    elif align_mode == 0:
+        c = jnp.clip((i + 0.5) * (in_n / out_n) - 0.5, 0.0, in_n - 1.0)
+    else:
+        c = jnp.clip(i * (in_n / out_n), 0.0, in_n - 1.0)
+    lo = jnp.floor(c).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, in_n - 1)
+    w = c - lo.astype(jnp.float32)
+    shape = [1] * v.ndim
+    shape[axis] = out_n
+    w = w.reshape(shape)
+    return jnp.take(v, lo, axis=axis) * (1 - w) \
+        + jnp.take(v, hi, axis=axis) * w
+
+
+def _interp_axis_cubic(v, axis, out_n, align_corners):
+    """1-D Keys-cubic (a = -0.75, the reference/torch kernel) resample:
+    4 clamped taps per output point, weights from the source offset."""
+    in_n = v.shape[axis]
+    i = jnp.arange(out_n, dtype=jnp.float32)
+    if align_corners:
+        c = i * ((in_n - 1) / max(out_n - 1, 1))
+    else:
+        c = (i + 0.5) * (in_n / out_n) - 0.5
+    lo = jnp.floor(c)
+    t = c - lo
+    a = -0.75
+
+    def kern(d):
+        ad = jnp.abs(d)
+        return jnp.where(
+            ad <= 1, (a + 2) * ad**3 - (a + 3) * ad**2 + 1,
+            jnp.where(ad < 2, a * ad**3 - 5 * a * ad**2 + 8 * a * ad
+                      - 4 * a, 0.0))
+
+    shape = [1] * v.ndim
+    shape[axis] = out_n
+    acc = 0
+    for k in range(-1, 3):
+        idx = jnp.clip(lo.astype(jnp.int32) + k, 0, in_n - 1)
+        acc = acc + jnp.take(v, idx, axis=axis) \
+            * kern(t - k).reshape(shape)
+    return acc
+
+
 def _interp(method):
     def impl(ctx, ins, attrs):
         v = x(ins)
@@ -521,19 +591,39 @@ def _interp(method):
             if len(scale) == 1:
                 scale = list(scale) * len(sp)
             tgt = tuple(int(round(s * f)) for s, f in zip(sp, scale))
-        meth = {"nearest": "nearest", "bilinear": "linear",
-                "trilinear": "linear", "bicubic": "cubic"}[method]
-        r = jax.image.resize(v, v.shape[:2] + tgt, method=meth)
-        return out(r.astype(v.dtype))
+        axes = list(range(2, v.ndim))
+        if len(tgt) != len(axes):
+            raise ValueError(
+                f"{method}_interp: target size {tgt} has {len(tgt)} dims "
+                f"for input with {len(axes)} spatial dims")
+        ac = bool(attrs.get("align_corners", True))
+        am = int(attrs.get("align_mode", 1))
+        if method == "nearest":
+            # pure gather: no float math on values (int maps stay exact)
+            r = v
+            for ax, n in zip(axes, tgt):
+                r = _interp_axis_nearest(r, ax, int(n), ac)
+            return out(r)
+        dt = v.dtype
+        r = v.astype(jnp.float32)
+        if method in ("bilinear", "trilinear"):
+            for ax, n in zip(axes, tgt):
+                r = _interp_axis_linear(r, ax, int(n), ac, am)
+        else:  # bicubic
+            for ax, n in zip(axes, tgt):
+                r = _interp_axis_cubic(r, ax, int(n), ac)
+        return out(r.astype(dt))
     return impl
 
 
 for _m in ("nearest", "bilinear", "trilinear", "bicubic"):
     for _suffix in ("_interp", "_interp_v2"):
         _name = _m + _suffix
+        # attr defaults mirror the reference op def (interpolate_op.cc:
+        # align_corners defaults TRUE); our python API always passes them
         register(_name, _interp(_m), no_grad_slots=("OutSize", "Scale"),
                  attrs={"out_h": 0, "out_w": 0, "out_d": 0, "scale": [],
-                        "align_corners": False, "align_mode": 1,
+                        "align_corners": True, "align_mode": 1,
                         "data_layout": "NCHW"})
 
 

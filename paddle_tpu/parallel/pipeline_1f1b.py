@@ -206,8 +206,9 @@ def pipeline_1f1b_grads(stage_fn: Callable, last_fn: Callable,
     Args:
       stage_fn: (local_params, x, key) -> (y, aux). One stage's layers.
       last_fn: (local_params, shared_params, x, ids_mb, key)
-        -> (loss_mb, aux). The final stage: layers + head + loss for ONE
-        microbatch (loss_mb is that microbatch's mean loss).
+        -> (y, loss_mb, aux). The final stage: layers + head + loss for
+        ONE microbatch — y is the stage output activation (its cotangent
+        is seeded by the executor), loss_mb that microbatch's mean loss.
       stage_params: pytree, leaves stacked [S, ...], sharded P(axis, ...).
       shared_params: pytree replicated over the pp axis (head/LN weights).
       mb_inputs: [M, mb, T, H] microbatched, pp-replicated activations.

@@ -140,14 +140,20 @@ class ImperativeQuantAware:
         blobs = {}
         for i, layer in enumerate(self._wrapped):
             w = np.asarray(layer.weight._value)
-            axis = 0  # Conv2D OIHW out-channels / Linear rows
+            # per-OUTPUT-channel: Conv2D OIHW axis 0; Linear [in, out]
+            # last axis — mirrors PTQ (slim/quantization.py) and the
+            # reference's quant_axis=1 for mul/matmul weights
+            axis = 0 if w.ndim == 4 else w.ndim - 1
             scales = _channel_scales(w, axis)
             qmax = 2 ** (self._wbits - 1) - 1
-            sh = scales.reshape((-1,) + (1,) * (w.ndim - 1))
+            shp = [1] * w.ndim
+            shp[axis] = -1
+            sh = scales.reshape(shp)
             q = np.clip(np.round(w / np.maximum(sh, 1e-8) * qmax),
                         -qmax, qmax).astype(np.int8)
             blobs[f"w{i}.int8"] = q
             blobs[f"w{i}.scale"] = scales.astype(np.float32)
+            blobs[f"w{i}.axis"] = np.asarray(axis)
         np.savez(path + ".int8.npz", **blobs)
         state = {k: np.asarray(getattr(v, "_value", v))
                  for k, v in model.state_dict().items()}

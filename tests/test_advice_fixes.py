@@ -181,3 +181,42 @@ def test_lookahead_slow_weights_start_at_init():
     la.minimize(loss)
     snap = np.asarray(la._slow[lin.weight.name])
     np.testing.assert_allclose(snap, w0, rtol=0, atol=0)
+
+
+def test_while_loop_side_effect_body_skips_masked_scan(fresh_programs):
+    """Round-4 advisor: an auto-detected trip bound must NOT lower to the
+    masked scan when the body carries io_callback-backed ops (external
+    effects would fire on masked ticks). The guard zeroes max_trip_count
+    so the op takes the lax.while_loop path; an identical loop without
+    the side-effecting op keeps its detected bound."""
+    main, startup, scope = fresh_programs
+
+    def build(with_side_effect):
+        with fluid.program_guard(main, startup):
+            i = layers.fill_constant([1], "int64", 0)
+            acc = layers.fill_constant([1], "float32", 1.0)
+            limit = layers.fill_constant([1], "int64", 4)
+
+            def cond(i, acc):
+                return layers.less_than(i, limit)
+
+            def body(i, acc):
+                doubled = layers.scale(acc, scale=2.0)
+                if with_side_effect:
+                    blk = main.current_block()
+                    blk.append_op(type="py_func", inputs={"X": [doubled]},
+                                  outputs={"Out": [doubled.name]},
+                                  attrs={"_callable": lambda v: v,
+                                         "forward_callable_id": 0})
+                return layers.increment(i), doubled
+
+            layers.while_loop(cond, body, [i, acc])
+        return [op for op in main.current_block().ops
+                if op.type == "while"][-1]
+
+    clean = build(False)
+    assert int(clean.attr("max_trip_count")) == 4, \
+        clean.attr("max_trip_count")
+    dirty = build(True)
+    assert int(dirty.attr("max_trip_count")) == 0, \
+        dirty.attr("max_trip_count")

@@ -139,12 +139,140 @@ def test_gather_tree():
 
 
 def test_interp_bilinear_matches_jax_image():
+    # half-pixel mode (align_corners=False, align_mode=0) == jax.image
     import jax
     x = _r(2, 3, 8, 8)
-    r = np.asarray(run_eager("bilinear_interp_v2", {"X": x},
-                             {"out_h": 16, "out_w": 16})["Out"][0])
+    r = np.asarray(run_eager(
+        "bilinear_interp_v2", {"X": x},
+        {"out_h": 16, "out_w": 16, "align_corners": False,
+         "align_mode": 0})["Out"][0])
     want = np.asarray(jax.image.resize(x, (2, 3, 16, 16), "linear"))
     np.testing.assert_allclose(r, want, rtol=1e-5, atol=1e-5)
+
+
+def _np_bilinear(x, oh, ow, align_corners, align_mode):
+    n, c, h, w = x.shape
+    if align_corners:
+        cy = np.arange(oh) * ((h - 1) / max(oh - 1, 1))
+        cx = np.arange(ow) * ((w - 1) / max(ow - 1, 1))
+    elif align_mode == 0:
+        cy = np.clip((np.arange(oh) + 0.5) * (h / oh) - 0.5, 0, h - 1)
+        cx = np.clip((np.arange(ow) + 0.5) * (w / ow) - 0.5, 0, w - 1)
+    else:
+        cy = np.clip(np.arange(oh) * (h / oh), 0, h - 1)
+        cx = np.clip(np.arange(ow) * (w / ow), 0, w - 1)
+    y0 = np.floor(cy).astype(int); y1 = np.minimum(y0 + 1, h - 1)
+    x0 = np.floor(cx).astype(int); x1 = np.minimum(x0 + 1, w - 1)
+    wy = (cy - y0)[None, None, :, None]
+    wx = (cx - x0)[None, None, None, :]
+    v = x[:, :, y0][:, :, :, x0] * (1 - wy) * (1 - wx) \
+        + x[:, :, y0][:, :, :, x1] * (1 - wy) * wx \
+        + x[:, :, y1][:, :, :, x0] * wy * (1 - wx) \
+        + x[:, :, y1][:, :, :, x1] * wy * wx
+    return v
+
+
+def test_interp_bilinear_align_corners_and_asymmetric():
+    """align_corners=True and align_mode=1 use the reference's coordinate
+    maps (interpolate_op.cc defaults align_corners TRUE), which differ
+    from jax.image's half-pixel — round-4 advisor finding."""
+    x = _r(2, 3, 8, 8)
+    for ac, am in [(True, 1), (False, 1), (True, 0)]:
+        r = np.asarray(run_eager(
+            "bilinear_interp_v2", {"X": x},
+            {"out_h": 13, "out_w": 5, "align_corners": ac,
+             "align_mode": am})["Out"][0])
+        want = _np_bilinear(x, 13, 5, ac, am)
+        np.testing.assert_allclose(r, want, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"ac={ac} am={am}")
+    # align_corners=True endpoint property: corners map exactly
+    r = np.asarray(run_eager(
+        "bilinear_interp_v2", {"X": x},
+        {"out_h": 15, "out_w": 15, "align_corners": True})["Out"][0])
+    np.testing.assert_allclose(r[..., 0, 0], x[..., 0, 0], rtol=1e-6)
+    np.testing.assert_allclose(r[..., -1, -1], x[..., -1, -1], rtol=1e-6)
+
+
+def test_interp_nearest_reference_rounding():
+    x = _r(1, 2, 6, 6)
+    # asymmetric floor (align_corners=False): src = floor(i*in/out)
+    r = np.asarray(run_eager(
+        "nearest_interp_v2", {"X": x},
+        {"out_h": 9, "out_w": 9, "align_corners": False})["Out"][0])
+    idx = np.floor(np.arange(9) * 6 / 9).astype(int)
+    np.testing.assert_allclose(r, x[:, :, idx][:, :, :, idx], rtol=1e-6)
+    # align_corners=True: src = round(i*(in-1)/(out-1))
+    r = np.asarray(run_eager(
+        "nearest_interp_v2", {"X": x},
+        {"out_h": 9, "out_w": 9, "align_corners": True})["Out"][0])
+    idx = np.rint(np.arange(9) * 5 / 8).astype(int)
+    np.testing.assert_allclose(r, x[:, :, idx][:, :, :, idx], rtol=1e-6)
+
+
+def test_interp_bicubic_keys_kernel():
+    """Keys cubic (a=-0.75) reproduces linear ramps exactly and pins
+    corners under align_corners=True."""
+    ramp = (np.arange(8, dtype="float32")[None, None, :, None]
+            + np.arange(8, dtype="float32")[None, None, None, :]
+            ) * np.ones((1, 2, 1, 1), "float32")
+    def np_cubic_1d(v, axis, out_n, ac):
+        in_n = v.shape[axis]
+        i = np.arange(out_n, dtype=np.float64)
+        c = i * ((in_n - 1) / max(out_n - 1, 1)) if ac \
+            else (i + 0.5) * (in_n / out_n) - 0.5
+        lo = np.floor(c)
+        t = c - lo
+        a = -0.75
+
+        def kern(d):
+            ad = np.abs(d)
+            return np.where(
+                ad <= 1, (a + 2) * ad**3 - (a + 3) * ad**2 + 1,
+                np.where(ad < 2, a * ad**3 - 5 * a * ad**2 + 8 * a * ad
+                         - 4 * a, 0.0))
+        shp = [1] * v.ndim
+        shp[axis] = out_n
+        acc = np.zeros(v.shape[:axis] + (out_n,) + v.shape[axis + 1:])
+        for k in range(-1, 3):
+            idx = np.clip(lo.astype(int) + k, 0, in_n - 1)
+            acc += np.take(v, idx, axis=axis) * kern(t - k).reshape(shp)
+        return acc
+
+    for ac in (True, False):
+        r = np.asarray(run_eager(
+            "bicubic_interp_v2", {"X": ramp},
+            {"out_h": 16, "out_w": 16, "align_corners": ac})["Out"][0])
+        want = np_cubic_1d(np_cubic_1d(ramp.astype(np.float64), 2, 16, ac),
+                           3, 16, ac)
+        np.testing.assert_allclose(r, want, atol=1e-4, err_msg=f"ac={ac}")
+    # align_corners=True pins the exact corners
+    r = np.asarray(run_eager(
+        "bicubic_interp_v2", {"X": ramp},
+        {"out_h": 16, "out_w": 16, "align_corners": True})["Out"][0])
+    np.testing.assert_allclose(r[0, 0, 0, 0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(r[0, 0, -1, -1], 14.0, atol=1e-4)
+
+
+def test_interp_nearest_preserves_integer_values():
+    """Nearest is a pure gather: large int64 ids survive exactly (no
+    float32 round-trip)."""
+    big = np.array([[[[2**24 + 1, 2**24 + 3],
+                      [2**24 + 5, 2**24 + 7]]]], dtype=np.int64)
+    r = np.asarray(run_eager(
+        "nearest_interp_v2", {"X": big},
+        {"out_h": 4, "out_w": 4, "align_corners": False})["Out"][0])
+    assert np.issubdtype(r.dtype, np.integer)
+    # 2^24+odd is not float32-representable — a float round-trip would
+    # corrupt these values
+    assert set(np.unique(r)) == set(np.unique(big))
+
+
+def test_interp_rank_mismatch_raises():
+    import pytest
+    x5 = _r(1, 1, 4, 4, 4)
+    with pytest.raises(ValueError):
+        run_eager("trilinear_interp_v2", {"X": x5},
+                  {"scale": [2.0, 2.0], "align_corners": False})
 
 
 def test_sequence_conv_window():
