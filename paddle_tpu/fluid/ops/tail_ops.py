@@ -513,7 +513,9 @@ def _interp_axis_nearest(v, axis, out_n, align_corners):
     in_n = v.shape[axis]
     i = jnp.arange(out_n, dtype=jnp.float32)
     if align_corners:
-        idx = jnp.rint(i * ((in_n - 1) / max(out_n - 1, 1)))
+        # round half UP (reference: static_cast<int>(ratio*i + 0.5)),
+        # not rint's half-to-even
+        idx = jnp.floor(i * ((in_n - 1) / max(out_n - 1, 1)) + 0.5)
     else:
         idx = jnp.floor(i * (in_n / out_n))
     return jnp.take(v, jnp.clip(idx.astype(jnp.int32), 0, in_n - 1),

@@ -495,13 +495,6 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
     mode = mode.lower()
-    if mode == "nearest" and x.ndim == 4:
-        oh, ow = (int(size[0]), int(size[1])) if size is not None \
-            else (-1, -1)
-        return run_op("interp_nearest", {"X": x},
-                      {"out_h": oh, "out_w": ow,
-                       "scale": float(scale_factor or 0.0),
-                       "align_corners": align_corners})
     op = {"nearest": "nearest_interp_v2", "bilinear": "bilinear_interp_v2",
           "trilinear": "trilinear_interp_v2",
           "bicubic": "bicubic_interp_v2"}.get(mode)

@@ -1,0 +1,147 @@
+"""ResNet-50 step-time decomposition on the real chip.
+
+Pure-jax replica of the vision/models resnet50 NHWC trunk with switchable
+BN handling, to locate the HBM traffic (bench.py bench_resnet50 profile):
+  full   — batch-stats BN (training semantics, custom-VJP-free autodiff)
+  fold   — per-channel scale+bias only (no stats passes)
+  none   — conv+relu only
+  fwd    — forward-only variants of the above
+Run: python scripts/resnet_decompose.py
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+BLOCKS = [(3, 64), (4, 128), (6, 256), (3, 512)]
+
+
+def init_params(rng, bn_mode):
+    p = {}
+    def conv(name, kh, kw, cin, cout):
+        p[name + ".w"] = (rng.randn(kh, kw, cin, cout)
+                          * (2.0 / (kh * kw * cin)) ** 0.5).astype(np.float32)
+        if bn_mode != "none":
+            p[name + ".g"] = np.ones((cout,), np.float32)
+            p[name + ".b"] = np.zeros((cout,), np.float32)
+    conv("stem", 7, 7, 3, 64)
+    cin = 64
+    for si, (n, cmid) in enumerate(BLOCKS):
+        cout = cmid * 4
+        for bi in range(n):
+            pre = f"s{si}b{bi}"
+            conv(pre + ".c1", 1, 1, cin, cmid)
+            conv(pre + ".c2", 3, 3, cmid, cmid)
+            conv(pre + ".c3", 1, 1, cmid, cout)
+            if bi == 0:
+                conv(pre + ".ds", 1, 1, cin, cout)
+            cin = cout
+    p["fc.w"] = (rng.randn(2048, 1000) * 0.01).astype(np.float32)
+    p["fc.b"] = np.zeros((1000,), np.float32)
+    return {k: jnp.asarray(v) for k, v in p.items()}
+
+
+def bn(x, g, b, mode):
+    if mode == "none" or g is None:
+        return x
+    if mode == "fold":
+        return x * g.astype(x.dtype) + b.astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    axes = (0, 1, 2)
+    mean = jnp.mean(xf, axes)
+    var = jnp.maximum(jnp.mean(jnp.square(xf), axes) - jnp.square(mean), 0.)
+    inv = jax.lax.rsqrt(var + 1e-5)
+    return ((xf - mean) * (inv * g) + b).astype(x.dtype)
+
+
+def conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride),
+        "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def forward(p, img, mode):
+    x = img.astype(jnp.bfloat16)
+    x = conv(x, p["stem.w"], 2)
+    x = bn(x, p.get("stem.g"), p.get("stem.b"), mode)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for si, (n, cmid) in enumerate(BLOCKS):
+        for bi in range(n):
+            pre = f"s{si}b{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            res = x
+            y = conv(x, p[pre + ".c1.w"])
+            y = jax.nn.relu(bn(y, p.get(pre + ".c1.g"),
+                               p.get(pre + ".c1.b"), mode))
+            y = conv(y, p[pre + ".c2.w"], stride)
+            y = jax.nn.relu(bn(y, p.get(pre + ".c2.g"),
+                               p.get(pre + ".c2.b"), mode))
+            y = conv(y, p[pre + ".c3.w"])
+            y = bn(y, p.get(pre + ".c3.g"), p.get(pre + ".c3.b"), mode)
+            if pre + ".ds.w" in p:
+                res = conv(res, p[pre + ".ds.w"], stride)
+                res = bn(res, p.get(pre + ".ds.g"),
+                         p.get(pre + ".ds.b"), mode)
+            x = jax.nn.relu(y + res)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    return x @ p["fc.w"] + p["fc.b"]
+
+
+def loss_fn(p, img, lab, mode):
+    logits = forward(p, img, mode)
+    lse = jax.nn.logsumexp(logits, -1)
+    return jnp.mean(lse - jnp.take_along_axis(logits, lab, 1)[:, 0])
+
+
+def timeit(f, *args, steps=8, warmup=2):
+    sl = jax.jit(lambda t: jnp.ravel(t)[:1])
+    for _ in range(warmup):
+        r = f(*args)
+    np.asarray(sl(jax.tree_util.tree_leaves(r)[0]))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        r = f(*args)
+    np.asarray(sl(jax.tree_util.tree_leaves(r)[0]))
+    return (time.perf_counter() - t0) / steps
+
+
+def flops_per_img():
+    f = 0
+    hw = 112 * 112
+    f += 2 * 7 * 7 * 3 * 64 * hw
+    cin, hw = 64, 56 * 56
+    for si, (n, cmid) in enumerate(BLOCKS):
+        cout = cmid * 4
+        for bi in range(n):
+            stride = 2 if (bi == 0 and si > 0) else 1
+            hw2 = hw // (stride * stride)
+            f += 2 * cin * cmid * hw          # 1x1
+            f += 2 * 9 * cmid * cmid * hw2    # 3x3
+            f += 2 * cmid * cout * hw2        # 1x1
+            if bi == 0:
+                f += 2 * cin * cout * hw2
+            cin, hw = cout, hw2
+    f += 2 * 2048 * 1000
+    return f
+
+
+if __name__ == "__main__":
+    B = 128
+    rngn = np.random.RandomState(0)
+    img = jnp.asarray(rngn.randn(B, 224, 224, 3).astype(np.float32))
+    lab = jnp.asarray(rngn.randint(0, 1000, (B, 1)))
+    fl = flops_per_img()
+    peak = 197e12
+    print(f"model fwd flops/img: {fl/1e9:.2f} G")
+    for mode in ("full", "fold", "none"):
+        p = init_params(np.random.RandomState(0), mode)
+        g = jax.jit(jax.grad(partial(loss_fn, mode=mode)))
+        f = jax.jit(partial(loss_fn, mode=mode))
+        dt = timeit(f, p, img, lab)
+        dg = timeit(g, p, img, lab)
+        mfu_g = 3 * fl * B / dg / peak
+        print(f"{mode:5s}: fwd {dt*1e3:7.1f} ms   fwd+bwd {dg*1e3:7.1f} ms"
+              f"  -> {B/dg:6.0f} img/s  MFU {mfu_g*100:.1f}%")

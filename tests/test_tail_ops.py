@@ -205,7 +205,20 @@ def test_interp_nearest_reference_rounding():
     r = np.asarray(run_eager(
         "nearest_interp_v2", {"X": x},
         {"out_h": 9, "out_w": 9, "align_corners": True})["Out"][0])
-    idx = np.rint(np.arange(9) * 5 / 8).astype(int)
+    idx = np.floor(np.arange(9) * 5 / 8 + 0.5).astype(int)
+    np.testing.assert_allclose(r, x[:, :, idx][:, :, :, idx], rtol=1e-6)
+
+
+def test_interp_nearest_align_corners_half_rounds_up():
+    """ratio*i landing exactly on .5 must round UP (reference
+    static_cast<int>(x+0.5)), not to-even: in=5 out=9 ac=True has
+    ratio 0.5, so output 1 comes from source 1, not source 0."""
+    x = _r(1, 1, 5, 5)
+    r = np.asarray(run_eager(
+        "nearest_interp_v2", {"X": x},
+        {"out_h": 9, "out_w": 9, "align_corners": True})["Out"][0])
+    idx = np.floor(np.arange(9) * 0.5 + 0.5).astype(int)
+    assert idx[1] == 1  # the half-case
     np.testing.assert_allclose(r, x[:, :, idx][:, :, :, idx], rtol=1e-6)
 
 
