@@ -165,9 +165,23 @@ def bench_bert(cfg_name="base", batch=16, seq=128, steps=32, warmup=3):
     blacklist (the Pallas LN/flash kernels already keep their f32 math
     internal), batch 128. The attention path already runs the Pallas
     flash kernel fwd+bwd; dropout+residual+LN runs the fused Pallas
-    epilogue. Pushing past ~39% requires cutting activation-revisits
-    across the matmul boundaries (fusing the FFN pair into one kernel,
-    i.e. Pallas matmul chains), not better elementwise fusion."""
+    epilogue.
+
+    r05 activation-traffic audit (xplane device trace, b64 s128): the
+    largest non-matmul cost is the FFN gelu tier — 12 fwd
+    `select_convert_fusion`s (erf gelu + saved branch predicate over
+    bf16[64,128,3072]) + 12 bwd partners at ~0.51 ms each ≈ 12 ms of the
+    ~64 ms step (19%). These passes run ~5x above their bandwidth floor,
+    i.e. they are VPU-compute-bound on the erf polynomial, not HBM-bound;
+    notably the f32-erf lowering measured FASTER than bf16-erf (which
+    up-converts with extra selects), so the existing AMP placement is
+    already the fast variant. Eliminating the tier needs the FFN pair
+    fused into one Pallas kernel (intermediate + gelu in VMEM, remat in
+    bwd) — est. ceiling ~+15% step; microbench development for it is
+    blocked by the shared-chip variance (same program measured 1.96 ms
+    to 4.79 ms across minutes), so it must be validated at full-step
+    granularity. With the RTT-clean timing convention the step measures
+    999 samples/s = 35.4% MFU against the r04 39% structural cap."""
     import jax
     from paddle_tpu.jit.functional import make_train_step
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
