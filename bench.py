@@ -332,6 +332,75 @@ def bench_gpt(batch=8, seq=1024, steps=10, warmup=2, dp=1, pp=1, tp=1):
             "dp": dp, "pp": pp, "tp": tp, "device_kind": str(kind)}
 
 
+def bench_gpt_1p3b(batch=1, seq=1024, steps=4, warmup=1):
+    """GPT-3 XL (1.3B params) with per-block remat, ONE chip (the round-4
+    verdict's missing entry). Memory math first: AdamW keeps f32 params +
+    m1 + m2 = 3 x 5.3 GB = 16.0 GB for 1.33B params before grads or
+    activations — against v5e's 16 GB HBM this cannot fit even at
+    batch 1 with remat, so the expected record is the documented-
+    impossible entry with the allocator's own numbers. The 2-way pp or tp
+    split that WOULD fit (8 GB of optimizer state per chip) needs 2
+    physical chips; this environment exposes one (dryrun_multichip
+    validates those meshes on virtual devices instead)."""
+    import jax
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.parallel.hybrid import HybridParallelTrainStep
+    from paddle_tpu.ops.pallas_attention import on_tpu
+
+    cfg = GPTConfig.gpt3_1p3b(amp_dtype="bfloat16",
+                              attn_impl="flash" if on_tpu() else "xla",
+                              remat=True)
+    D, L, V = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    n_params = V * D + cfg.max_position_embeddings * D + 2 * D \
+        + L * (12 * D * D + 13 * D)
+    base = {"metric": "gpt_1p3b_train_tokens_per_sec_per_chip",
+            "unit": "tokens/sec/chip", "batch": batch, "seq": seq,
+            "n_params": n_params, "remat": True}
+    # memory precheck BEFORE paying the (large, doomed) compile: f32
+    # params + AdamW m1/m2 + bf16 grads; v5e HBM = 16 GiB. Verified by
+    # attempting the real compile once in r05: RESOURCE_EXHAUSTED.
+    hbm_gib = float(os.environ.get("TPU_HBM_GIB", 16))
+    need_gib = n_params * (3 * 4 + 2) / 2**30
+    if need_gib > hbm_gib * 0.95:
+        base.update(
+            value=None,
+            impossible_on_1_chip=(
+                f"f32 AdamW master+moments + bf16 grads = {need_gib:.1f} "
+                f"GiB vs {hbm_gib:.0f} GiB HBM; fits under pp=2 or tp=2 "
+                "(needs 2 physical chips, not available here; "
+                "dp2xpp2xtp2 compiles+runs in dryrun_multichip)"))
+        return base
+    try:
+        step = HybridParallelTrainStep(cfg, dp=1, pp=1, tp=1,
+                                       grad_clip_norm=1.0)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+        for _ in range(warmup):
+            loss = step(ids)
+        _sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(ids)
+        dt = _finish_timed(t0, loss)
+        peak, kind = chip_peak_flops()
+        mfu = gpt_train_flops_per_step(cfg, batch, seq) * steps / dt / peak
+        base.update(value=round(batch * seq * steps / dt, 1),
+                    mfu=round(mfu, 4), device_kind=str(kind))
+        return base
+    except Exception as e:
+        msg = str(e)
+        base.update(
+            value=None,
+            impossible_on_1_chip=(
+                "f32 AdamW master+moments alone = "
+                f"{3 * n_params * 4 / 2**30:.1f} GiB vs 16 GiB v5e HBM; "
+                "fits under pp=2 or tp=2 (needs 2 physical chips, not "
+                "available here; dp2xpp2xtp2 compiles+runs in "
+                "dryrun_multichip)"),
+            error=f"{type(e).__name__}: {msg[:180]}")
+        return base
+
+
 def resnet_train_flops_per_step(batch):
     """ResNet-50 224x224 forward = 8.18 GFLOP/image (2 x 4.09 GMACs,
     derived per-layer below); train step = fwd + dX + dW = 3x forward.
@@ -566,6 +635,8 @@ def main():
         rec = bench_widedeep()
     elif which == "infer":
         rec = bench_infer_latency()
+    elif which == "gpt_1p3b":
+        rec = bench_gpt_1p3b()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,
@@ -575,42 +646,54 @@ def main():
         # round's BENCH record carries the whole BASELINE matrix
         if os.environ.get("BENCH_EXTRAS", "1") != "0":
             extras = {}
-            # (name, full-steps runner, reduced-steps runner). Ordered so
-            # BASELINE configs that have never produced a number (widedeep,
-            # infer, flash_attn skipped in r03 on budget) run FIRST; the
-            # well-characterised transformer configs ride in whatever
-            # budget is left with shrunk step counts.
+            # (name, full-steps runner, reduced-steps runner). Every config
+            # records EVERY round (the r4 verdict's completeness bar): the
+            # order rotates by round (round index = count of committed
+            # BENCH_r*.json records) so no config is systematically
+            # starved, and when the budget runs out configs drop to a
+            # minimal 2-step run rather than skipping — 2 steps still
+            # records a real number.
             configs = [
                 ("widedeep",
                  lambda: bench_widedeep(steps=10, warmup=2),
-                 lambda: bench_widedeep(steps=4, warmup=1)),
+                 lambda: bench_widedeep(steps=2, warmup=1)),
                 ("infer_latency",
                  lambda: bench_infer_latency(steps=15, warmup=3),
-                 lambda: bench_infer_latency(steps=6, warmup=2)),
-                ("flash_attn", bench_flash_attn, bench_flash_attn),
+                 lambda: bench_infer_latency(steps=5, warmup=1)),
+                ("flash_attn", bench_flash_attn,
+                 lambda: bench_flash_attn(steps=6, warmup=1)),
                 ("resnet50",
                  lambda: bench_resnet50(steps=8, warmup=2),
-                 lambda: bench_resnet50(steps=4, warmup=1)),
+                 lambda: bench_resnet50(steps=2, warmup=1)),
                 ("bert_base_512",
                  lambda: bench_bert("base_512", batch=16, seq=512,
                                     steps=16, warmup=2),
                  lambda: bench_bert("base_512", batch=16, seq=512,
-                                    steps=6, warmup=1)),
+                                    steps=2, warmup=1)),
                 ("gpt_350m",
                  lambda: bench_gpt(steps=6, warmup=2),
-                 lambda: bench_gpt(steps=3, warmup=1)),
+                 lambda: bench_gpt(steps=2, warmup=1)),
+                ("gpt_1p3b",
+                 lambda: bench_gpt_1p3b(steps=4, warmup=1),
+                 lambda: bench_gpt_1p3b(steps=2, warmup=1)),
             ]
+            try:
+                import glob as _glob
+                rnd = len(_glob.glob(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_r*.json")))
+            except Exception:
+                rnd = 0
+            configs = configs[rnd % len(configs):] \
+                + configs[:rnd % len(configs)]
             budget = float(os.environ.get("BENCH_EXTRAS_BUDGET", 420))
             for i, (name, full, reduced) in enumerate(configs):
                 # wall budget so the driver's bench window is never blown
-                # (each config costs a fresh XLA compile ~20-40s); share
-                # the remaining budget across the configs still queued and
-                # shrink step counts rather than skipping
+                # badly (each config costs a fresh XLA compile ~20-40s);
+                # share the remaining budget across the configs still
+                # queued and shrink step counts rather than skipping
                 left = budget - (time.perf_counter() - _T0)
                 share = left / (len(configs) - i)
-                if left < 20:
-                    extras[name] = {"skipped": f">{budget:.0f}s budget"}
-                    continue
                 try:
                     # a full config costs ~25s compile + ~15s steps; run
                     # full whenever the fair share covers that, reduced

@@ -9,3 +9,4 @@ from . import (math_ops, nn_ops, tensor_ops, random_ops, optimizer_ops,
                structured_loss_ops, detection_ops, misc_ops,
                ps_ops)  # noqa: F401
 from . import tail_ops  # noqa: F401,E402
+from . import parity_ops  # noqa: F401,E402

@@ -1,0 +1,1364 @@
+"""Round-5 op-tail parity sweep: the remaining real gaps between the
+reference `REGISTER_OPERATOR` registry and ours (VERDICT r04 missing #3).
+
+Reference kernel families replaced (one .cc/.cu/.h group each under
+/root/reference/paddle/fluid/operators/): cholesky_op, multiplex_op,
+crop_tensor_op (v1 crop_op too), unpool_op, pool_with_index_op
+(max_pool2d/3d_with_index), gru_op, lstm_op, lstmp_op (monolithic RNN op
+forms over the dense+lengths design), sequence_ops/{sequence_concat,
+sequence_reshape}_op, detection/{sigmoid_focal_loss,yolov3_loss,
+prroi_pool}_op, center_loss_op, bpr_loss_op, hinge_loss_op, log_loss_op,
+cos_sim_op, sample_logits_op, cvm_op, pad_constant_like_op,
+expand_as_op (v1), reverse_op, partial_sum_op, partial_concat_op,
+shuffle_batch_op, minus_op, l1_norm_op, fsp_op, cross_entropy2,
+lod_reset_op, sync_batch_norm_op (GSPMD subsumes the NCCL stats
+exchange), fake int8 {quantize,dequantize,requantize}_op (mkldnn tier's
+schema), deformable_conv_v1, depthwise_conv2d_transpose, batch_fc_op,
+shrink_rnn_memory_op, filter_by_instag_op, correlation_op, inplace_abn,
+save/load(_combine)_op, run_program_op, conditional_block_op,
+split_selected_rows_op, linear_interp(_v2), max_pool3d_with_index.
+
+Dense-over-LoD convention (SURVEY §3): variable-length ops take padded
+[B, T, ...] plus a SeqLen vector where the reference used LoD.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register, same_shape_as
+from .common import x, out
+
+F32 = jnp.float32
+
+
+def _xs(ins, slot="X"):
+    return list(ins.get(slot) or [])
+
+
+# ---------------------------------------------------------------------------
+# small math / tensor ops
+# ---------------------------------------------------------------------------
+
+register("minus",
+         lambda ctx, ins, attrs: out(x(ins, "X") - x(ins, "Y")),
+         infer_shape=same_shape_as("X"))
+
+register("l1_norm",
+         lambda ctx, ins, attrs: out(jnp.sum(jnp.abs(x(ins)))))
+
+
+@register("cholesky", attrs={"upper": False})
+def _cholesky(ctx, ins, attrs):
+    l = jnp.linalg.cholesky(x(ins))
+    if attrs.get("upper"):
+        l = jnp.swapaxes(l, -1, -2)
+    return out(l)
+
+
+@register("multiplex", no_grad_slots=("Ids",))
+def _multiplex(ctx, ins, attrs):
+    """Out[i] = X[Ids[i]][i] (reference multiplex_op.cc)."""
+    ids = x(ins, "Ids").reshape(-1).astype(jnp.int32)
+    stack = jnp.stack(_xs(ins), axis=0)          # [K, N, ...]
+    n = stack.shape[1]
+    return out(stack[ids, jnp.arange(n)])
+
+
+@register("reverse", attrs={"axis": []})
+def _reverse(ctx, ins, attrs):
+    axes = attrs.get("axis") or [0]
+    return out(jnp.flip(x(ins), axis=[int(a) for a in axes]))
+
+
+def _crop_common(v, offsets, shape):
+    # offsets may be traced (dynamic_slice supports that); shape is static
+    shape = [v.shape[i] if s in (-1, 0) and i < v.ndim else int(s)
+             for i, s in enumerate(shape)]
+    return jax.lax.dynamic_slice(v, list(offsets), shape)
+
+
+def _static_ints(t, what):
+    """Shape-determining tensor inputs must be trace-time constants (XLA
+    static shapes); runtime tracers get a clear error, matching the
+    tail_ops.py:284 guard convention."""
+    if isinstance(t, jax.core.Tracer):
+        raise NotImplementedError(
+            f"{what} must be a compile-time constant on TPU (static "
+            "shapes); pass it as an attr or a non-traced tensor")
+    return [int(s) for s in np.asarray(t)]
+
+
+@register("crop", no_grad_slots=("Y", "Offsets"),
+          attrs={"offsets": [], "shape": []})
+def _crop(ctx, ins, attrs):
+    """crop_op.cc. Offsets may be a RUNTIME tensor (lax.dynamic_slice
+    takes traced starts); the output shape must be static."""
+    v = x(ins)
+    ref = x(ins, "Y")
+    shape = list(ref.shape) if ref is not None else attrs["shape"]
+    offs = x(ins, "Offsets")
+    offsets = list(offs.ravel()) if offs is not None \
+        else (attrs["offsets"] or [0] * v.ndim)
+    return out(_crop_common(v, offsets, shape))
+
+
+@register("crop_tensor", no_grad_slots=("Shape", "Offsets"),
+          attrs={"offsets": [], "shape": []})
+def _crop_tensor(ctx, ins, attrs):
+    v = x(ins)
+    st = x(ins, "Shape")
+    shape = _static_ints(st, "crop_tensor Shape") if st is not None \
+        else attrs["shape"]
+    offs = x(ins, "Offsets")
+    if offs is not None:
+        offsets = list(offs.ravel())
+        static_offs = None if isinstance(offs, jax.core.Tracer) \
+            else [int(o) for o in np.asarray(offs)]
+    else:
+        offsets = attrs["offsets"] or [0] * v.ndim
+        static_offs = offsets
+    if any(s == -1 for s in shape):
+        if static_offs is None:
+            raise NotImplementedError(
+                "crop_tensor: shape -1 entries need compile-time "
+                "offsets (static output shapes on TPU)")
+        shape = [v.shape[i] - static_offs[i] if s == -1 else s
+                 for i, s in enumerate(shape)]
+    return out(_crop_common(v, offsets, shape))
+
+
+@register("pad_constant_like", no_grad_slots=("X",),
+          attrs={"pad_value": 0.0})
+def _pad_constant_like(ctx, ins, attrs):
+    big, small = x(ins, "X"), x(ins, "Y")
+    pads = [(0, b - s) for b, s in zip(big.shape, small.shape)]
+    return out(jnp.pad(small, pads,
+                       constant_values=attrs.get("pad_value", 0.0)))
+
+
+@register("expand_as", no_grad_slots=("target_tensor",))
+def _expand_as(ctx, ins, attrs):
+    """v1 expand_as (expand_as_op.cc): tile X to the target's shape —
+    each target dim must be a multiple of X's."""
+    v = x(ins)
+    tgt = ins.get("target_tensor") or ins.get("Y")
+    tshape = tgt[0].shape
+    reps = [t // s for t, s in zip(tshape, v.shape)]
+    return out(jnp.tile(v, reps))
+
+
+@register("partial_sum", attrs={"start_index": 0, "length": -1})
+def _partial_sum(ctx, ins, attrs):
+    """Sum of X[i][:, start:start+length] over the input list
+    (partial_sum_op.cc)."""
+    s = int(attrs.get("start_index", 0))
+    ln = int(attrs.get("length", -1))
+    xs = _xs(ins)
+    e = xs[0].shape[1] if ln < 0 else s + ln
+    return out(sum(v[:, s:e] for v in xs))
+
+
+@register("partial_concat", attrs={"start_index": 0, "length": -1})
+def _partial_concat(ctx, ins, attrs):
+    s = int(attrs.get("start_index", 0))
+    ln = int(attrs.get("length", -1))
+    xs = _xs(ins)
+    e = xs[0].shape[1] if ln < 0 else s + ln
+    return out(jnp.concatenate([v[:, s:e] for v in xs], axis=1))
+
+
+@register("shuffle_batch", no_grad_slots=("Seed",),
+          no_grad_out_slots=("ShuffleIdx", "SeedOut"),
+          attrs={"startup_seed": 0}, stochastic=True)
+def _shuffle_batch(ctx, ins, attrs):
+    """Row shuffle with recorded permutation (shuffle_batch_op.cc).
+    ShuffleIdx lets callers un-shuffle labels the same way. The seed
+    tensor may be a tracer under the jitted executor — PRNGKey accepts
+    traced ints, so the whole path stays jittable."""
+    v = x(ins)
+    sd = x(ins, "Seed")
+    seed = jnp.asarray(sd).ravel()[0].astype(jnp.int32) \
+        if sd is not None \
+        else jnp.int32(attrs.get("startup_seed", 0))
+    perm = jax.random.permutation(jax.random.PRNGKey(seed), v.shape[0])
+    return {"Out": [v[perm]], "ShuffleIdx": [perm.astype(jnp.int64)],
+            "SeedOut": [(seed.astype(jnp.int64) + 1).reshape(1)]}
+
+
+# shuffle_batch's backward (un-permute by ShuffleIdx, reference
+# ShuffleBatchGradOp) falls out of the auto-vjp: the stochastic rng
+# stream replays the same permutation in the grad op, and d(v[perm]) is
+# exactly the scatter-back.
+
+
+@register("fsp")
+def _fsp(ctx, ins, attrs):
+    """FSP (flow of solution procedure) matrix for distillation
+    (fsp_op.cc): Out[n,i,j] = mean_hw X[n,i,h,w] * Y[n,j,h,w]."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    n, c1, h, w = a.shape
+    c2 = b.shape[1]
+    r = jnp.einsum("nihw,njhw->nij", a.astype(F32), b.astype(F32))
+    return out((r / (h * w)).astype(a.dtype))
+
+
+@register("batch_fc")
+def _batch_fc(ctx, ins, attrs):
+    """Per-slot fc (batch_fc_op.cc): Input [S, N, D] x W [S, D, O] + b
+    [S, O] -> [S, N, O]."""
+    v, w, b = x(ins, "Input"), x(ins, "W"), x(ins, "Bias")
+    r = jnp.einsum("snd,sdo->sno", v, w)
+    if b is not None:
+        r = r + b[:, None, :]
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    """loss = max(0, 1 - (2y-1) * logit) (hinge_loss_op.cc)."""
+    logits, y = x(ins, "Logits"), x(ins, "Labels")
+    return out(jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * logits),
+               slot="Loss")
+
+
+@register("log_loss", attrs={"epsilon": 1e-4})
+def _log_loss(ctx, ins, attrs):
+    p, y = x(ins, "Predicted"), x(ins, "Labels")
+    eps = attrs.get("epsilon", 1e-4)
+    return out(-y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps),
+               slot="Loss")
+
+
+@register("bpr_loss", no_grad_slots=("Label",))
+def _bpr_loss(ctx, ins, attrs):
+    """Bayesian personalized ranking (bpr_loss_op.h): per row i with
+    label l: -mean_{j != l} log sigmoid(x_il - x_ij)."""
+    v = x(ins)
+    lab = x(ins, "Label").reshape(-1)
+    n, c = v.shape
+    pos = jnp.take_along_axis(v, lab[:, None].astype(jnp.int32), axis=1)
+    # -log(1 + exp(x_j - x_pos)) summed over j != label
+    t = -jnp.logaddexp(0.0, v - pos)
+    t = jnp.where(jax.nn.one_hot(lab, c, dtype=bool), 0.0, t)
+    return out((-jnp.sum(t, axis=1, keepdims=True) / (c - 1)), slot="Y")
+
+
+@register("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    """cos similarity row-wise; Y may be [1, D] broadcast
+    (cos_sim_op.cc). Outputs XNorm/YNorm for the reference grad."""
+    a, b = x(ins, "X"), x(ins, "Y")
+    xn = jnp.sqrt(jnp.sum(jnp.square(a), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(b), axis=-1, keepdims=True))
+    sim = jnp.sum(a * b, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": [sim], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register("sigmoid_focal_loss", no_grad_slots=("Label", "FgNum"),
+          attrs={"gamma": 2.0, "alpha": 0.25})
+def _sigmoid_focal_loss(ctx, ins, attrs):
+    """detection/sigmoid_focal_loss_op: per-class focal BCE where Label
+    holds 1-based foreground class (0 = background), normalized by the
+    foreground count FgNum."""
+    v = x(ins)                              # [N, C] logits
+    lab = x(ins, "Label").reshape(-1)       # [N] int, 0 = background
+    fg = jnp.maximum(x(ins, "FgNum").reshape(()).astype(F32), 1.0)
+    gamma, alpha = attrs["gamma"], attrs["alpha"]
+    n, c = v.shape
+    # target[i, j] = 1 iff lab[i] == j+1
+    tgt = (lab[:, None] == (jnp.arange(c)[None, :] + 1)).astype(F32)
+    p = jax.nn.sigmoid(v)
+    ce = -(tgt * jax.nn.log_sigmoid(v)
+           + (1 - tgt) * jax.nn.log_sigmoid(-v))
+    w = tgt * alpha * jnp.power(1 - p, gamma) \
+        + (1 - tgt) * (1 - alpha) * jnp.power(p, gamma)
+    return out(w * ce / fg)
+
+
+@register("center_loss",
+          no_grad_slots=("Label", "Centers", "CenterUpdateRate"),
+          no_grad_out_slots=("SampleCenterDiff", "CentersOut"))
+def _center_loss(ctx, ins, attrs):
+    """center_loss_op.h: loss = 0.5 * |x - center[label]|^2; centers
+    updated by the averaged per-class diff * alpha. The auto-vjp of the
+    loss output reproduces the reference backward (dX = dLoss * diff);
+    the stats outputs carry no gradient."""
+    v = x(ins).astype(F32)
+    lab = x(ins, "Label").reshape(-1).astype(jnp.int32)
+    centers = x(ins, "Centers").astype(F32)
+    alpha = x(ins, "CenterUpdateRate").reshape(()).astype(F32)
+    need_update = attrs.get("need_update", True)
+    diff = v - centers[lab]                       # [N, D]
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if need_update:
+        cnum = centers.shape[0]
+        ones = jnp.ones_like(lab, F32)
+        cnt = jnp.zeros((cnum,), F32).at[lab].add(ones) + 1.0
+        acc = jnp.zeros_like(centers).at[lab].add(diff)
+        centers = centers + alpha * acc / cnt[:, None]
+    return {"Loss": [loss], "SampleCenterDiff": [diff],
+            "CentersOut": [centers]}
+
+
+
+
+@register("cross_entropy2", no_grad_slots=("Label",),
+          attrs={"ignore_index": -100})
+def _cross_entropy2(ctx, ins, attrs):
+    """cross_entropy2 (cross_entropy_op.cc second form): hard-label CE
+    over probabilities (not logits), with MatchX/XShape aux outputs."""
+    p = x(ins)
+    lab = x(ins, "Label")
+    ig = attrs.get("ignore_index", -100)
+    li = lab.reshape(lab.shape[0], -1)[:, 0].astype(jnp.int32)
+    match = jnp.take_along_axis(p, li[:, None], axis=1)
+    loss = jnp.where(li[:, None] == ig, 0.0,
+                     -jnp.log(jnp.maximum(match, 1e-20)))
+    return {"Y": [loss], "MatchX": [match],
+            "XShape": [jnp.asarray(p.shape, jnp.int64)]}
+
+
+@register("cvm", no_grad_slots=("CVM",), attrs={"use_cvm": True})
+def _cvm(ctx, ins, attrs):
+    """cvm_op.h: first two columns are show/click counters; use_cvm
+    keeps them log-transformed, otherwise drops them."""
+    v = x(ins)
+    if attrs.get("use_cvm", True):
+        c0 = jnp.log(v[:, :1] + 1.0)
+        c1 = jnp.log(v[:, 1:2] + 1.0) - c0
+        return {"Y": [jnp.concatenate([c0, c1, v[:, 2:]], axis=1)]}
+    return {"Y": [v[:, 2:]]}
+
+
+# ---------------------------------------------------------------------------
+# pooling with indices / unpool / prroi
+# ---------------------------------------------------------------------------
+
+def _adaptive_pool_with_index(v, osize):
+    """Adaptive max pool with argmax: bin i covers
+    [floor(i*H/oh), ceil((i+1)*H/oh)) — membership-mask formulation
+    keeps shapes static for any bin split."""
+    n, c, h, w = v.shape
+    oh, ow = osize
+
+    def masks(inn, onn):
+        i = jnp.arange(onn)
+        lo = (i * inn) // onn
+        hi = -((-(i + 1) * inn) // onn)   # ceil
+        t = jnp.arange(inn)
+        return (t[None, :] >= lo[:, None]) & (t[None, :] < hi[:, None])
+
+    mh = masks(h, oh)                      # [oh, H]
+    mw = masks(w, ow)                      # [ow, W]
+    m = mh[:, None, :, None] & mw[None, :, None, :]  # [oh, ow, H, W]
+    win = jnp.where(m[None, None], v[:, :, None, None, :, :], -jnp.inf)
+    flat = win.reshape(n, c, oh, ow, h * w)
+    idx = jnp.argmax(flat, axis=-1).astype(jnp.int32)
+    return jnp.max(flat, axis=-1), idx
+
+
+def _pool_with_index(v, ksize, strides, paddings, adaptive=False):
+    """[N,C,H,W] max pool returning flat h*w argmax per window
+    (pool_with_index_op.cc convention)."""
+    if adaptive:
+        return _adaptive_pool_with_index(v, ksize) + (ksize[0], ksize[1])
+    n, c, h, w = v.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    # window gather: [N, C, OH, OW, kh, kw]
+    hy = (jnp.arange(oh) * sh - ph)[:, None] + jnp.arange(kh)[None, :]
+    wx = (jnp.arange(ow) * sw - pw)[:, None] + jnp.arange(kw)[None, :]
+    valid = ((hy >= 0) & (hy < h))[:, None, :, None] \
+        & ((wx >= 0) & (wx < w))[None, :, None, :]       # [OH,OW,kh,kw]
+    hyc = jnp.clip(hy, 0, h - 1)
+    wxc = jnp.clip(wx, 0, w - 1)
+    win = v[:, :, hyc[:, None, :, None], wxc[None, :, None, :]]
+    win = jnp.where(valid[None, None], win, -jnp.inf)
+    flat = win.reshape(n, c, oh, ow, kh * kw)
+    arg = jnp.argmax(flat, axis=-1)
+    mx = jnp.max(flat, axis=-1)
+    ky, kx = arg // kw, arg % kw
+    # absolute index = hy*w + wx at the argmax tap
+    ay = (jnp.arange(oh) * sh - ph)[None, None, :, None] + ky
+    ax = (jnp.arange(ow) * sw - pw)[None, None, None, :] + kx
+    idx = (ay * w + ax).astype(jnp.int32)
+    return mx, idx, oh, ow
+
+
+@register("max_pool2d_with_index", no_grad_out_slots=("Mask",),
+          attrs={"ksize": [2, 2], "strides": [1, 1], "paddings": [0, 0],
+                 "global_pooling": False, "adaptive": False})
+def _max_pool2d_with_index(ctx, ins, attrs):
+    v = x(ins)
+    ks = list(attrs["ksize"])
+    if attrs.get("global_pooling"):
+        ks = [v.shape[2], v.shape[3]]
+    mx, idx, _, _ = _pool_with_index(
+        v, ks, attrs["strides"], attrs["paddings"],
+        adaptive=attrs.get("adaptive", False))
+    return {"Out": [mx], "Mask": [idx]}
+
+
+@register("max_pool3d_with_index", no_grad_out_slots=("Mask",),
+          attrs={"ksize": [2, 2, 2], "strides": [1, 1, 1],
+                 "paddings": [0, 0, 0], "global_pooling": False,
+                 "adaptive": False})
+def _max_pool3d_with_index(ctx, ins, attrs):
+    v = x(ins)   # [N, C, D, H, W]
+    n, c, d, h, w = v.shape
+    kd, kh, kw = (attrs["ksize"] if not attrs.get("global_pooling")
+                  else [d, h, w])
+    sd, sh, sw = attrs["strides"]
+    pd, ph, pw = attrs["paddings"]
+    od = (d + 2 * pd - kd) // sd + 1
+    # 2-D pool every depth slice, then a 1-D max over the depth window;
+    # the flat 3-D index is d*h*w + (2-D index)
+    mx2, idx2, oh, ow = _pool_with_index(
+        v.reshape(n, c * d, h, w), [kh, kw], [sh, sw], [ph, pw])
+    mx2 = mx2.reshape(n, c, d, oh, ow)
+    idx2 = idx2.reshape(n, c, d, oh, ow)
+    dz = (jnp.arange(od) * sd - pd)[:, None] + jnp.arange(kd)[None, :]
+    validz = (dz >= 0) & (dz < d)
+    dzc = jnp.clip(dz, 0, d - 1)
+    win = mx2[:, :, dzc]                       # [N, C, od, kd, oh, ow]
+    win = jnp.where(validz[None, None, :, :, None, None], win, -jnp.inf)
+    argd = jnp.argmax(win, axis=3)             # [N, C, od, oh, ow]
+    mx = jnp.max(win, axis=3)
+    dsel = dzc[jnp.arange(od)[None, None, :, None, None], argd]
+    idx = dsel * (h * w) + jnp.take_along_axis(idx2, dsel, axis=2)
+    return {"Out": [mx], "Mask": [idx.astype(jnp.int32)]}
+
+
+@register("unpool", no_grad_slots=("Indices",),
+          attrs={"unpooling_type": "max", "ksize": [2, 2],
+                 "strides": [2, 2], "paddings": [0, 0],
+                 "output_size": []})
+def _unpool(ctx, ins, attrs):
+    """unpool_op.cc: scatter pooled values back to the argmax positions
+    recorded by max_pool2d_with_index."""
+    v, idx = x(ins), x(ins, "Indices")
+    n, c, h, w = v.shape
+    osz = attrs.get("output_size") or []
+    if len(osz) >= 2 and osz[-2] > 0:
+        oh, ow = int(osz[-2]), int(osz[-1])
+    else:
+        sh, sw = attrs["strides"]
+        kh, kw = attrs["ksize"]
+        oh = (h - 1) * sh - 2 * attrs["paddings"][0] + kh
+        ow = (w - 1) * sw - 2 * attrs["paddings"][1] + kw
+    flat = jnp.zeros((n, c, oh * ow), v.dtype)
+    r = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(v.reshape(n, c, -1))
+    return out(r.reshape(n, c, oh, ow))
+
+
+@register("prroi_pool", no_grad_slots=("ROIs", "BatchRoINums"),
+          attrs={"spatial_scale": 1.0, "pooled_height": 1,
+                 "pooled_width": 1})
+def _prroi_pool(ctx, ins, attrs):
+    """Precise RoI pooling (detection/prroi_pool_op): exact integral of
+    the bilinearly-interpolated feature over each bin (no sampling
+    points). Computed per (bin, feature-pixel) overlap weights — the
+    closed form of the PrRoIPooling integral."""
+    feat = x(ins)                         # [N, C, H, W]
+    rois = x(ins, "ROIs")                 # [R, 4] (x1,y1,x2,y2)
+    n, c, h, w = feat.shape
+    scale = attrs["spatial_scale"]
+    ph_, pw_ = attrs["pooled_height"], attrs["pooled_width"]
+    bi = x(ins, "BatchRoINums")           # [N] rois per image
+    if bi is not None:
+        # roi r belongs to image i where cumsum(bi) first exceeds r —
+        # searchsorted keeps shapes static so this jits
+        bounds = jnp.cumsum(bi.astype(jnp.int32))
+        roi_batch = jnp.searchsorted(
+            bounds, jnp.arange(rois.shape[0], dtype=jnp.int32),
+            side="right").astype(jnp.int32)
+    else:
+        roi_batch = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    ih = jnp.arange(h, dtype=F32)
+    iw = jnp.arange(w, dtype=F32)
+
+    def one(roi, b):
+        x1, y1, x2, y2 = [r * scale for r in roi]
+        bh = jnp.maximum((y2 - y1) / ph_, 1e-6)
+        bw = jnp.maximum((x2 - x1) / pw_, 1e-6)
+        # integral of the bilinear interpolant over [a, b] in 1-D
+        # decomposes into per-source-pixel triangular-kernel overlap
+        # weights: w_i = integral over bin of max(0, 1 - |t - i|) dt
+        def wts(lo, hi, grid, size):
+            # antiderivative of the hat function around center i
+            def F(t, i):
+                u = t - i
+                return jnp.where(
+                    u <= -1, 0.0,
+                    jnp.where(u <= 0, 0.5 * (u + 1) ** 2,
+                              jnp.where(u <= 1, 0.5 + u - 0.5 * u * u,
+                                        1.0)))
+            return F(hi[:, None], grid[None, :]) \
+                - F(lo[:, None], grid[None, :])   # [bins, size]
+        ylo = y1 + jnp.arange(ph_, dtype=F32) * bh
+        xlo = x1 + jnp.arange(pw_, dtype=F32) * bw
+        wy = wts(ylo, ylo + bh, ih, h)            # [ph, H]
+        wx = wts(xlo, xlo + bw, iw, w)            # [pw, W]
+        f = feat[b].astype(F32)                    # [C, H, W]
+        s = jnp.einsum("ph,chw,qw->cpq", wy, f, wx)
+        return s / (bh * bw)
+
+    r = jax.vmap(one)(rois.astype(F32), roi_batch.astype(jnp.int32))
+    return out(r)
+
+
+# ---------------------------------------------------------------------------
+# monolithic RNN op forms (gru_op.cc, lstm_op.cc, lstmp_op.cc)
+#
+# Dense convention: Input is the pre-projected gate tensor [B, T, G*D]
+# (the reference feeds LoD-packed x@Wx through a preceding mul op — same
+# contract), Weight is the recurrent weight, outputs are [B, T, D].
+# ---------------------------------------------------------------------------
+
+_ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
+         "identity": lambda v: v}
+
+
+@register("gru", no_grad_slots=("SeqLen",),
+          attrs={"activation": "tanh", "gate_activation": "sigmoid",
+                 "is_reverse": False, "origin_mode": False})
+def _gru(ctx, ins, attrs):
+    """GRU over dense [B, T, 3D] gate inputs (gru_op.cc + math/detail/
+    gru_kernel.h). Gate layout [update, reset, candidate]; Weight [D, 3D]
+    packs W_uz|W_r (first 2D) and W_c (last D)."""
+    g = x(ins, "Input")
+    w = x(ins, "Weight")
+    b = x(ins, "Bias")
+    h0 = x(ins, "H0")
+    act = _ACTS[attrs.get("activation", "tanh")]
+    gact = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    origin = attrs.get("origin_mode", False)
+    B, T, G = g.shape
+    D = G // 3
+    if b is not None:
+        g = g + b.reshape(1, 1, G)
+    if attrs.get("is_reverse"):
+        g = jnp.flip(g, axis=1)
+    hprev = h0 if h0 is not None else jnp.zeros((B, D), g.dtype)
+
+    wur, wc = w[:, :2 * D], w[:, 2 * D:]
+
+    def step(h, gt):
+        ur = gact(gt[:, :2 * D] + h @ wur)
+        u, r = ur[:, :D], ur[:, D:]
+        c = act(gt[:, 2 * D:] + (r * h) @ wc)
+        h2 = u * h + c - u * c if origin else h - u * h + u * c
+        return h2, h2
+
+    _, hs = jax.lax.scan(step, hprev, jnp.swapaxes(g, 0, 1))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if attrs.get("is_reverse"):
+        hs = jnp.flip(hs, axis=1)
+    return {"Hidden": [hs]}
+
+
+def _lstm_scan(g, h0, c0, w, proj, use_peepholes, checks, acts, clip=0.0):
+    B, T, G = g.shape
+    D = G // 4
+    act_c, act_g, act_s = acts
+    P = proj.shape[1] if proj is not None else D
+    h = h0 if h0 is not None else jnp.zeros((B, P), g.dtype)
+    c = c0 if c0 is not None else jnp.zeros((B, D), g.dtype)
+    ci, cf, co = checks
+
+    def step(carry, gt):
+        h, c = carry
+        gt = gt + h @ w                       # recurrent term
+        cin = act_c(gt[:, :D])                # candidate first (lstm_kernel.h)
+        ig = act_g(gt[:, D:2 * D] + (c * ci if ci is not None else 0.0))
+        fg = act_g(gt[:, 2 * D:3 * D] + (c * cf if cf is not None else 0.0))
+        c2 = cin * ig + c * fg
+        if clip > 0.0:
+            c2 = jnp.clip(c2, -clip, clip)
+        og = act_g(gt[:, 3 * D:] + (c2 * co if co is not None else 0.0))
+        h2 = og * act_s(c2)
+        if proj is not None:
+            h2 = h2 @ proj
+        return (h2, c2), (h2, c2)
+
+    _, (hs, cs) = jax.lax.scan(step, (h, c), jnp.swapaxes(g, 0, 1))
+    return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+
+def _lstm_common(ins, attrs, with_proj):
+    g = x(ins, "Input")                       # [B, T, 4D]
+    w = x(ins, "Weight")                      # [P|D, 4D]
+    b = x(ins, "Bias")
+    h0, c0 = x(ins, "H0"), x(ins, "C0")
+    proj = x(ins, "ProjWeight") if with_proj else None
+    B, T, G = g.shape
+    D = G // 4
+    use_peep = attrs.get("use_peepholes", False)
+    checks = (None, None, None)
+    if b is not None:
+        g = g + b[..., :4 * D].reshape(1, 1, 4 * D)
+        if use_peep and b.size >= 7 * D:
+            flat = b.reshape(-1)
+            checks = (flat[4 * D:5 * D], flat[5 * D:6 * D],
+                      flat[6 * D:7 * D])
+    acts = (_ACTS[attrs.get("candidate_activation", "tanh")],
+            _ACTS[attrs.get("gate_activation", "sigmoid")],
+            _ACTS[attrs.get("cell_activation", "tanh")])
+    if attrs.get("is_reverse"):
+        g = jnp.flip(g, axis=1)
+    hs, cs = _lstm_scan(g, h0, c0, w, proj, use_peep, checks, acts,
+                        attrs.get("cell_clip", 0.0))
+    if attrs.get("is_reverse"):
+        hs, cs = jnp.flip(hs, axis=1), jnp.flip(cs, axis=1)
+    return hs, cs
+
+
+@register("lstm", no_grad_slots=("SeqLen",),
+          attrs={"use_peepholes": False, "is_reverse": False,
+                 "gate_activation": "sigmoid",
+                 "cell_activation": "tanh",
+                 "candidate_activation": "tanh", "cell_clip": 0.0})
+def _lstm(ctx, ins, attrs):
+    """Monolithic LSTM (lstm_op.cc): gate layout [candidate, input,
+    forget, output] with optional peephole weights packed after the 4D
+    bias (math/detail/lstm_kernel.h)."""
+    hs, cs = _lstm_common(ins, attrs, with_proj=False)
+    return {"Hidden": [hs], "Cell": [cs]}
+
+
+@register("lstmp", no_grad_slots=("SeqLen",),
+          attrs={"use_peepholes": False, "is_reverse": False,
+                 "gate_activation": "sigmoid",
+                 "cell_activation": "tanh",
+                 "candidate_activation": "tanh",
+                 "proj_activation": "identity", "cell_clip": 0.0,
+                 "proj_clip": 0.0})
+def _lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (lstmp_op.cc): h_t = act_p(
+    o*act(c)) @ ProjWeight feeds back as the recurrent state."""
+    hs, cs = _lstm_common(ins, attrs, with_proj=True)
+    pact = _ACTS[attrs.get("proj_activation", "identity")]
+    hs = pact(hs)
+    pc = attrs.get("proj_clip", 0.0)
+    if pc > 0.0:
+        hs = jnp.clip(hs, -pc, pc)
+    return {"Projection": [hs], "Cell": [cs]}
+
+
+@register("shrink_rnn_memory", no_grad_slots=("RankTable", "I"),
+          attrs={})
+def _shrink_rnn_memory(ctx, ins, attrs):
+    """shrink_rnn_memory_op.cc: keep the first K rows, where K comes from
+    the rank table at step I — dense form: K passed via the RankTable
+    vector (sorted sequence lengths). The output SHAPE depends on the
+    data, so I/RankTable must be trace-time constants on TPU (the dense
+    StaticRNN path never emits this op; it exists for deserialized
+    reference graphs run eagerly)."""
+    v = x(ins)
+    iv, tbl = x(ins, "I"), x(ins, "RankTable")
+    if isinstance(iv, jax.core.Tracer) or isinstance(tbl, jax.core.Tracer):
+        raise NotImplementedError(
+            "shrink_rnn_memory produces a data-dependent shape — not "
+            "expressible in a jitted XLA program; run the block eagerly "
+            "(dygraph) or use the StaticRNN/scan lowering instead")
+    i = int(np.asarray(iv).ravel()[0])
+    k = int((np.asarray(tbl).ravel() > i).sum())
+    return out(v[:max(k, 1)])
+
+
+# ---------------------------------------------------------------------------
+# sequence tail (dense + SeqLen design)
+# ---------------------------------------------------------------------------
+
+@register("sequence_concat", no_grad_slots=("SeqLen",))
+def _sequence_concat(ctx, ins, attrs):
+    """sequence_ops/sequence_concat_op: concatenate the VALID prefixes of
+    each input sequence per row; dense form packs the result and returns
+    the combined lengths."""
+    xs = _xs(ins)
+    lens = list(ins.get("SeqLen") or [])
+    if not lens:
+        return {"Out": [jnp.concatenate(xs, axis=1)],
+                "SeqLenOut": [jnp.asarray(
+                    [sum(v.shape[1] for v in xs)] * xs[0].shape[0],
+                    jnp.int64)]}
+    B = xs[0].shape[0]
+    Ttot = sum(v.shape[1] for v in xs)
+    total = sum(
+        (l.astype(jnp.int32) for l in lens),
+        jnp.zeros((B,), jnp.int32))
+    # scatter each input's valid prefix to offset[k] + t, where offset[k]
+    # is the running sum of earlier inputs' valid lengths; invalid slots
+    # target index Ttot, which mode="drop" discards
+    D = xs[0].shape[2:]
+    flat = jnp.zeros((B, Ttot) + D, xs[0].dtype)
+    offs = jnp.zeros((B,), jnp.int32)
+    for v, ln in zip(xs, lens):
+        T = v.shape[1]
+        t = jnp.arange(T)[None, :]
+        valid = t < ln.astype(jnp.int32)[:, None]
+        tgt = jnp.where(valid, offs[:, None] + t, Ttot)
+        flat = flat.at[jnp.arange(B)[:, None], tgt].set(v, mode="drop")
+        offs = offs + ln.astype(jnp.int32)
+    return {"Out": [flat], "SeqLenOut": [total.astype(jnp.int64)]}
+
+
+@register("sequence_reshape", no_grad_slots=("SeqLen",),
+          attrs={"new_dim": 1})
+def _sequence_reshape(ctx, ins, attrs):
+    """sequence_ops/sequence_reshape_op: re-chunk each sequence's
+    elements into rows of new_dim. Dense form: valid data is contiguous
+    per row, so [B, T, D] -> [B, T*D/new, new] with lengths scaled."""
+    v = x(ins)
+    new = int(attrs["new_dim"])
+    B, T, D = v.shape
+    assert (T * D) % new == 0, "sequence_reshape: indivisible new_dim"
+    r = v.reshape(B, T * D // new, new)
+    ln = x(ins, "SeqLen")
+    outs = {"Out": [r]}
+    if ln is not None:
+        outs["SeqLenOut"] = [(ln * D // new).astype(jnp.int64)]
+    return outs
+
+
+@register("lod_reset", no_grad_slots=("Y",), attrs={"target_lod": []})
+def _lod_reset(ctx, ins, attrs):
+    """lod_reset_op: data passes through; the length metadata is
+    replaced (dense design: lengths ride as a separate output)."""
+    v = x(ins)
+    y = x(ins, "Y")
+    tgt = attrs.get("target_lod") or []
+    if y is not None:
+        lens = y.astype(jnp.int64)
+    else:
+        lod = np.asarray(tgt, np.int64)
+        lens = jnp.asarray(np.diff(lod) if lod.ndim == 1 and len(lod) > 1
+                           else lod)
+    return {"Out": [v], "SeqLenOut": [lens]}
+
+
+@register("filter_by_instag", grad=None,
+          no_grad_slots=("Ins_tag", "Filter_tag"),
+          attrs={"is_lod": True, "out_val_if_empty": 0})
+def _filter_by_instag(ctx, ins, attrs):
+    """filter_by_instag_op: keep rows whose tag set intersects the
+    filter tags; dense form returns the filtered rows compacted to the
+    front (zero-padded), a row map, and the loss weight."""
+    v = x(ins, "Ins")
+    tags = x(ins, "Ins_tag")           # [N, K] int64 (padded with -1)
+    filt = x(ins, "Filter_tag")        # [F]
+    hit = (tags[:, :, None] == filt[None, None, :]).any(axis=(1, 2))
+    n = v.shape[0]
+    order = jnp.argsort(~hit, stable=True)      # kept rows first
+    kept = hit.sum()
+    rows = v[order]
+    keep_mask = (jnp.arange(n) < kept)
+    rows = jnp.where(keep_mask.reshape((-1,) + (1,) * (v.ndim - 1)),
+                     rows, attrs.get("out_val_if_empty", 0))
+    idx = jnp.where(keep_mask, order, -1)
+    w = keep_mask.astype(F32)[:, None]
+    return {"Out": [rows], "LossWeight": [w],
+            "IndexMap": [idx.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# sampled softmax helper (sample_logits_op)
+# ---------------------------------------------------------------------------
+
+@register("sample_logits",
+          no_grad_slots=("Labels", "CustomizedSamples",
+                         "CustomizedProbabilities"),
+          no_grad_out_slots=("Samples", "Probabilities", "SampledLabels",
+                             "LogitsDim", "LabelsDim"),
+          stochastic=True,
+          attrs={"use_customized_samples": False, "uniq": True,
+                 "remove_accidental_hits": True, "num_samples": 1,
+                 "seed": 0})
+def _sample_logits(ctx, ins, attrs):
+    """sample_logits_op.h: gather label logits + num_samples log-uniform
+    negative samples per row; sampled logits are corrected by -log(prob)
+    (sampled-softmax bias correction) and accidental hits masked."""
+    logits = x(ins, "Logits")               # [N, C]
+    labels = x(ins, "Labels")               # [N, NT]
+    n, c = logits.shape
+    nt = labels.shape[1]
+    s = int(attrs["num_samples"])
+    if attrs.get("use_customized_samples"):
+        samples = x(ins, "CustomizedSamples")
+        probs = x(ins, "CustomizedProbabilities")
+    else:
+        # fresh negatives every call: fold the static seed into the
+        # step's RNG stream (ctx.rng varies per step/op)
+        key = jax.random.fold_in(ctx.rng(attrs),
+                                 int(attrs.get("seed", 0)))
+        # log-uniform (Zipf) sampler, the reference's LogUniformSampler
+        u = jax.random.uniform(key, (n, s))
+        neg = (jnp.exp(u * jnp.log(float(c + 1))) - 1.0).astype(jnp.int64)
+        neg = jnp.clip(neg, 0, c - 1)
+        samples = jnp.concatenate([labels.astype(jnp.int64), neg], axis=1)
+        p = (jnp.log((samples + 2.0) / (samples + 1.0))
+             / jnp.log(float(c + 1)))
+        probs = p
+    si = samples.astype(jnp.int32)
+    sl = jnp.take_along_axis(logits, si, axis=1)
+    sl = sl - jnp.log(jnp.maximum(probs.astype(F32), 1e-20))
+    if attrs.get("remove_accidental_hits", True):
+        # a negative that equals one of the row's true labels is masked
+        neg_part = samples[:, nt:]
+        acc = (neg_part[:, :, None] == labels[:, None, :]).any(-1)
+        sl = sl.at[:, nt:].add(jnp.where(acc, -1e20, 0.0))
+    sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int64)[None, :],
+                              (n, 1))
+    return {"Samples": [samples], "Probabilities": [probs],
+            "SampledLogits": [sl], "SampledLabels": [sampled_labels],
+            "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
+            "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# yolov3_loss (detection/yolov3_loss_op.h) — vectorised re-derivation
+# ---------------------------------------------------------------------------
+
+def _box_iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+    l1, r1 = x1 - w1 / 2, x1 + w1 / 2
+    l2, r2 = x2 - w2 / 2, x2 + w2 / 2
+    t1, b1 = y1 - h1 / 2, y1 + h1 / 2
+    t2, b2 = y2 - h2 / 2, y2 + h2 / 2
+    iw = jnp.minimum(r1, r2) - jnp.maximum(l1, l2)
+    ih = jnp.minimum(b1, b2) - jnp.maximum(t1, t2)
+    inter = jnp.where((iw > 0) & (ih > 0), iw * ih, 0.0)
+    union = w1 * h1 + w2 * h2 - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _sce(logit, tgt):
+    # SigmoidCrossEntropy of the reference helpers
+    return jnp.maximum(logit, 0.0) - logit * tgt \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+@register("yolov3_loss", grad="auto",
+          no_grad_slots=("GTBox", "GTLabel", "GTScore"),
+          no_grad_out_slots=("ObjectnessMask", "GTMatchMask"),
+          attrs={"anchors": [], "anchor_mask": [], "class_num": 1,
+                 "ignore_thresh": 0.7, "downsample_ratio": 32,
+                 "use_label_smooth": True, "scale_x_y": 1.0})
+def _yolov3_loss(ctx, ins, attrs):
+    """YOLOv3 loss (detection/yolov3_loss_op.h), vectorised: per-cell
+    best-IoU ignore mask, per-gt best-anchor positive matching, box
+    location SCE/L1, objectness SCE and per-class SCE — autodiff
+    replaces the hand-written grad kernel (the stats outputs are
+    stop-gradiented)."""
+    v = x(ins).astype(F32)                 # [N, C, H, W]
+    gtbox = x(ins, "GTBox").astype(F32)    # [N, B, 4] cx,cy,w,h in [0,1]
+    gtlab = x(ins, "GTLabel").astype(jnp.int32)     # [N, B]
+    gts = x(ins, "GTScore")
+    anchors = list(attrs["anchors"])
+    amask = list(attrs["anchor_mask"])
+    cnum = int(attrs["class_num"])
+    ignore = float(attrs["ignore_thresh"])
+    down = int(attrs["downsample_ratio"])
+    smooth = attrs.get("use_label_smooth", True)
+    scale = float(attrs.get("scale_x_y", 1.0))
+    bias = -0.5 * (scale - 1.0)
+    n, c, h, w = v.shape
+    m = len(amask)
+    bnum = gtbox.shape[1]
+    input_size = down * h
+    an_num = len(anchors) // 2
+    if gts is None:
+        gts = jnp.ones((n, bnum), F32)
+    gts = gts.astype(F32)
+    pos_lab, neg_lab = 1.0, 0.0
+    if smooth:
+        sw = min(1.0 / cnum, 1.0 / 40)
+        pos_lab, neg_lab = 1.0 - sw, sw
+
+    # reshape predictions to [N, m, 5+cnum, H, W]
+    p = v.reshape(n, m, 5 + cnum, h, w)
+    gx = (jnp.arange(w, dtype=F32)[None, None, None, :]
+          + jax.nn.sigmoid(p[:, :, 0]) * scale + bias) / w
+    gy = (jnp.arange(h, dtype=F32)[None, None, :, None]
+          + jax.nn.sigmoid(p[:, :, 1]) * scale + bias) / h
+    aw = jnp.asarray([anchors[2 * i] for i in amask], F32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in amask], F32)
+    gw = jnp.exp(p[:, :, 2]) * aw[None, :, None, None] / input_size
+    gh = jnp.exp(p[:, :, 3]) * ah[None, :, None, None] / input_size
+
+    valid = (gtbox[:, :, 2] > 0) & (gtbox[:, :, 3] > 0)   # [N, B]
+    # per-cell best IoU against every valid gt -> ignore mask
+    iou = _box_iou_xywh(
+        gx[:, :, :, :, None], gy[:, :, :, :, None],
+        gw[:, :, :, :, None], gh[:, :, :, :, None],
+        gtbox[:, None, None, None, :, 0], gtbox[:, None, None, None, :, 1],
+        gtbox[:, None, None, None, :, 2], gtbox[:, None, None, None, :, 3])
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1)                      # [N, m, H, W]
+    # objness mask: -1 = ignored, 0 = negative, score = positive
+    obj_mask = jnp.where(best_iou > ignore, -1.0, 0.0)
+
+    # per-gt best anchor over ALL anchors (shape-only IoU at origin)
+    aw_all = jnp.asarray(anchors[0::2], F32) / input_size
+    ah_all = jnp.asarray(anchors[1::2], F32) / input_size
+    g0 = jnp.zeros_like(gtbox[:, :, 0])
+    aiou = _box_iou_xywh(
+        g0[:, :, None], g0[:, :, None],
+        gtbox[:, :, 2:3], gtbox[:, :, 3:4],
+        jnp.zeros((an_num,), F32)[None, None, :],
+        jnp.zeros((an_num,), F32)[None, None, :],
+        aw_all[None, None, :], ah_all[None, None, :])
+    best_n = jnp.argmax(aiou, axis=-1)                    # [N, B]
+    # map best anchor id into the mask list (-1 when not in this head)
+    amask_arr = jnp.asarray(amask, jnp.int32)
+    match = jnp.where(
+        best_n[:, :, None] == amask_arr[None, None, :],
+        jnp.arange(m, dtype=jnp.int32)[None, None, :], -1).max(-1)
+    match = jnp.where(valid, match, -1)                   # GTMatchMask
+
+    gi = jnp.clip((gtbox[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gtbox[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    act = match >= 0
+    mi = jnp.where(act, match, 0)
+
+    bidx = jnp.arange(n)[:, None]
+    # gather predicted entries at matched cells: [N, B, 5+cnum]
+    pred_at = p[bidx, mi, :, gj, gi]
+    tx = gtbox[:, :, 0] * w - gi
+    ty = gtbox[:, :, 1] * h - gj
+    anchors_w = jnp.asarray(anchors[0::2], F32)
+    anchors_h = jnp.asarray(anchors[1::2], F32)
+    tw = jnp.log(jnp.maximum(
+        gtbox[:, :, 2] * input_size / anchors_w[best_n], 1e-10))
+    th = jnp.log(jnp.maximum(
+        gtbox[:, :, 3] * input_size / anchors_h[best_n], 1e-10))
+    lscale = (2.0 - gtbox[:, :, 2] * gtbox[:, :, 3]) * gts
+    loc = (_sce(pred_at[:, :, 0], tx) + _sce(pred_at[:, :, 1], ty)
+           + jnp.abs(pred_at[:, :, 2] - tw)
+           + jnp.abs(pred_at[:, :, 3] - th)) * lscale
+    loc = jnp.where(act, loc, 0.0)
+
+    # class loss at matched cells
+    tgt_cls = jnp.where(
+        gtlab[:, :, None] == jnp.arange(cnum)[None, None, :],
+        pos_lab, neg_lab)
+    cls = jnp.sum(_sce(pred_at[:, :, 5:], tgt_cls), axis=-1) * gts
+    cls = jnp.where(act, cls, 0.0)
+
+    # positive objness: scatter scores into the mask (positives override
+    # the ignore flag, as in the reference write order)
+    obj_mask = obj_mask.at[bidx, mi, gj, gi].set(
+        jnp.where(act, gts, obj_mask[bidx, mi, gj, gi]), mode="drop")
+    objness = p[:, :, 4]
+    obj_loss = jnp.where(
+        obj_mask > 1e-5, _sce(objness, 1.0) * obj_mask,
+        jnp.where(obj_mask > -0.5, _sce(objness, 0.0), 0.0))
+
+    loss = jnp.sum(loc + cls, axis=1) + jnp.sum(obj_loss, axis=(1, 2, 3))
+    return {"Loss": [loss],
+            "ObjectnessMask": [jax.lax.stop_gradient(obj_mask)],
+            "GTMatchMask": [jax.lax.stop_gradient(match)]}
+
+
+# ---------------------------------------------------------------------------
+# int8 quant trio (mkldnn-tier {quantize,dequantize,requantize}_op schema)
+# ---------------------------------------------------------------------------
+
+@register("quantize", grad=None, attrs={"Scale": 1.0, "Shift": 0.0,
+                                        "is_negative_input": True,
+                                        "output_format": "NCHW",
+                                        "bfloat16": False})
+def _quantize(ctx, ins, attrs):
+    s, sh = attrs.get("Scale", 1.0), attrs.get("Shift", 0.0)
+    v = x(ins, "Input")
+    q = jnp.round(v * s + sh)
+    if attrs.get("is_negative_input", True):
+        return {"Output": [jnp.clip(q, -128, 127).astype(jnp.int8)]}
+    return {"Output": [jnp.clip(q, 0, 255).astype(jnp.uint8)]}
+
+
+@register("dequantize", grad=None, attrs={"Scale": 1.0, "Shift": 0.0})
+def _dequantize(ctx, ins, attrs):
+    s, sh = attrs.get("Scale", 1.0), attrs.get("Shift", 0.0)
+    v = x(ins, "Input")
+    return {"Output": [(v.astype(F32) - sh) / s]}
+
+
+@register("requantize", grad=None, attrs={"Scale_in": 1.0, "Scale_out": 1.0,
+                                          "Shift_in": 0.0, "Shift_out": 0.0})
+def _requantize(ctx, ins, attrs):
+    v = x(ins, "Input").astype(F32)
+    si, so = attrs.get("Scale_in", 1.0), attrs.get("Scale_out", 1.0)
+    shi, sho = attrs.get("Shift_in", 0.0), attrs.get("Shift_out", 0.0)
+    q = jnp.round((v - shi) / si * so + sho)
+    return {"Output": [jnp.clip(q, -128, 127).astype(jnp.int8)]}
+
+
+# ---------------------------------------------------------------------------
+# conv variants / norm aliases
+# ---------------------------------------------------------------------------
+
+@register("deformable_conv_v1", no_grad_slots=(),
+          attrs={"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1,
+                 "deformable_groups": 1, "im2col_step": 64})
+def _deformable_conv_v1(ctx, ins, attrs):
+    """v1 = deformable conv without modulation mask
+    (deformable_conv_v1_op.cc)."""
+    from ..registry import require
+    ins2 = dict(ins)
+    ins2.pop("Mask", None)
+    return require("deformable_conv").compute(ctx, ins2, dict(attrs))
+
+
+@register("depthwise_conv2d_transpose",
+          attrs={"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1,
+                 "output_size": [], "output_padding": [],
+                 "data_format": "NCHW"})
+def _depthwise_conv2d_transpose(ctx, ins, attrs):
+    from ..registry import require
+    return require("conv2d_transpose").compute(ctx, dict(ins), dict(attrs))
+
+
+@register("sync_batch_norm", infer_shape=None,
+          attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+                 "data_layout": "NCHW", "use_global_stats": False,
+                 "trainable_statistics": False},
+          no_grad_out_slots=("MeanOut", "VarianceOut", "SavedMean",
+                             "SavedVariance", "ReserveSpace"))
+def _sync_batch_norm(ctx, ins, attrs):
+    """sync_batch_norm_op.cu's NCCL stats exchange is subsumed by GSPMD:
+    under dp sharding the batch axis is GLOBAL inside the jitted program,
+    so batch_norm's jnp.mean/var already reduce over every replica's rows
+    (XLA inserts the cross-replica all-reduce). Single-device: identical
+    to batch_norm."""
+    from ..registry import require
+    return require("batch_norm").compute(ctx, dict(ins), dict(attrs))
+
+
+@register("inplace_abn",
+          attrs={"momentum": 0.9, "epsilon": 1e-5, "is_test": False,
+                 "data_layout": "NCHW", "use_global_stats": False,
+                 "activation": "identity", "alpha": 0.01,
+                 "trainable_statistics": False},
+          no_grad_out_slots=("MeanOut", "VarianceOut", "SavedMean",
+                             "SavedVariance", "ReserveSpace"))
+def _inplace_abn(ctx, ins, attrs):
+    """inplace_abn_op: batch norm + in-place activation (XLA's buffer
+    reuse supplies the 'inplace'; we fuse bn+act functionally)."""
+    from ..registry import require
+    r = require("batch_norm").compute(ctx, dict(ins), dict(attrs))
+    act = attrs.get("activation", "identity")
+    y = r["Y"][0]
+    if act == "leaky_relu":
+        y = jax.nn.leaky_relu(y, attrs.get("alpha", 0.01))
+    elif act == "elu":
+        y = jax.nn.elu(y, attrs.get("alpha", 1.0))
+    elif act != "identity":
+        y = _ACTS[act](y)
+    r["Y"] = [y]
+    return r
+
+
+# ---------------------------------------------------------------------------
+# framework / program ops (save_op, load_op, run_program_op,
+# conditional_block_op, split_selected_rows_op)
+# ---------------------------------------------------------------------------
+
+def _host_dump(path, fp16, combine=False):
+    import os
+    import pickle
+
+    def do(*vals):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        arrs = [np.asarray(v).astype(np.float16) if fp16 else np.asarray(v)
+                for v in vals]
+        with open(path, "wb") as f:
+            pickle.dump(arrs if combine else arrs[0], f, protocol=4)
+        return np.zeros((1,), np.float32)
+    return do
+
+
+@register("save", grad=None, attrs={"file_path": "",
+                                    "save_as_fp16": False,
+                                    "overwrite": True})
+def _save_op(ctx, ins, attrs):
+    """save_op.cc: persist one variable to file_path. Reference-built
+    save programs contain these; the write happens via an ORDERED
+    io_callback so it runs (and survives DCE) inside the jitted block."""
+    from jax.experimental import io_callback
+    io_callback(_host_dump(attrs["file_path"],
+                           attrs.get("save_as_fp16", False)),
+                jax.ShapeDtypeStruct((1,), F32), x(ins), ordered=True)
+    return {}
+
+
+@register("load", grad=None, attrs={"file_path": "",
+                                    "load_as_fp16": False})
+def _load_op(ctx, ins, attrs):
+    import pickle
+    with open(attrs["file_path"], "rb") as f:
+        v = pickle.load(f)
+    v = np.asarray(v)
+    if attrs.get("load_as_fp16"):
+        v = v.astype(np.float16)
+    return {"Out": [jnp.asarray(v)]}
+
+
+@register("save_combine", grad=None, attrs={"file_path": "",
+                                            "save_as_fp16": False,
+                                            "overwrite": True})
+def _save_combine(ctx, ins, attrs):
+    from jax.experimental import io_callback
+    vals = _xs(ins)
+    io_callback(_host_dump(attrs["file_path"],
+                           attrs.get("save_as_fp16", False), combine=True),
+                jax.ShapeDtypeStruct((1,), F32), *vals, ordered=True)
+    return {}
+
+
+@register("load_combine", grad=None, attrs={"file_path": "",
+                                            "load_as_fp16": False})
+def _load_combine(ctx, ins, attrs):
+    import pickle
+    with open(attrs["file_path"], "rb") as f:
+        vals = pickle.load(f)
+    return {"Out": [jnp.asarray(np.asarray(v)) for v in vals]}
+
+
+@register("run_program", grad=None, attrs={})
+def _run_program(ctx, ins, attrs):
+    """run_program_op.cc: execute a captured sub-block (the dy2static
+    fallback path). Inputs bind by the block's feed names attr."""
+    blk = attrs["sub_block"]
+    feed_names = list(attrs.get("feed_names", []))
+    fetch_names = list(attrs.get("fetch_names", []))
+    env = dict(zip(feed_names, _xs(ins)))
+    ctx.exec_block(blk, env)
+    return {"Out": [env[n] for n in fetch_names]}
+
+
+@register("conditional_block", grad=None, attrs={"is_scalar_condition":
+                                                 True})
+def _conditional_block(ctx, ins, attrs):
+    """conditional_block_op.cc single-branch conditional: run the
+    sub-block when Cond is true, else produce ZEROS of the recorded
+    output shapes (the reference leaves outputs untouched; a functional
+    program needs a defined else-value, and zero matches the reference's
+    zero-initialised scope vars)."""
+    blk = attrs["sub_block"]
+    cond = x(ins, "Cond")
+    out_names = list(attrs.get("out_names", []))
+    cap_names = list(attrs.get("capture_names", []))
+    caps = list(ins.get("Input") or [])
+
+    def true_fn(*caps_v):
+        env = dict(zip(cap_names, caps_v))
+        ctx.exec_block(blk, env)
+        return tuple(env[n] for n in out_names)
+
+    # trace once to learn output shapes for the zero branch
+    shaped = jax.eval_shape(true_fn, *caps)
+
+    def false_fn(*caps_v):
+        return tuple(jnp.zeros(s.shape, s.dtype) for s in shaped)
+
+    pred = jnp.asarray(cond).reshape(()).astype(bool)
+    outs = jax.lax.cond(pred, true_fn, false_fn, *caps)
+    return {"Out": list(outs)}
+
+
+@register("split_selected_rows", grad=None,
+          attrs={"height_sections": []})
+def _split_selected_rows(ctx, ins, attrs):
+    """split_selected_rows_op.cc: partition a SelectedRows' rows by
+    height sections (dense form: the rows tensor plus Rows ids)."""
+    v = x(ins)
+    rows = x(ins, "Rows")
+    secs = list(attrs["height_sections"])
+    bounds = np.cumsum([0] + secs)
+    outs = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        m = (rows >= lo) & (rows < hi)
+        outs.append(jnp.where(m.reshape((-1,) + (1,) * (v.ndim - 1)),
+                              v, 0))
+    return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# PS sparse-table op forms (pull_sparse/push_sparse/
+# distributed_lookup_table) over the fleet KV tier
+# ---------------------------------------------------------------------------
+
+_FLEET = None
+
+
+def _fleet_kv():
+    global _FLEET
+    if _FLEET is None:
+        from ...distributed.fleet.fleet_wrapper import FleetWrapper
+        _FLEET = FleetWrapper()
+    return _FLEET
+
+
+@register("pull_sparse", grad=None, no_grad_slots=("Ids",),
+          attrs={"EmbeddingDim": 8, "TableId": 0, "table_name": ""})
+def _pull_sparse(ctx, ins, attrs):
+    """pull_sparse_op.cc over the FleetWrapper KV (fleet_wrapper.h
+    PullSparseVarsSync): host-side table fetch via io_callback."""
+    from jax.experimental import io_callback
+    dim = int(attrs.get("EmbeddingDim", 8))
+    table = attrs.get("table_name") or f"table_{attrs.get('TableId', 0)}"
+    ids = x(ins, "Ids")
+
+    def do(ids_v):
+        fw = _fleet_kv()
+        return fw.pull_sparse(table, np.asarray(ids_v).ravel(), dim
+                              ).astype(np.float32).reshape(
+            ids_v.shape + (dim,))
+
+    r = io_callback(do, jax.ShapeDtypeStruct(ids.shape + (dim,), F32),
+                    ids, ordered=True)
+    return {"Out": [r]}
+
+
+register("pull_sparse_v2", _pull_sparse, grad=None,
+         no_grad_slots=("Ids",),
+         attrs={"EmbeddingDim": 8, "TableId": 0, "table_name": ""})
+
+
+register("push_sparse_v2",
+         lambda ctx, ins, attrs: __import__(
+             "paddle_tpu.fluid.registry", fromlist=["require"]
+         ).require("push_sparse").compute(ctx, dict(ins), dict(attrs)),
+         grad=None, no_grad_slots=("Ids", "Grad"),
+         attrs={"EmbeddingDim": 8, "TableId": 0, "table_name": ""})
+
+
+@register("push_sparse", grad=None, no_grad_slots=("Ids", "Grad"),
+          attrs={"EmbeddingDim": 8, "TableId": 0, "table_name": ""})
+def _push_sparse(ctx, ins, attrs):
+    from jax.experimental import io_callback
+    dim = int(attrs.get("EmbeddingDim", 8))
+    table = attrs.get("table_name") or f"table_{attrs.get('TableId', 0)}"
+    ids = x(ins, "Ids")
+    g = x(ins, "Grad") if x(ins, "Grad") is not None else x(ins, "Out")
+
+    def do(ids_v, g_v):
+        fw = _fleet_kv()
+        fw.push_sparse(table, np.asarray(ids_v).ravel(),
+                       np.asarray(g_v).reshape(-1, dim), dim)
+        return np.zeros((1,), np.float32)
+
+    done = io_callback(do, jax.ShapeDtypeStruct((1,), F32), ids, g,
+                       ordered=True)
+    return {"Out": [done]}
+
+
+@register("distributed_lookup_table", grad=None, no_grad_slots=("Ids",),
+          attrs={"table_id": 0, "is_distributed": True,
+                 "lookup_table_version": "lookup_table",
+                 "table_name": "", "dim": 8})
+def _distributed_lookup_table(ctx, ins, attrs):
+    """distributed_lookup_table_op.cc: sparse-table lookups routed to the
+    PS tier; shares the pull_sparse transport."""
+    from ..registry import require
+    ids_list = list(ins.get("Ids") or [])
+    dim = int(attrs.get("dim", attrs.get("EmbeddingDim", 8)))
+    a = {"EmbeddingDim": dim, "TableId": attrs.get("table_id", 0),
+         "table_name": attrs.get("table_name", "")}
+    outs = []
+    for ids in ids_list:
+        r = require("pull_sparse").compute(ctx, {"Ids": [ids]}, dict(a))
+        outs.append(r["Out"][0])
+    return {"Outputs": outs}
+
+
+# ---------------------------------------------------------------------------
+# nms variants, linear interp, correlation
+# ---------------------------------------------------------------------------
+
+def _nms_variant(extra_index):
+    def impl(ctx, ins, attrs):
+        from ..registry import require
+        r = require("multiclass_nms").compute(ctx, dict(ins), dict(attrs))
+        outv = r["Out"][0]
+        n, k = outv.shape[0], outv.shape[1]
+        # Index: flat row index of each kept det in the padded output
+        idx = (jnp.arange(n)[:, None] * k
+               + jnp.arange(k)[None, :]).astype(jnp.int32)
+        idx = jnp.where(outv[:, :, 0] >= 0, idx, -1)
+        r["Index"] = [idx.reshape(-1, 1)]
+        if extra_index:
+            r.setdefault("NmsRoisNum", [jnp.sum(
+                (outv[:, :, 0] >= 0).astype(jnp.int32), axis=1)])
+        return r
+    return impl
+
+
+register("multiclass_nms2", _nms_variant(False), grad=None,
+         attrs={"score_threshold": 0.05, "nms_top_k": 64,
+                "keep_top_k": 100, "nms_threshold": 0.3, "nms_eta": 1.0,
+                "normalized": True, "background_label": 0})
+register("multiclass_nms3", _nms_variant(True), grad=None,
+         attrs={"score_threshold": 0.05, "nms_top_k": 64,
+                "keep_top_k": 100, "nms_threshold": 0.3, "nms_eta": 1.0,
+                "normalized": True, "background_label": 0})
+
+
+def _linear_interp_impl(ctx, ins, attrs):
+    """linear_interp(_v2): 1-D linear resample on [N, C, L]
+    (interpolate_op's linear mode)."""
+    from .tail_ops import _interp_axis_linear
+    v = x(ins)
+    ow = attrs.get("out_w", 0) or 0
+    if not ow:
+        scale = attrs.get("scale") or [1.0]
+        if isinstance(scale, (int, float)):
+            scale = [scale]
+        ow = int(round(v.shape[2] * scale[0]))
+    ac = bool(attrs.get("align_corners", True))
+    am = int(attrs.get("align_mode", 1))
+    dt = v.dtype
+    r = _interp_axis_linear(v.astype(F32), 2, int(ow), ac, am)
+    return out(r.astype(dt))
+
+
+for _n in ("linear_interp", "linear_interp_v2"):
+    register(_n, _linear_interp_impl, no_grad_slots=("OutSize", "Scale"),
+             attrs={"out_w": 0, "scale": [], "align_corners": True,
+                    "align_mode": 1, "data_layout": "NCHW"})
+
+
+@register("correlation", attrs={"pad_size": 0, "kernel_size": 1,
+                                "max_displacement": 1, "stride1": 1,
+                                "stride2": 1, "corr_type_multiply": 1})
+def _correlation(ctx, ins, attrs):
+    """FlowNet correlation (correlation_op.cu): mean over channels of
+    dot products between x1 patches and displaced x2 patches."""
+    a, b = x(ins, "Input1").astype(F32), x(ins, "Input2").astype(F32)
+    n, c, h, w = a.shape
+    d = int(attrs["max_displacement"])
+    s2 = int(attrs["stride2"])
+    disp = list(range(-d, d + 1, s2))
+    pads = [(0, 0), (0, 0), (d, d), (d, d)]
+    bp = jnp.pad(b, pads)
+    rows = []
+    for dy in disp:
+        for dx in disp:
+            shifted = bp[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
+            rows.append(jnp.mean(a * shifted, axis=1))
+    return out(jnp.stack(rows, axis=1).astype(x(ins, "Input1").dtype))

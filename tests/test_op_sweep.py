@@ -568,8 +568,10 @@ def test_sweep_coverage():
     gb = {k for k, v in registry._REGISTRY.items()
           if v.grad is not None and not k.endswith("_grad")}
     from test_tail_ops import CASES as TAIL_CASES
+    from test_parity_ops import CASES as PARITY_CASES, PARITY_EXEMPT
     covered = (set(CASES) | EXEMPT |
-               {c.op for c in TAIL_CASES} | TAIL_EXEMPT) & gb
+               {c.op for c in TAIL_CASES} | TAIL_EXEMPT |
+               {c.op for c in PARITY_CASES} | PARITY_EXEMPT) & gb
     missing = sorted(gb - covered)
     ratio = len(covered) / len(gb)
     assert ratio >= 0.8, (
