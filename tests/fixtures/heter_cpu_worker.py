@@ -1,0 +1,38 @@
+"""Heter CPU-role process: sparse IO + lookups against the PS, dense
+compute delegated to the dense worker."""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.fleet.heter_worker import HeterCpuWorker  # noqa: E402
+from paddle_tpu.models.wide_deep import WideDeepConfig  # noqa: E402
+
+
+def main():
+    cfg = WideDeepConfig(vocab_size=128, num_slots=4, embed_dim=4,
+                         dense_dim=3, hidden=[16, 8])
+    wid = int(os.environ["WORKER_ID"])
+    rounds = int(os.environ.get("ROUNDS", "30"))
+    w = HeterCpuWorker(cfg, os.environ["DENSE_ENDPOINT"],
+                       ps_endpoints=[os.environ["PS_ENDPOINT"]],
+                       lr=float(os.environ.get("LR", "0.1")))
+    rng = np.random.RandomState(100 + wid)
+    # learnable synthetic CTR signal: label depends on whether the
+    # batch's ids fall in the lower vocab half
+    for step in range(rounds):
+        ids = rng.randint(0, cfg.vocab_size, (32, cfg.num_slots))
+        dense = rng.randn(32, cfg.dense_dim).astype("float32")
+        label = ((ids < cfg.vocab_size // 2).mean(axis=1) > 0.5
+                 ).astype("float32")[:, None]
+        w.train_one_batch(ids, dense, label)
+    out = {"worker": wid, "losses": w.losses}
+    w.close()   # the parent stops the dense worker once ALL cpus exit
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

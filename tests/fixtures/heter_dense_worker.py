@@ -1,0 +1,28 @@
+"""Heter dense-role process: serve the dense net until stopped."""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed.fleet.heter_worker import HeterDenseWorker  # noqa: E402
+from paddle_tpu.models.wide_deep import WideDeepConfig  # noqa: E402
+
+
+def main():
+    cfg = WideDeepConfig(vocab_size=128, num_slots=4, embed_dim=4,
+                         dense_dim=3, hidden=[16, 8])
+    w = HeterDenseWorker(cfg, endpoint=os.environ["DENSE_ENDPOINT"],
+                         lr=float(os.environ.get("LR", "0.1")), seed=0)
+    # announce the bound port for the parent (endpoint may use port 0)
+    print(json.dumps({"endpoint": w.endpoint}), flush=True)
+    w.serve_forever()           # until a "stop" request shuts us down
+    print(json.dumps({"losses": w.losses[:4], "steps": len(w.losses)}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
