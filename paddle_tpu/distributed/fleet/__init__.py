@@ -8,6 +8,7 @@ parallelism onto the device mesh.
 from .base.distributed_strategy import DistributedStrategy
 from .fleet_wrapper import DownpourWorker, FleetWrapper
 from .heter_worker import HeterCpuWorker, HeterDenseWorker
+from .boxps_cache import BoxPSWrapper
 from .base.fleet_base import (Fleet, init, is_first_worker, worker_index,
                               worker_num, is_worker, worker_endpoints,
                               server_num, server_index, server_endpoints,
@@ -16,7 +17,7 @@ from .base.fleet_base import (Fleet, init, is_first_worker, worker_index,
                               distributed_optimizer, minimize)
 from .base.role_maker import PaddleCloudRoleMaker, UserDefinedRoleMaker, Role
 
-__all__ = ["DistributedStrategy", "FleetWrapper", "DownpourWorker", "HeterCpuWorker", "HeterDenseWorker", "init", "is_first_worker", "worker_index",
+__all__ = ["DistributedStrategy", "FleetWrapper", "DownpourWorker", "HeterCpuWorker", "HeterDenseWorker", "BoxPSWrapper", "init", "is_first_worker", "worker_index",
            "worker_num", "is_worker", "worker_endpoints", "server_num",
            "server_index", "server_endpoints", "is_server", "barrier_worker",
            "init_worker", "init_server", "run_server", "stop_worker",

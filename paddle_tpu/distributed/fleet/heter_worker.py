@@ -143,15 +143,17 @@ class HeterDenseWorker(socketserver.ThreadingTCPServer):
         with self._plock:
             # local SGD on the dense side (the reference's device-side
             # optimizer in HeterXpuTrainer); sparse updates happen on
-            # the CPU/PS side
-            self.params["wide_dense"] = np.asarray(
-                params["wide_dense"] - self.lr * gp["wide_dense"])
-            self.params["bias"] = np.asarray(
-                params["bias"] - self.lr * gp["bias"])
+            # the CPU/PS side. The delta applies to the CURRENT params,
+            # not the pre-grad snapshot — concurrent workers' updates
+            # compose (Hogwild) instead of overwriting each other.
+            self.params["wide_dense"] = self.params["wide_dense"] \
+                - self.lr * np.asarray(gp["wide_dense"])
+            self.params["bias"] = self.params["bias"] \
+                - self.lr * np.asarray(gp["bias"])
             self.params["mlp"] = [
-                {"w": np.asarray(l["w"] - self.lr * g["w"]),
-                 "b": np.asarray(l["b"] - self.lr * g["b"])}
-                for l, g in zip(params["mlp"], gp["mlp"])]
+                {"w": l["w"] - self.lr * np.asarray(g["w"]),
+                 "b": l["b"] - self.lr * np.asarray(g["b"])}
+                for l, g in zip(self.params["mlp"], gp["mlp"])]
             self.losses.append(float(loss))
         return {"loss": float(loss), "d_emb": np.asarray(d_emb),
                 "d_wide": np.asarray(d_wide)}

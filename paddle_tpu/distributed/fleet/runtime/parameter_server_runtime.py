@@ -135,9 +135,10 @@ class LargeScaleKV:
 def _send_msg(sock, obj):
     blob = pickle.dumps(obj, protocol=4)
     sock.sendall(struct.pack("<Q", len(blob)) + blob)
+    return 8 + len(blob)
 
 
-def _recv_msg(sock):
+def _recv_msg_sized(sock):
     hdr = b""
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
@@ -151,7 +152,11 @@ def _recv_msg(sock):
         if not chunk:
             raise ConnectionError("peer closed")
         buf += chunk
-    return pickle.loads(bytes(buf))
+    return pickle.loads(bytes(buf)), 8 + n
+
+
+def _recv_msg(sock):
+    return _recv_msg_sized(sock)[0]
 
 
 class _SyncRound:
@@ -338,6 +343,12 @@ class PSClient:
         self._socks: list[socket.socket | None] = [None] * len(endpoints)
         self._locks = [threading.Lock() for _ in endpoints]
         self._pool = None  # lazy persistent fan-out pool
+        # wire accounting (bench/diagnostics): bytes on the TCP
+        # transport; own lock — _call runs concurrently from the
+        # per-endpoint fan-out threads
+        self.bytes_out = 0
+        self.bytes_in = 0
+        self._bytes_lock = threading.Lock()
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -366,8 +377,12 @@ class PSClient:
     def _call(self, i: int, req: dict):
         with self._locks[i]:
             s = self._sock(i)
-            _send_msg(s, req)
-            return _recv_msg(s)
+            n_out = _send_msg(s, req)
+            obj, n_in = _recv_msg_sized(s)
+        with self._bytes_lock:
+            self.bytes_out += n_out
+            self.bytes_in += n_in
+        return obj
 
     def _route(self, keys: np.ndarray) -> np.ndarray:
         return (keys.astype(np.int64) % len(self.endpoints)).astype(np.int64)

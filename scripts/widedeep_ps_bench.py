@@ -1,0 +1,81 @@
+"""Subprocess roles for the widedeep PS-transport bench (bench.py).
+
+ROLE=server : PSServer shard on PS_ENDPOINT until killed.
+ROLE=worker : DownpourWorker over the TCP PSClient tier; prints a JSON
+              line {examples_per_sec, pull/push wire bytes, steps}.
+              MODE=boxps wraps the FleetWrapper in the BoxPS-style
+              hot-row cache (flush every FLUSH_EVERY batches).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    role = os.environ["ROLE"]
+    eps = os.environ["PS_ENDPOINTS"].split(",")
+    if role == "server":
+        from paddle_tpu.distributed.fleet.runtime. \
+            parameter_server_runtime import PSServer
+        PSServer(os.environ["MY_ENDPOINT"]).serve_forever()
+        return
+
+    from paddle_tpu.distributed.fleet import DownpourWorker, FleetWrapper
+    from paddle_tpu.models.wide_deep import WideDeepConfig
+
+    wid = int(os.environ.get("WORKER_ID", "0"))
+    steps = int(os.environ.get("STEPS", "12"))
+    warmup = int(os.environ.get("WARMUP", "2"))
+    batch = int(os.environ.get("BATCH", "4096"))
+    cfg = WideDeepConfig()          # 1M vocab, 26 slots, 13 dense
+    fw = FleetWrapper(endpoints=eps)
+    kv = fw
+    if os.environ.get("MODE") == "boxps":
+        from paddle_tpu.distributed.fleet.boxps_cache import BoxPSWrapper
+        kv = BoxPSWrapper(fw, capacity=1 << 21,
+                          flush_every=int(os.environ.get("FLUSH_EVERY",
+                                                         "8")))
+    worker = DownpourWorker(kv, cfg, lr=0.05)
+    if wid == 0:
+        worker.push_initial_dense()
+    else:
+        time.sleep(1.0)
+
+    rng = np.random.RandomState(7 + wid)
+
+    def batch_data():
+        # Zipfian ids — CTR id traffic is heavy-tailed, which is also
+        # what makes the BoxPS hot-row cache meaningful; both transport
+        # modes run the same distribution
+        ids = (rng.zipf(1.3, (batch, cfg.num_slots)) - 1) % cfg.vocab_size
+        dense = rng.randn(batch, cfg.dense_dim).astype(np.float32)
+        label = (ids[:, 0] % 2).astype(np.float32)[:, None]
+        return ids, dense, label
+
+    for _ in range(warmup):
+        worker.train_one_batch(*batch_data())
+    cl = fw._client
+    b_out0, b_in0 = cl.bytes_out, cl.bytes_in
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        worker.train_one_batch(*batch_data())
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "worker": wid, "examples_per_sec": batch * steps / dt,
+        "push_pull_mb_out": (cl.bytes_out - b_out0) / 1e6,
+        "push_pull_mb_in": (cl.bytes_in - b_in0) / 1e6,
+        "steps": steps, "batch": batch}), flush=True)
+    fw.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,13 +21,15 @@ def main():
                        ps_endpoints=[os.environ["PS_ENDPOINT"]],
                        lr=float(os.environ.get("LR", "0.1")))
     rng = np.random.RandomState(100 + wid)
-    # learnable synthetic CTR signal: label depends on whether the
-    # batch's ids fall in the lower vocab half
+    # learnable synthetic CTR signal with BOTH a dense component (the
+    # MLP picks it up within a few steps) and a sparse-id component, so
+    # convergence is visible well above the label-entropy floor
     for step in range(rounds):
         ids = rng.randint(0, cfg.vocab_size, (32, cfg.num_slots))
         dense = rng.randn(32, cfg.dense_dim).astype("float32")
-        label = ((ids < cfg.vocab_size // 2).mean(axis=1) > 0.5
-                 ).astype("float32")[:, None]
+        logit = 2.0 * (ids < cfg.vocab_size // 2).mean(axis=1) - 1.0 \
+            + dense[:, 0]
+        label = (logit > 0).astype("float32")[:, None]
         w.train_one_batch(ids, dense, label)
     out = {"worker": wid, "losses": w.losses}
     w.close()   # the parent stops the dense worker once ALL cpus exit

@@ -103,3 +103,40 @@ def test_downpour_widedeep_multiprocess(tmp_path):
             assert tail < 0.53 and tail < head - 0.02, (head, tail)
     finally:
         server.kill()
+
+
+def test_boxps_cache_semantics():
+    """BoxPS-style hot-row cache (r04 missing #2): read-your-writes
+    locally, aggregated delta flush to the PS, EndPass refresh merges
+    other workers' updates."""
+    from paddle_tpu.distributed.fleet import FleetWrapper
+    from paddle_tpu.distributed.fleet.boxps_cache import BoxPSWrapper
+
+    fw = FleetWrapper()          # in-process KV
+    box = BoxPSWrapper(fw, capacity=64, flush_every=100, id_space=256)
+    ids = np.array([1, 2, 3], np.int64)
+    r0 = box.pull_sparse("t", ids, 4)
+    base = fw.pull_sparse("t", ids, 4)
+    np.testing.assert_allclose(r0, base)
+
+    g = np.ones((3, 4), np.float32)
+    box.push_sparse("t", ids, g, 4, lr=0.5)
+    # read-your-writes: cached rows reflect the local update...
+    r1 = box.pull_sparse("t", ids, 4)
+    np.testing.assert_allclose(r1, r0 - 0.5, rtol=1e-6)
+    # ...but the PS hasn't seen it yet (delta not flushed)
+    np.testing.assert_allclose(fw.pull_sparse("t", ids, 4), base)
+
+    # another worker pushes directly to the PS
+    fw.push_sparse("t", ids, 2 * g, 4, lr=0.5)
+    box.flush()
+    # PS now holds BOTH updates; the refreshed cache agrees with the PS
+    ps = fw.pull_sparse("t", ids, 4)
+    np.testing.assert_allclose(ps, base - 0.5 - 1.0, rtol=1e-6)
+    np.testing.assert_allclose(box.pull_sparse("t", ids, 4), ps,
+                               rtol=1e-6)
+
+    # over-id-space ids bypass the cache transparently
+    big = np.array([1000], np.int64)
+    r = box.pull_sparse("t", big, 4)
+    np.testing.assert_allclose(r, fw.pull_sparse("t", big, 4))

@@ -84,7 +84,7 @@ def test_heter_multiprocess_cpu_sparse_device_dense():
         for wid in range(2):
             env = dict(env0)
             env.update({"DENSE_ENDPOINT": dense_ep, "PS_ENDPOINT": ps_ep,
-                        "WORKER_ID": str(wid), "ROUNDS": "40"})
+                        "WORKER_ID": str(wid), "ROUNDS": "60"})
             cpus.append(subprocess.Popen(
                 [sys.executable,
                  os.path.join(fixdir, "heter_cpu_worker.py")],
