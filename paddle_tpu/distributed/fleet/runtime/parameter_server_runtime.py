@@ -211,6 +211,60 @@ class _SyncRound:
             return self.fround
 
 
+class _DGCRound:
+    """One sparse-gradient exchange round (DGC transport): trainers push
+    their top-k (idx, val) pairs; once every trainer has pushed, pulls
+    return the MERGED sparse gradient (duplicate indices summed,
+    vectorized at seal time). The round recycles when every trainer has
+    pulled — lockstep rounds like the reference's sparse allreduce.
+    Stragglers raise TimeoutError (matching _SyncRound) instead of
+    hanging the handler thread."""
+
+    def __init__(self, trainers: int):
+        self.trainers = trainers
+        self.cond = threading.Condition()
+        self._reset()
+
+    def _reset(self):
+        self.parts: list = []
+        self.pushed: set[int] = set()
+        self.pulled: set[int] = set()
+        self.merged = None
+
+    def push(self, worker: int, idx, val):
+        with self.cond:
+            if not self.cond.wait_for(
+                    lambda: worker not in self.pushed, timeout=300):
+                raise TimeoutError(
+                    "dgc round not drained — a trainer never pulled")
+            self.parts.append((np.asarray(idx, np.int64).ravel(),
+                               np.asarray(val, np.float32).ravel()))
+            self.pushed.add(worker)
+            if len(self.pushed) == self.trainers:
+                allidx = np.concatenate([p[0] for p in self.parts])
+                allval = np.concatenate([p[1] for p in self.parts])
+                uniq, inv = np.unique(allidx, return_inverse=True)
+                summed = np.bincount(inv, weights=allval,
+                                     minlength=len(uniq))
+                self.merged = (uniq, summed.astype(np.float32))
+                self.cond.notify_all()
+            return True
+
+    def pull(self, worker: int):
+        with self.cond:
+            if not self.cond.wait_for(lambda: self.merged is not None,
+                                      timeout=300):
+                raise TimeoutError(
+                    "dgc round incomplete — trainers missing: "
+                    f"{sorted(set(range(self.trainers)) - self.pushed)}")
+            idx, val = self.merged
+            self.pulled.add(worker)
+            if len(self.pulled) == self.trainers:
+                self._reset()
+                self.cond.notify_all()
+            return {"idx": idx, "val": val}
+
+
 class PSServer(socketserver.ThreadingTCPServer):
     """One PS shard: serves pull/push/save/size for its tables (reference
     listen_and_serv_op RunAsyncLoop — apply-on-arrival, no global
@@ -231,6 +285,7 @@ class PSServer(socketserver.ThreadingTCPServer):
         # lost_workers() reports ids silent past the timeout
         self.worker_timeout = worker_timeout
         self._beats: dict[int, float] = {}
+        self._dgc: dict[str, _DGCRound] = {}
         self._beats_lock = threading.Lock()
         outer = self
 
@@ -300,7 +355,30 @@ class PSServer(socketserver.ThreadingTCPServer):
             return True
         if op == "lost_workers":
             return self.lost_workers()
+        if op == "dgc_push":
+            # sparse gradient round (DGC transport, reference dgc_op.h +
+            # sparse allreduce in operators/collective): accumulate each
+            # trainer's top-k (idx, val) pairs; seal when all arrived
+            return self._dgc_round(req["table"], int(req["trainers"])
+                                   ).push(int(req["worker"]),
+                                          req["idx"], req["val"])
+        if op == "dgc_pull":
+            return self._dgc_round(req["table"], int(req["trainers"])
+                                   ).pull(int(req["worker"]))
         raise ValueError(f"unknown PS op {op!r}")
+
+    def _dgc_round(self, table: str, trainers: int) -> "_DGCRound":
+        with self._sync_lock:
+            r = self._dgc.get(table)
+            if r is None:
+                r = self._dgc[table] = _DGCRound(trainers)
+            elif r.trainers != trainers:
+                if r.pushed or r.pulled:
+                    raise RuntimeError(
+                        f"dgc trainer count changed mid-round on "
+                        f"{table!r} ({r.trainers} -> {trainers})")
+                r = self._dgc[table] = _DGCRound(trainers)
+            return r
 
     def _sync_state(self, trainers: int) -> _SyncRound:
         with self._sync_lock:
@@ -473,6 +551,34 @@ class PSClient:
     def save(self, dirname: str):
         for i in range(len(self.endpoints)):
             self._call(i, {"op": "save", "dirname": dirname})
+
+    # -- DGC sparse-gradient rounds (shard by index hash) ----------------
+    def dgc_allreduce(self, name: str, idx, val, worker: int,
+                      trainers: int):
+        """Exchange top-k sparse gradients: push this worker's (idx,
+        val), receive the all-trainer merged sparse gradient. Wire cost
+        is O(k) both ways vs O(N) for a dense exchange — this is the
+        DGC transport the dgc_momentum op's compression exists for."""
+        idx = np.asarray(idx, np.int64).ravel()
+        val = np.asarray(val, np.float32).ravel()
+        owner = self._route(idx)
+        calls = []
+        for i in range(len(self.endpoints)):
+            m = owner == i
+            calls.append((lambda i=i, m=m: self._call(
+                i, {"op": "dgc_push", "table": name, "idx": idx[m],
+                    "val": val[m], "worker": worker,
+                    "trainers": trainers})))
+        self._fanout(calls)
+        parts = self._fanout([
+            (lambda i=i: self._call(i, {"op": "dgc_pull", "table": name,
+                                        "worker": worker,
+                                        "trainers": trainers}))
+            for i in range(len(self.endpoints))])
+        midx = np.concatenate([p["idx"] for p in parts])
+        mval = np.concatenate([p["val"] for p in parts])
+        order = np.argsort(midx, kind="stable")
+        return midx[order], mval[order]
 
     def close(self):
         if self._pool is not None:

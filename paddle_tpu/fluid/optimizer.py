@@ -850,8 +850,17 @@ class DGCMomentumOptimizer(Optimizer):
     """Deep Gradient Compression momentum (reference optimizer.py:1355
     DGCMomentumOptimizer + operators/dgc_op.h): top-`1-sparsity` residual
     selection with momentum correction; vanilla momentum during rampup.
-    The dgc_momentum op keeps DGC's convergence semantics; the sparse
-    transport it implied is subsumed by XLA's dense mesh collectives."""
+
+    Transport: the dgc_momentum op provides the compression/correction
+    SEMANTICS; where the bytes go depends on the tier. In-mesh data
+    parallelism stays a dense XLA allreduce — over ICI the dense
+    collective is bandwidth-cheap and compression would only add
+    latency. For the slow tier (PS/DCN) the framework PROVIDES the
+    sparse exchange primitive ``PSClient.dgc_allreduce`` (O(k) wire
+    bytes both ways, index-hash sharded lockstep rounds on the PS;
+    tests/test_transpiler.py::test_dgc_sparse_transport) — a PS-mode
+    training loop opts in by exchanging its top-k through it; the
+    transpiler's default dense send/recv path is unchanged."""
     type = "dgc_momentum"
 
     def __init__(self, learning_rate, momentum=0.9,

@@ -1,0 +1,205 @@
+"""Per-op microbenchmark harness over the registry.
+
+The reference ships a standalone per-op latency tool
+(/root/reference/paddle/fluid/operators/benchmark/op_tester.cc:1 with
+OpTesterConfig files naming an op, its input shapes and repeat count).
+This is its registry-native equivalent: each case jits one op kernel at
+a configured shape, times `repeat` dispatches with a single device sync,
+and emits one JSON record per case — wall ms, achieved GB/s against the
+case's array-IO bytes, and the output signature.
+
+Usage:
+  python -m paddle_tpu.tools.op_bench                 # built-in sweep
+  python -m paddle_tpu.tools.op_bench --ops matmul,softmax
+  python -m paddle_tpu.tools.op_bench --config cases.json --out r.json
+
+Config file: JSON list of cases,
+  {"op": "matmul", "inputs": {"X": {"shape": [4096, 4096]},
+   "Y": {"shape": [4096, 4096]}}, "attrs": {}, "repeat": 20}
+dtype defaults to float32 ("int64"/"int32" inputs draw random indices
+bounded by "high").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+__all__ = ["run_case", "run_cases", "DEFAULT_CASES"]
+
+
+def _make_input(spec, rng):
+    shape = tuple(spec.get("shape", ()))
+    dtype = spec.get("dtype", "float32")
+    if "value" in spec:
+        return np.asarray(spec["value"], dtype)
+    if dtype.startswith("int") or dtype == "bool":
+        return rng.randint(0, spec.get("high", 8), shape).astype(dtype)
+    return rng.randn(*shape).astype(dtype)
+
+
+def run_case(case: dict) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ..fluid import registry
+    from ..fluid.executor import ExecContext
+
+    op = case["op"]
+    repeat = int(case.get("repeat", 20))
+    opdef = registry.lookup(op)
+    if opdef is None:
+        return {"op": op, "error": "not registered"}
+    rng = np.random.RandomState(int(case.get("seed", 0)))
+    ins_np = {slot: [_make_input(s, rng) for s in
+                     (spec if isinstance(spec, list) else [spec])]
+              for slot, spec in case.get("inputs", {}).items()}
+    attrs = dict(case.get("attrs", {}))
+    opdef.fill_default_attrs(attrs)
+    if opdef.stochastic:
+        attrs.setdefault("_rng_id", 0)
+
+    ins = {k: [jnp.asarray(a) for a in v] for k, v in ins_np.items()}
+    ctx = ExecContext(jax.random.PRNGKey(0), is_test=bool(
+        case.get("is_test", False)))
+
+    def fn(ins):
+        return opdef.compute(ctx, ins, attrs)
+
+    try:
+        jitted = jax.jit(fn)
+        out = jitted(ins)
+    except Exception as e:
+        return {"op": op, "error": f"{type(e).__name__}: {e}"[:200]}
+    leaves = [v for v in jax.tree_util.tree_leaves(out)
+              if hasattr(v, "shape")]
+    sync = jax.jit(lambda t: jnp.ravel(t)[:1])
+    np.asarray(sync(leaves[0]))
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        out = jitted(ins)
+    np.asarray(sync(jax.tree_util.tree_leaves(out)[0]))
+    loop = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    np.asarray(sync(jax.tree_util.tree_leaves(out)[0]))
+    dt = max(loop - (time.perf_counter() - t1), loop * 0.5) / repeat
+
+    in_bytes = sum(a.nbytes for v in ins_np.values() for a in v)
+    out_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                    for v in leaves)
+    rec = {"op": op, "ms": round(dt * 1e3, 4),
+           "io_gb_per_s": round((in_bytes + out_bytes) / dt / 1e9, 2),
+           "in_bytes": in_bytes, "out_bytes": out_bytes,
+           "outputs": {k: [list(v.shape) for v in vs if
+                           hasattr(v, "shape")]
+                       for k, vs in out.items()},
+           "repeat": repeat}
+    if "flops" in case:
+        rec["tflops_per_s"] = round(case["flops"] / dt / 1e12, 2)
+    return rec
+
+
+# shapes chosen at BERT/ResNet working points so the numbers relate to
+# the model benches; flops given where the op is matmul-shaped
+DEFAULT_CASES = [
+    {"op": "matmul", "inputs": {"X": {"shape": [4096, 1024]},
+                                "Y": {"shape": [1024, 4096]}},
+     "flops": 2 * 4096 * 1024 * 4096},
+    {"op": "matmul_v2", "inputs": {"X": {"shape": [8192, 768]},
+                                   "Y": {"shape": [768, 3072]}},
+     "flops": 2 * 8192 * 768 * 3072},
+    {"op": "softmax", "inputs": {"X": {"shape": [64, 12, 128, 128]}},
+     "attrs": {"axis": -1}},
+    {"op": "layer_norm", "inputs": {
+        "X": {"shape": [8192, 768]}, "Scale": {"shape": [768]},
+        "Bias": {"shape": [768]}}},
+    {"op": "gelu", "inputs": {"X": {"shape": [64, 128, 3072]}}},
+    {"op": "relu", "inputs": {"X": {"shape": [256, 56, 56, 256]}}},
+    {"op": "conv2d", "inputs": {
+        "Input": {"shape": [64, 64, 56, 56]},
+        "Filter": {"shape": [64, 64, 3, 3]}},
+     "attrs": {"strides": [1, 1], "paddings": [1, 1],
+               "dilations": [1, 1], "groups": 1},
+     "flops": 2 * 64 * 64 * 64 * 9 * 56 * 56},
+    {"op": "batch_norm", "inputs": {
+        "X": {"shape": [64, 56, 56, 64]}, "Scale": {"shape": [64]},
+        "Bias": {"shape": [64]}, "Mean": {"shape": [64]},
+        "Variance": {"shape": [64]}},
+     "attrs": {"data_layout": "NHWC"}},
+    {"op": "dropout", "inputs": {"X": {"shape": [64, 128, 768]}},
+     "attrs": {"dropout_prob": 0.1}},
+    {"op": "transpose2", "inputs": {"X": {"shape": [64, 128, 12, 64]}},
+     "attrs": {"axis": [0, 2, 1, 3]}},
+    {"op": "reduce_sum", "inputs": {"X": {"shape": [64, 128, 3072]}},
+     "attrs": {"dim": [-1]}},
+    {"op": "elementwise_add", "inputs": {
+        "X": {"shape": [64, 128, 768]}, "Y": {"shape": [64, 128, 768]}}},
+    {"op": "lookup_table_v2", "inputs": {
+        "W": {"shape": [30522, 768]},
+        "Ids": {"shape": [64, 128], "dtype": "int64", "high": 30522}}},
+    {"op": "softmax_with_cross_entropy", "inputs": {
+        "Logits": {"shape": [8192, 30522]},
+        "Label": {"shape": [8192, 1], "dtype": "int64", "high": 30522}}},
+    {"op": "concat", "inputs": {
+        "X": [{"shape": [64, 128, 768]}, {"shape": [64, 128, 768]}]},
+     "attrs": {"axis": -1}},
+    {"op": "slice", "inputs": {"X": {"shape": [64, 128, 768]}},
+     "attrs": {"axes": [1], "starts": [0], "ends": [64]}},
+    {"op": "scale", "inputs": {"X": {"shape": [64, 128, 768]}},
+     "attrs": {"scale": 2.0}},
+    {"op": "adam", "inputs": {
+        "Param": {"shape": [3072, 768]}, "Grad": {"shape": [3072, 768]},
+        "Moment1": {"shape": [3072, 768]},
+        "Moment2": {"shape": [3072, 768]},
+        "LearningRate": {"value": [1e-3]},
+        "Beta1Pow": {"value": [0.9]}, "Beta2Pow": {"value": [0.999]}}},
+    {"op": "cholesky", "inputs": {"X": {"value": None}},  # filled below
+     "repeat": 5},
+    {"op": "gru", "inputs": {
+        "Input": {"shape": [32, 64, 384]},
+        "Weight": {"shape": [128, 384]}}, "repeat": 5},
+]
+
+# positive-definite input for cholesky
+_m = np.random.RandomState(0).randn(256, 256).astype("float32")
+DEFAULT_CASES[-2]["inputs"]["X"]["value"] = \
+    (_m @ _m.T + 256 * np.eye(256, dtype="float32")).tolist()
+
+
+def run_cases(cases, ops_filter=None):
+    recs = []
+    for c in cases:
+        if ops_filter and not any(s in c["op"] for s in ops_filter):
+            continue
+        recs.append(run_case(c))
+    return recs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", help="JSON file with a list of cases")
+    ap.add_argument("--ops", help="comma-separated op-name substrings")
+    ap.add_argument("--repeat", type=int, default=None)
+    ap.add_argument("--out", help="write JSON records here (else stdout)")
+    args = ap.parse_args(argv)
+    cases = DEFAULT_CASES
+    if args.config:
+        with open(args.config) as f:
+            cases = json.load(f)
+    if args.repeat:
+        cases = [{**c, "repeat": args.repeat} for c in cases]
+    flt = args.ops.split(",") if args.ops else None
+    recs = run_cases(cases, flt)
+    blob = json.dumps(recs, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(blob)
+    else:
+        print(blob)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

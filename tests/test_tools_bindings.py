@@ -1,0 +1,94 @@
+"""R binding (r/) + per-op microbench harness (tools/op_bench) —
+VERDICT r04 missing #4/#5."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_r_example_runs_or_skips():
+    """Mirror of the Go toolchain test: run the R example end-to-end
+    when Rscript (+reticulate) exists, skip cleanly otherwise."""
+    rscript = shutil.which("Rscript")
+    if rscript is None:
+        pytest.skip("no R toolchain in this image")
+    env = dict(os.environ)
+    env["PADDLE_TPU_PYTHON"] = sys.executable
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [rscript, os.path.join(REPO, "r", "example", "lenet.r")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "lenet.r OK" in res.stdout
+
+
+def test_r_example_python_surface():
+    """The exact Python call chain the R script drives via reticulate
+    must work — validated from Python so the binding is tested even
+    without an R toolchain (the reference binding is reticulate over
+    these same objects, /root/reference/r/example/mobilenet.r)."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, Predictor
+    from paddle_tpu.models.lenet import LeNet
+    from paddle_tpu.static import InputSpec
+
+    d = tempfile.mkdtemp()
+    model = LeNet()
+    model.eval()
+    paddle.jit.save(model, os.path.join(d, "lenet"),
+                    input_spec=[InputSpec([-1, 1, 28, 28], "float32",
+                                          "img")])
+    config = Config(model_dir=os.path.join(d, "lenet"))
+    pred = Predictor(config)
+    img = np.random.RandomState(0).rand(2, 1, 28, 28).astype("float32")
+    ref = pred.run([img])[0]
+    ih = pred.get_input_handle(pred.get_input_names()[0])
+    ih.copy_from_cpu(img)
+    assert pred.run() is True
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def test_op_bench_records():
+    from paddle_tpu.tools.op_bench import run_cases
+
+    recs = run_cases([
+        {"op": "matmul", "inputs": {"X": {"shape": [128, 64]},
+                                    "Y": {"shape": [64, 32]}},
+         "flops": 2 * 128 * 64 * 32, "repeat": 3},
+        {"op": "softmax", "inputs": {"X": {"shape": [8, 128]}},
+         "attrs": {"axis": -1}, "repeat": 3},
+        {"op": "not_an_op", "inputs": {}},
+    ])
+    assert recs[0]["op"] == "matmul" and recs[0]["ms"] > 0
+    assert "tflops_per_s" in recs[0]
+    assert recs[0]["outputs"]["Out"] == [[128, 32]]
+    assert recs[1]["io_gb_per_s"] > 0
+    assert recs[2] == {"op": "not_an_op", "error": "not registered"}
+
+
+def test_op_bench_cli(tmp_path):
+    out = tmp_path / "r.json"
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.op_bench",
+         "--ops", "scale,relu", "--repeat", "3", "--out", str(out)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", "")},
+        capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-1500:]
+    recs = json.loads(out.read_text())
+    assert {r["op"] for r in recs} == {"scale", "relu"}
+    assert all(r["ms"] > 0 for r in recs)

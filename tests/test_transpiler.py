@@ -221,3 +221,64 @@ def test_fleet1x_incubate_api(ps_server, fresh_programs):
             losses.append(float(np.ravel(lv)[0]))
     fleet.stop_worker()
     assert losses[-1] < losses[2] * 0.2, (losses[2], losses[-1])
+
+
+def test_dgc_sparse_transport(ps_server):
+    """DGC's top-k exchange over the PS tier is genuinely SPARSE (r04
+    weak #6): two trainers push disjoint+overlapping top-k (idx, val)
+    sets, every trainer receives the identical merged sparse gradient
+    (duplicates summed), and the wire carries O(k), not O(N), bytes."""
+    import threading
+
+    from paddle_tpu.distributed.fleet.runtime. \
+        parameter_server_runtime import PSClient
+
+    N = 1_000_000                      # dense gradient length
+    k = 512
+    rng = np.random.RandomState(0)
+    dense = [np.zeros(N, np.float32), np.zeros(N, np.float32)]
+    tops = []
+    for t in range(2):
+        idx = rng.choice(N, k, replace=False)
+        val = rng.randn(k).astype(np.float32)
+        dense[t][idx] = val
+        tops.append((idx, val))
+    want = dense[0] + dense[1]
+
+    results = [None, None]
+    clients = [PSClient([ps_server]) for _ in range(2)]
+
+    def go(t):
+        results[t] = clients[t].dgc_allreduce(
+            "w@DGC", tops[t][0], tops[t][1], worker=t, trainers=2)
+
+    th = [threading.Thread(target=go, args=(t,)) for t in range(2)]
+    [x.start() for x in th]
+    [x.join(timeout=120) for x in th]
+    for t in range(2):
+        idx, val = results[t]
+        got = np.zeros(N, np.float32)
+        got[idx] = val
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    # O(k) wire: both directions way below the 4 MB dense gradient
+    for cl in clients:
+        assert cl.bytes_out < 200_000, cl.bytes_out
+        assert cl.bytes_in < 200_000, cl.bytes_in
+        cl.close()
+
+    # a second round on the same table works (round state recycles)
+    clients2 = [PSClient([ps_server]) for _ in range(2)]
+
+    def go2(t):
+        results[t] = clients2[t].dgc_allreduce(
+            "w@DGC", tops[t][0][:4], tops[t][1][:4] * 2.0,
+            worker=t, trainers=2)
+
+    th = [threading.Thread(target=go2, args=(t,)) for t in range(2)]
+    [x.start() for x in th]
+    [x.join(timeout=120) for x in th]
+    for cl in clients2:
+        cl.close()
+    assert len(results[0][0]) <= 8
+    np.testing.assert_array_equal(results[0][0], results[1][0])
