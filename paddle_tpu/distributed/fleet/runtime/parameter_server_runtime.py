@@ -358,13 +358,21 @@ class PSServer(socketserver.ThreadingTCPServer):
         if op == "dgc_push":
             # sparse gradient round (DGC transport, reference dgc_op.h +
             # sparse allreduce in operators/collective): accumulate each
-            # trainer's top-k (idx, val) pairs; seal when all arrived
-            return self._dgc_round(req["table"], int(req["trainers"])
-                                   ).push(int(req["worker"]),
-                                          req["idx"], req["val"])
+            # trainer's top-k (idx, val) pairs; seal when all arrived.
+            # Timeouts surface as an error PAYLOAD — TimeoutError is an
+            # OSError subclass the connection handler would swallow
+            try:
+                return self._dgc_round(req["table"], int(req["trainers"])
+                                       ).push(int(req["worker"]),
+                                              req["idx"], req["val"])
+            except (TimeoutError, RuntimeError) as e:
+                return {"error": str(e)}
         if op == "dgc_pull":
-            return self._dgc_round(req["table"], int(req["trainers"])
-                                   ).pull(int(req["worker"]))
+            try:
+                return self._dgc_round(req["table"], int(req["trainers"])
+                                       ).pull(int(req["worker"]))
+            except (TimeoutError, RuntimeError) as e:
+                return {"error": str(e)}
         raise ValueError(f"unknown PS op {op!r}")
 
     def _dgc_round(self, table: str, trainers: int) -> "_DGCRound":
@@ -569,12 +577,17 @@ class PSClient:
                 i, {"op": "dgc_push", "table": name, "idx": idx[m],
                     "val": val[m], "worker": worker,
                     "trainers": trainers})))
-        self._fanout(calls)
+        for r in self._fanout(calls):
+            if isinstance(r, dict) and "error" in r:
+                raise RuntimeError(f"dgc_push failed: {r['error']}")
         parts = self._fanout([
             (lambda i=i: self._call(i, {"op": "dgc_pull", "table": name,
                                         "worker": worker,
                                         "trainers": trainers}))
             for i in range(len(self.endpoints))])
+        for p in parts:
+            if "error" in p:
+                raise RuntimeError(f"dgc_pull failed: {p['error']}")
         midx = np.concatenate([p["idx"] for p in parts])
         mval = np.concatenate([p["val"] for p in parts])
         order = np.argsort(midx, kind="stable")

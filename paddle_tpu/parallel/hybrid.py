@@ -87,10 +87,13 @@ class HybridParallelTrainStep:
                     f"num_experts={cfg.num_experts} not divisible by "
                     f"ep={self.ep}")
         if self.sp > 1:
-            if self.pp > 1:  # judged off the MESH, not the ctor args
+            if self.pp > 1 and self._schedule != "1F1B":
                 raise NotImplementedError(
-                    "sp x pp nests two manual mesh axes — shard the "
-                    "sequence OR the layers, not both (yet)")
+                    "sp x pp needs schedule_mode='1F1B': the ring "
+                    "attention rides INSIDE the 1F1B stage functions "
+                    "(sp stays a GSPMD axis with the ring's shard_map "
+                    "nested in the pp-manual region); the GPipe scan "
+                    "has no per-stage function to host it")
             # sequence parallel => ring attention over the sp axis
             import dataclasses as _dc
             cfg = _dc.replace(cfg, attn_impl="ring")
@@ -247,7 +250,8 @@ class HybridParallelTrainStep:
         embp = {"wte": params["wte"], "wpe": params["wpe"]}
         x0, emb_vjp = jax.vjp(emb_fn, embp)
         x0 = jax.lax.with_sharding_constraint(
-            x0, NamedSharding(mesh, P(None, "dp")))
+            x0, NamedSharding(mesh, P(None, "dp", "sp")
+                              if self.sp > 1 else P(None, "dp")))
 
         def stage_fn(local, x, k):
             if use_drop:
@@ -278,10 +282,22 @@ class HybridParallelTrainStep:
         shared = {"wte": params["wte"], "lnf_s": params["lnf_s"],
                   "lnf_b": params["lnf_b"]}
         aux_w = cfg.moe_aux_weight if cfg.num_experts > 0 else 0.0
-        loss, gblocks, gshared, dx0 = pipeline_1f1b_grads(
-            stage_fn, last_fn, params["blocks"], shared, x0, ids_mb,
-            mesh, "pp", aux_weight=aux_w, key=key,
-            uniform_last=n_auto >= 2)
+        import contextlib
+        ring_cm = contextlib.nullcontext()
+        if self.sp > 1:
+            # sp x pp: the sequence stays a GSPMD ("auto") axis inside
+            # the pp-manual region; attention drops into the ring's own
+            # shard_map over "sp" NESTED in the 1F1B engine's manual
+            # region — the manual axes sets are disjoint, which jax's
+            # shard_map supports
+            from .sequence_parallel import ring_context
+            ring_cm = ring_context(mesh, "sp")
+        with ring_cm:
+            loss, gblocks, gshared, dx0 = pipeline_1f1b_grads(
+                stage_fn, last_fn, params["blocks"], shared, x0, ids_mb,
+                mesh, "pp", aux_weight=aux_w, key=key,
+                uniform_last=n_auto >= 2,
+                uniform_all=self.sp > 1)
         (gemb,) = emb_vjp(dx0)
         grads = {"wte": gshared["wte"] + gemb["wte"].astype(jnp.float32),
                  "wpe": gemb["wpe"].astype(jnp.float32),

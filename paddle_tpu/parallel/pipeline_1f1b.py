@@ -200,7 +200,8 @@ def pipeline_1f1b_grads(stage_fn: Callable, last_fn: Callable,
                         stage_params: Any, shared_params: Any,
                         mb_inputs, mb_ids, mesh, axis_name: str = "pp",
                         aux_weight: float = 0.0, key=None,
-                        uniform_last: bool = False):
+                        uniform_last: bool = False,
+                        uniform_all: bool = False):
     """Run the 1F1B schedule and return grads directly.
 
     Args:
@@ -223,10 +224,20 @@ def pipeline_1f1b_grads(stage_fn: Callable, last_fn: Callable,
         beside the manual pp axis; the uniform body avoids the per-stage
         cond at the price of re-running the head on non-final stages'
         B-ticks.
+      uniform_all: additionally drop the f_on/b_on scheduling conds —
+        EVERY stage runs the F and B bodies on EVERY tick with the
+        results where-masked. Required when the stage bodies carry
+        EXPLICIT in-body collectives (sp x pp: ring attention's
+        ppermutes over "sp" inside the stage functions) — a collective
+        inside a stage-divergent lax.cond deadlocks the ring at runtime
+        (half the devices enter the rendezvous, half take the other
+        branch). Costs bubble-tick compute; correctness-identical.
 
     Returns (loss, d_stage_params [S,...], d_shared, d_mb_inputs):
       loss = mean over microbatches of loss_mb + aux_weight * sum of aux.
     """
+    if uniform_all:
+        uniform_last = True   # the cond-free B body is the uniform one
     S = mesh.shape[axis_name]
     M = mb_inputs.shape[0]
     if M < S:
@@ -289,9 +300,15 @@ def pipeline_1f1b_grads(stage_fn: Callable, last_fn: Callable,
             fx_buf = jax.lax.dynamic_index_in_dim(xbuf, my["f_slot"], 0,
                                                   keepdims=False)
             fx = jnp.where(stage == 0, fx_own, fx_buf)
-            y_out = jax.lax.cond(my["f_on"] > 0,
-                                 lambda _: f_mid(local, fx, fm),
-                                 lambda _: zero_act, None)
+            if uniform_all:
+                # cond-free: collectives inside f_mid must execute on
+                # every device every tick (see uniform_all docstring)
+                y_live = f_mid(local, fx, fm)
+                y_out = jnp.where(my["f_on"] > 0, y_live, zero_act)
+            else:
+                y_out = jax.lax.cond(my["f_on"] > 0,
+                                     lambda _: f_mid(local, fx, fm),
+                                     lambda _: zero_act, None)
 
             # ---- backward action --------------------------------------
             # buffer reads/updates and grad accumulation stay OUTSIDE the
@@ -356,8 +373,11 @@ def pipeline_1f1b_grads(stage_fn: Callable, last_fn: Callable,
                         jax.tree_util.tree_map(jnp.zeros_like, shared),
                         zero_act, jnp.zeros((), jnp.float32))
 
-            dl, dsh, dx_out, dloss = jax.lax.cond(
-                my["b_on"] > 0, do_b, no_b, None)
+            if uniform_all:
+                dl, dsh, dx_out, dloss = do_b(None)
+            else:
+                dl, dsh, dx_out, dloss = jax.lax.cond(
+                    my["b_on"] > 0, do_b, no_b, None)
             bon = my["b_on"] > 0
             gl = jax.tree_util.tree_map(
                 lambda a, b: a + jnp.where(bon, b.astype(jnp.float32), 0),

@@ -115,7 +115,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         return (acc / l[..., None]).astype(q.dtype)
 
     spec = P(None, None, axis, None)
-    return jax.shard_map(spmd, mesh=mesh, in_specs=(spec, spec, spec),
+    # nested-in-manual support (sp x pp): when this runs inside another
+    # shard_map's manual region (the 1F1B engine manual over "pp"), the
+    # inner shard_map must be built on the CONTEXT abstract mesh — the
+    # one where pp is already Manual — not the original device mesh
+    from jax.sharding import AxisType, get_abstract_mesh
+    ctx_mesh = get_abstract_mesh()
+    use_mesh = mesh
+    if getattr(ctx_mesh, "axis_names", ()) and \
+            AxisType.Manual in tuple(getattr(ctx_mesh, "axis_types", ())):
+        use_mesh = ctx_mesh
+    return jax.shard_map(spmd, mesh=use_mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names=frozenset({axis}),
                          check_vma=False)(q, k, v)
 

@@ -162,9 +162,10 @@ DEFAULT_CASES = [
         "Weight": {"shape": [128, 384]}}, "repeat": 5},
 ]
 
-# positive-definite input for cholesky
+# positive-definite input for cholesky — located by op name, not index
 _m = np.random.RandomState(0).randn(256, 256).astype("float32")
-DEFAULT_CASES[-2]["inputs"]["X"]["value"] = \
+next(c for c in DEFAULT_CASES
+     if c["op"] == "cholesky")["inputs"]["X"]["value"] = \
     (_m @ _m.T + 256 * np.eye(256, dtype="float32")).tolist()
 
 
