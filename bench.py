@@ -740,7 +740,10 @@ def main():
             configs = [
                 ("widedeep",
                  lambda: bench_widedeep(steps=10, warmup=2),
-                 lambda: bench_widedeep(steps=2, warmup=1)),
+                 # reduced mode skips the 4-subprocess PS-TCP section
+                 lambda: (os.environ.__setitem__(
+                     "BENCH_WIDEDEEP_PS", "0"),
+                     bench_widedeep(steps=2, warmup=1))[1]),
                 ("infer_latency",
                  lambda: bench_infer_latency(steps=15, warmup=3),
                  lambda: bench_infer_latency(steps=5, warmup=1)),

@@ -79,6 +79,7 @@ def test_op_bench_records():
     assert recs[2] == {"op": "not_an_op", "error": "not registered"}
 
 
+@pytest.mark.slow
 def test_op_bench_cli(tmp_path):
     out = tmp_path / "r.json"
     res = subprocess.run(
