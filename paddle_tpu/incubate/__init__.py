@@ -1,5 +1,6 @@
 """paddle.incubate (reference python/paddle/incubate/): experimental APIs."""
 from . import checkpoint
+from . import complex
 from . import fleet
 
-__all__ = ["checkpoint", "fleet"]
+__all__ = ["checkpoint", "complex", "fleet"]

@@ -1,0 +1,148 @@
+"""Complex-number tensor API (reference python/paddle/incubate/complex/:
+ComplexVariable + tensor/{math,linalg,manipulation}.py).
+
+The reference era predated native complex kernels, so it carried a
+ComplexVariable holding separate real/imag tensors and re-derived every
+op from real arithmetic. XLA/jax support complex64/128 natively — here
+ComplexTensor wraps ONE native complex jnp array (real+imag pairs are
+accepted and fused on construction), and each API function is the
+direct jnp op. Autodiff, jit and sharding all see an ordinary array.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ComplexTensor", "is_complex", "is_real",
+           "elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "matmul", "kron", "trace", "sum",
+           "reshape", "transpose"]
+
+
+class ComplexTensor:
+    """reference fluid/framework.py ComplexVariable: `.real` / `.imag`
+    views plus the arithmetic surface; backed by one native array."""
+
+    def __init__(self, value, imag=None):
+        v = jnp.asarray(getattr(value, "_value", value))
+        if imag is not None:
+            v = v + 1j * jnp.asarray(getattr(imag, "_value", imag))
+        self._value = v if jnp.iscomplexobj(v) \
+            else v.astype(jnp.complex64)
+
+    @property
+    def real(self):
+        return jnp.real(self._value)
+
+    @property
+    def imag(self):
+        return jnp.imag(self._value)
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def conj(self):
+        return ComplexTensor(jnp.conj(self._value))
+
+    def __repr__(self):
+        return f"ComplexTensor(shape={self.shape}, dtype={self.dtype})"
+
+    def __add__(self, o):
+        return elementwise_add(self, o)
+
+    def __radd__(self, o):
+        return elementwise_add(o, self)
+
+    def __sub__(self, o):
+        return elementwise_sub(self, o)
+
+    def __rsub__(self, o):
+        return elementwise_sub(o, self)
+
+    def __mul__(self, o):
+        return elementwise_mul(self, o)
+
+    def __rmul__(self, o):
+        return elementwise_mul(o, self)
+
+    def __truediv__(self, o):
+        return elementwise_div(self, o)
+
+    def __rtruediv__(self, o):
+        return elementwise_div(o, self)
+
+    def __matmul__(self, o):
+        return matmul(self, o)
+
+
+def _val(x):
+    if isinstance(x, ComplexTensor):
+        return x._value
+    return jnp.asarray(getattr(x, "_value", x))
+
+
+def is_complex(x) -> bool:
+    """helper.py is_complex."""
+    return isinstance(x, ComplexTensor) or jnp.iscomplexobj(_val(x))
+
+
+def is_real(x) -> bool:
+    return not is_complex(x)
+
+
+def _wrap(v):
+    return ComplexTensor(v) if jnp.iscomplexobj(v) else v
+
+
+def elementwise_add(x, y):
+    return _wrap(_val(x) + _val(y))
+
+
+def elementwise_sub(x, y):
+    return _wrap(_val(x) - _val(y))
+
+
+def elementwise_mul(x, y):
+    return _wrap(_val(x) * _val(y))
+
+
+def elementwise_div(x, y):
+    return _wrap(_val(x) / _val(y))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    a, b = _val(x), _val(y)
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    return _wrap(a @ b)
+
+
+def kron(x, y):
+    return _wrap(jnp.kron(_val(x), _val(y)))
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return _wrap(jnp.trace(_val(x), offset=offset, axis1=axis1,
+                           axis2=axis2))
+
+
+def sum(x, axis=None, keepdim=False):
+    return _wrap(jnp.sum(_val(x), axis=axis, keepdims=keepdim))
+
+
+def reshape(x, shape):
+    return _wrap(jnp.reshape(_val(x), shape))
+
+
+def transpose(x, perm):
+    return _wrap(jnp.transpose(_val(x), perm))

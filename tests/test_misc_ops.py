@@ -343,3 +343,52 @@ def test_grad_flows_through_differentiable_misc_ops():
         g = jax.grad(loss)([jnp.asarray(v) for v in ins.values()])
         for gv in g:
             assert np.isfinite(np.asarray(gv)).all(), op
+
+
+def test_incubate_complex_api():
+    """reference incubate/complex: ComplexVariable surface over native
+    jnp complex arrays (the reference re-derived complex arithmetic from
+    real pairs; XLA has native complex64)."""
+    import numpy as np
+    from paddle_tpu.incubate import complex as cpx
+
+    a = cpx.ComplexTensor(np.ones((2, 3), "float32"),
+                          np.full((2, 3), 2.0, "float32"))
+    b = cpx.ComplexTensor(np.full((2, 3), 3.0, "float32"),
+                          np.full((2, 3), -1.0, "float32"))
+    assert cpx.is_complex(a) and cpx.is_real(np.ones(3))
+    np.testing.assert_allclose((a + b).numpy(), (1 + 2j) + (3 - 1j))
+    np.testing.assert_allclose((a * b).numpy(), (1 + 2j) * (3 - 1j))
+    np.testing.assert_allclose((a - b).numpy(), (1 + 2j) - (3 - 1j))
+    np.testing.assert_allclose((a / b).numpy(), (1 + 2j) / (3 - 1j),
+                               rtol=1e-6)
+    np.testing.assert_allclose(a.conj().numpy(), 1 - 2j)
+    np.testing.assert_allclose(a.real, 1.0)
+    np.testing.assert_allclose(a.imag, 2.0)
+
+    m = cpx.ComplexTensor((np.arange(4) + 1j * np.arange(4)
+                           ).reshape(2, 2).astype("complex64"))
+    mm = cpx.matmul(m, m).numpy()
+    np.testing.assert_allclose(mm, m.numpy() @ m.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        cpx.trace(m).numpy(), np.trace(m.numpy()), rtol=1e-6)
+    kr = cpx.kron(m, m).numpy()
+    np.testing.assert_allclose(kr, np.kron(m.numpy(), m.numpy()),
+                               rtol=1e-6)
+    s = cpx.sum(m, axis=0).numpy()
+    np.testing.assert_allclose(s, m.numpy().sum(0), rtol=1e-6)
+    r = cpx.reshape(m, (4,))
+    assert r.shape == (4,)
+    t = cpx.transpose(m, (1, 0)).numpy()
+    np.testing.assert_allclose(t, m.numpy().T)
+
+
+def test_incubate_complex_reflected_ops():
+    import numpy as np
+    from paddle_tpu.incubate import complex as cpx
+    a = cpx.ComplexTensor(np.ones((2,), "float32"),
+                          np.ones((2,), "float32"))
+    np.testing.assert_allclose((2.0 * a).numpy(), 2 + 2j)
+    np.testing.assert_allclose(((1 + 1j) + a).numpy(), 2 + 2j)
+    np.testing.assert_allclose((2.0 - a).numpy(), 1 - 1j)
+    np.testing.assert_allclose((2.0 / a).numpy(), 2 / (1 + 1j), rtol=1e-6)
