@@ -1362,3 +1362,123 @@ def _correlation(ctx, ins, attrs):
             shifted = bp[:, :, d + dy:d + dy + h, d + dx:d + dx + w]
             rows.append(jnp.mean(a * shifted, axis=1))
     return out(jnp.stack(rows, axis=1).astype(x(ins, "Input1").dtype))
+
+
+# ---------------------------------------------------------------------------
+# tree_conv (TBCNN) + rank_attention
+# ---------------------------------------------------------------------------
+
+def _tree_patches(edges: np.ndarray, n_nodes: int, max_depth: int):
+    """Tree2ColUtil (math/tree2col.cc): per-root DFS patch of nodes
+    within max_depth, each weighted by the continuous-binary-tree etas
+    (tree2col.h TreeNode). Returns dense (A_l, A_r, A_t) [n, n] maps so
+    the conv becomes three constant matmuls — linear in the features,
+    so autodiff covers the backward."""
+    tr: dict[int, list[int]] = {}
+    node_count = 0
+    for u, v in edges:
+        if u == 0 or v == 0:
+            break
+        tr.setdefault(int(u), []).append(int(v))
+        node_count += 1
+    node_count += 1
+    node_count = min(node_count, n_nodes)
+    al = np.zeros((n_nodes, n_nodes), np.float32)
+    ar = np.zeros_like(al)
+    at = np.zeros_like(al)
+    rows = 0
+    md = float(max_depth)
+    for root in range(1, node_count + 1):
+        # iterative DFS mirroring construct_patch: (node, index, pclen,
+        # depth); root = (root, 1, 1, 0)
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        while stack:
+            node, _, _, depth = stack.pop()
+            kids = tr.get(node, [])
+            for i, v in enumerate(kids):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    item = (v, i + 1, len(kids), depth + 1)
+                    stack.append(item)
+                    patch.append(item)
+        if not patch:
+            continue
+        for node, index, pclen, depth in patch:
+            eta_t = (md - depth) / md
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1.0 - eta_t) * tmp
+            # reference tree2col.h: eta_r scales by (1 - eta_l) with the
+            # FULL eta_l (which already carries the (1-eta_t) factor)
+            eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+            al[rows, node - 1] += eta_l
+            ar[rows, node - 1] += eta_r
+            at[rows, node - 1] += eta_t
+        rows += 1
+    return al, ar, at
+
+
+@register("tree_conv", no_grad_slots=("EdgeSet",),
+          attrs={"max_depth": 2})
+def _tree_conv(ctx, ins, attrs):
+    """TBCNN tree convolution (tree_conv_op.h + math/tree2col): patches
+    gathered per root with continuous-binary-tree eta weights, then one
+    GEMM against the [F, 3, out, filters] filter. The tree structure
+    (EdgeSet) must be a trace-time constant — it determines the sparse
+    linear maps; features and filters stay fully differentiable."""
+    emb = x(ins, "NodesVector")        # [B, n, F]
+    edges = x(ins, "EdgeSet")          # [B, E, 2] int
+    flt = x(ins, "Filter")             # [F, 3, out, nf]
+    if isinstance(edges, jax.core.Tracer):
+        raise NotImplementedError(
+            "tree_conv: EdgeSet (the tree structure) must be a "
+            "compile-time constant — it defines the patch gather maps")
+    md = int(attrs.get("max_depth", 2))
+    B, n, F = emb.shape
+    Fd, three, out_sz, nf = flt.shape
+    w2 = flt.reshape(F * 3, out_sz * nf)
+    ed = np.asarray(edges)
+    outs = []
+    for b in range(B):
+        al, ar, at = _tree_patches(ed[b], n, md)
+        e = emb[b].astype(F32)
+        # interleaved (f0l, f0r, f0t, f1l, ...) per tree2col row layout
+        pl = jnp.asarray(al) @ e
+        pr = jnp.asarray(ar) @ e
+        pt = jnp.asarray(at) @ e
+        patch = jnp.stack([pl, pr, pt], axis=-1).reshape(n, F * 3)
+        outs.append((patch @ w2.astype(F32)).reshape(n, out_sz, nf))
+    return out(jnp.stack(outs).astype(emb.dtype))
+
+
+@register("rank_attention", no_grad_slots=("RankOffset",),
+          no_grad_out_slots=("InputHelp", "InsRank"),
+          attrs={"MaxRank": 3, "MaxSize": 0})
+def _rank_attention(ctx, ins, attrs):
+    """CTR rank attention (rank_attention_op.cc + rank_attention.cu.h):
+    per instance, gather up to MaxRank rank-neighbors' feature rows and
+    the per-(ins_rank, neighbor_rank) parameter blocks, then contract —
+    out[i] = sum_k X[idx_k] @ P[(lower_i-1)*MaxRank + (faster_k-1)].
+    Pure gathers + einsum: differentiable in X and RankParam, jittable
+    with RankOffset as runtime data."""
+    v = x(ins, "X").astype(F32)               # [N, d]
+    ro = x(ins, "RankOffset").astype(jnp.int32)   # [N, 1+2*MaxRank]
+    par = x(ins, "RankParam").astype(F32)     # [MaxRank^2 * d, pc]
+    mr = int(attrs.get("MaxRank", 3))
+    N, d = v.shape
+    pc = par.shape[1]
+    pblocks = par.reshape(mr * mr, d, pc)
+    lower = ro[:, 0] - 1                      # [N] ins rank (may be -1)
+    faster = ro[:, 1::2] - 1                  # [N, mr] neighbor ranks
+    index = ro[:, 2::2]                       # [N, mr] row indices
+    valid = (lower[:, None] >= 0) & (faster >= 0)
+    xin = jnp.where(valid[..., None],
+                    v[jnp.clip(index, 0, N - 1)], 0.0)      # [N, mr, d]
+    bsel = jnp.clip(lower[:, None] * mr + faster, 0, mr * mr - 1)
+    psel = jnp.where(valid[..., None, None],
+                     pblocks[bsel], 0.0)      # [N, mr, d, pc]
+    r = jnp.einsum("nkd,nkdp->np", xin, psel)
+    return {"Out": [r.astype(x(ins, "X").dtype)],
+            "InputHelp": [xin.reshape(N, mr * d)],
+            "InsRank": [(lower + 1).astype(jnp.float32).reshape(N, 1)]}

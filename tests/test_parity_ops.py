@@ -560,6 +560,7 @@ PARITY_EXEMPT = {
     "yolov3_loss", "sigmoid_focal_loss", "max_pool2d_with_index",
     "max_pool3d_with_index", "unpool", "prroi_pool", "correlation",
     "gru", "lstm", "lstmp", "sequence_concat", "shrink_rnn_memory",
+    "tree_conv", "rank_attention",
     "lod_reset", "multiplex", "cholesky",
     # thin aliases over already-swept kernels
     "deformable_conv_v1", "depthwise_conv2d_transpose",
@@ -615,3 +616,60 @@ def test_max_pool2d_with_index_adaptive():
                 assert mx[0, c, i, j] == win.max()
                 fi = idx[0, c, i, j]
                 assert x[0, c, fi // 7, fi % 7] == win.max()
+
+
+def test_tree_conv_single_chain():
+    """Chain tree 1->2->3, max_depth=2: each root's patch is itself +
+    its direct child, with the continuous-binary-tree eta weights."""
+    import jax.numpy as jnp
+    F, out_sz, nf = 2, 3, 1
+    emb = _r(1, 3, F)
+    edges = np.array([[[1, 2], [2, 3], [0, 0]]], np.int32)
+    flt = _r(F, 3, out_sz, nf, seed=2)
+    r = np.asarray(run_eager(
+        "tree_conv", {"NodesVector": emb, "EdgeSet": edges,
+                      "Filter": flt}, {"max_depth": 2})["Out"][0])
+    assert r.shape == (1, 3, out_sz, nf)
+    # manual: root node u has patch [(u,1,1,0)] + child (v,1,1,1)
+    w2 = flt.reshape(F * 3, out_sz * nf)
+
+    def row(contribs):
+        p = np.zeros((F, 3), np.float32)
+        for node, index, pclen, depth in contribs:
+            md = 2.0
+            eta_t = (md - depth) / md
+            tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * tmp
+            p[:, 0] += eta_l * emb[0, node - 1]
+            p[:, 1] += (1 - eta_t) * (1 - eta_l) * emb[0, node - 1]
+            p[:, 2] += eta_t * emb[0, node - 1]
+        return (p.reshape(-1) @ w2).reshape(out_sz, nf)
+
+    np.testing.assert_allclose(r[0, 0], row([(1, 1, 1, 0), (2, 1, 1, 1)]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r[0, 1], row([(2, 1, 1, 0), (3, 1, 1, 1)]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(r[0, 2], row([(3, 1, 1, 0)]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rank_attention_gather_contract():
+    mr, d, pc = 2, 3, 4
+    v = _r(3, d)
+    par = _r(mr * mr * d, pc, seed=1)
+    # ins 0: rank 1, neighbors (rank 1 -> row 1), (rank 2 -> row 2)
+    # ins 1: rank 2, neighbor (rank 1 -> row 0); second slot invalid
+    # ins 2: invalid ins rank -> zero output
+    ro = np.array([[1, 1, 1, 2, 2],
+                   [2, 1, 0, 0, -1],
+                   [0, 1, 0, 0, 0]], np.int32)
+    r = run_eager("rank_attention",
+                  {"X": v, "RankOffset": ro, "RankParam": par},
+                  {"MaxRank": mr})
+    o = np.asarray(r["Out"][0])
+    pb = par.reshape(mr * mr, d, pc)
+    want0 = v[1] @ pb[0] + v[2] @ pb[1]     # (1,1) and (1,2) blocks
+    want1 = v[0] @ pb[2]                    # (2,1) block
+    np.testing.assert_allclose(o[0], want0, rtol=1e-5)
+    np.testing.assert_allclose(o[1], want1, rtol=1e-5)
+    np.testing.assert_allclose(o[2], 0.0, atol=1e-7)
