@@ -1046,3 +1046,34 @@ def _fused_dropout_add_ln(ctx, ins, attrs):
     var = jnp.mean(jnp.square(z - mean), -1, keepdims=True)
     zhat = (z - mean) * jax.lax.rsqrt(var + eps)
     return out((zhat * scale + bias).astype(res.dtype))
+
+
+# ---------------------------------------------------------------------------
+# fused transformer FFN: act(x@W1+b1)@W2+b2 with the 4H intermediate in
+# VMEM (Pallas, ops/pallas_ffn.py; reference fused_feedforward_op tier).
+# ---------------------------------------------------------------------------
+
+@register("fused_ffn", infer_shape=same_shape_as("X", "Out"),
+          attrs={"activation": "gelu"})
+def _fused_ffn_op(ctx, ins, attrs):
+    v = x(ins, "X")
+    w1, b1 = x(ins, "W1"), x(ins, "B1")
+    w2, b2 = x(ins, "W2"), x(ins, "B2")
+    act = attrs.get("activation", "gelu")
+    h = v.shape[-1]
+    i = w1.shape[1]
+    m = 1
+    for s in v.shape[:-1]:
+        m *= s
+    from ...ops.pallas_ffn import can_use_fused_ffn, fused_ffn
+    if act in ("gelu", "relu") and can_use_fused_ffn(m, h, i):
+        return out(fused_ffn(v, w1, b1, w2, b2, act))
+    # composed fallback (non-aligned dims / pallas disabled / other act)
+    hid = v.reshape(m, h) @ w1 + b1
+    if act == "gelu":
+        hid = jax.nn.gelu(hid.astype(jnp.float32),
+                          approximate=False).astype(v.dtype)
+    else:
+        from ..registry import require
+        hid = require(act).compute(ctx, {"X": [hid]}, {})["Out"][0]
+    return out((hid @ w2 + b2).astype(v.dtype).reshape(v.shape))

@@ -1403,8 +1403,6 @@ def _tree_patches(edges: np.ndarray, n_nodes: int, max_depth: int):
                     item = (v, i + 1, len(kids), depth + 1)
                     stack.append(item)
                     patch.append(item)
-        if not patch:
-            continue
         for node, index, pclen, depth in patch:
             eta_t = (md - depth) / md
             tmp = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)

@@ -1,0 +1,157 @@
+"""Pallas TPU fused transformer FFN: y = act(x @ W1 + b1) @ W2 + b2.
+
+The round-5 BERT traffic audit (bench.py bench_bert docstring) measured
+the FFN activation tier — erf-gelu + its saved branch predicates over
+bf16[B,T,4H] — at ~19% of the train step, VPU-compute-bound and
+materialised to HBM between the two matmuls. This kernel keeps the 4H
+intermediate in VMEM: per (M-block, I-block) grid cell it computes
+act(x_blk @ W1_blk + b1_blk) on-chip and accumulates the second matmul
+into an f32 scratch, so the intermediate never exists in HBM and the
+gelu runs tile-at-a-time interleaved with MXU work.
+
+Reference equivalent: the fused FFN passes of
+operators/fused/fused_feedforward_op.cc (the mechanism — one kernel for
+linear+act+linear — re-expressed as a TPU Mosaic pipeline).
+
+Backward (custom_vjp) rematerialises: only x is saved; dx/dW come from
+one recompute matmul + the standard four, all left to XLA — the fwd
+traffic/VPU win is where the audit says the money is.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_attention import on_tpu
+
+__all__ = ["fused_ffn", "can_use_fused_ffn"]
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def can_use_fused_ffn(m: int, h: int, i: int) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("PADDLE_TPU_DISABLE_FFN_FUSION"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    # MXU-aligned shapes only; fall back to the XLA chain otherwise
+    return (m % 256 == 0 and h % 128 == 0 and i % 512 == 0
+            and h <= 4096)
+
+
+def _erf_poly(z):
+    """Abramowitz & Stegun 7.1.26 rational erf (|err| < 1.5e-7 in f32):
+    Pallas TPU has no erf/erfc primitive, and 1.5e-7 is far inside bf16
+    activation tolerance."""
+    s = jnp.sign(z)
+    a = jnp.abs(z)
+    t = 1.0 / (1.0 + 0.3275911 * a)
+    poly = t * (0.254829592 + t * (-0.284496736 + t * (
+        1.421413741 + t * (-1.453152027 + t * 1.061405429))))
+    return s * (1.0 - poly * jnp.exp(-a * a))
+
+
+def _gelu_exact(v):
+    f = v.astype(jnp.float32)
+    return (0.5 * f * (1.0 + _erf_poly(f * 0.7071067811865476))
+            ).astype(v.dtype)
+
+
+_ACTS = {
+    "gelu": _gelu_exact,
+    "relu": jax.nn.relu,
+}
+
+
+def _ffn_kernel(x_ref, w1_ref, b1_ref, w2_ref, b2_ref, o_ref, acc_ref,
+                *, act, n_i):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    a = jnp.dot(x_ref[...], w1_ref[...],
+                preferred_element_type=jnp.float32) + b1_ref[...]
+    hid = act(a).astype(x_ref.dtype)
+    acc_ref[...] += jnp.dot(hid, w2_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_i - 1)
+    def _emit():
+        o_ref[...] = (acc_ref[...] + b2_ref[...]).astype(o_ref.dtype)
+
+
+def _ffn_fwd_impl(x2, w1, b1, w2, b2, act_name, bm, bi):
+    m, h = x2.shape
+    i = w1.shape[1]
+    n_i = i // bi
+    act = _ACTS[act_name]
+    return pl.pallas_call(
+        functools.partial(_ffn_kernel, act=act, n_i=n_i),
+        grid=(m // bm, n_i),
+        in_specs=[
+            pl.BlockSpec((bm, h), lambda mi, ji: (mi, 0)),
+            pl.BlockSpec((h, bi), lambda mi, ji: (0, ji)),
+            pl.BlockSpec((1, bi), lambda mi, ji: (0, ji)),
+            pl.BlockSpec((bi, h), lambda mi, ji: (ji, 0)),
+            pl.BlockSpec((1, h), lambda mi, ji: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, h), lambda mi, ji: (mi, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, h), x2.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, h), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(x2, w1, b1.reshape(1, i), w2, b2.reshape(1, h))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def fused_ffn(x, w1, b1, w2, b2, act_name="gelu"):
+    """x [..., H] -> [..., H]; the 4H intermediate stays in VMEM."""
+    return _fused_ffn_fwd(x, w1, b1, w2, b2, act_name)[0]
+
+
+def _fused_ffn_fwd(x, w1, b1, w2, b2, act_name):
+    shape = x.shape
+    h = shape[-1]
+    x2 = x.reshape(-1, h)
+    m = x2.shape[0]
+    bm = 512 if m % 512 == 0 else 256
+    bi = 512
+    y = _ffn_fwd_impl(x2, w1, b1, w2, b2, act_name, bm, bi)
+    return y.reshape(shape), (x, w1, b1, w2, b2)
+
+
+def _fused_ffn_bwd(act_name, res, dy):
+    x, w1, b1, w2, b2 = res
+    act = _ACTS[act_name]
+    h = x.shape[-1]
+    x2 = x.reshape(-1, h).astype(jnp.float32)
+    dy2 = dy.reshape(-1, h).astype(jnp.float32)
+
+    def chain(x2f, w1f, b1f, w2f, b2f):
+        hid = act(x2f @ w1f + b1f)
+        return hid @ w2f + b2f
+
+    # one recompute matmul + the standard four, via XLA's autodiff —
+    # nothing was saved between the matmuls
+    _, vjp = jax.vjp(chain, x2, w1.astype(jnp.float32),
+                     b1.astype(jnp.float32), w2.astype(jnp.float32),
+                     b2.astype(jnp.float32))
+    dx2, dw1, db1, dw2, db2 = vjp(dy2)
+    return (dx2.reshape(x.shape).astype(x.dtype),
+            dw1.astype(w1.dtype), db1.astype(b1.dtype),
+            dw2.astype(w2.dtype), db2.astype(b2.dtype))
+
+
+fused_ffn.defvjp(_fused_ffn_fwd, _fused_ffn_bwd)

@@ -247,3 +247,58 @@ def test_fused_epilogue_op_and_encoder_parity(_interpret_env):
     finally:
         os.environ.pop("PADDLE_TPU_DISABLE_PALLAS", None)
     np.testing.assert_allclose(out_fused, out_ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_ffn_matches_chain():
+    """Pallas fused FFN (ops/pallas_ffn.py): interpret-mode parity vs
+    the composed linear-gelu-linear chain, fwd and all five grads."""
+    import os
+    os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+    try:
+        from paddle_tpu.ops.pallas_ffn import can_use_fused_ffn, fused_ffn
+        M, H, I = 256, 128, 512
+        assert can_use_fused_ffn(M, H, I)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(M, H).astype("float32"))
+        w1 = jnp.asarray((rng.randn(H, I) * 0.05).astype("float32"))
+        b1 = jnp.asarray(rng.randn(I).astype("float32") * 0.1)
+        w2 = jnp.asarray((rng.randn(I, H) * 0.05).astype("float32"))
+        b2 = jnp.asarray(rng.randn(H).astype("float32") * 0.1)
+
+        def ref(x, w1, b1, w2, b2):
+            return jax.nn.gelu(x @ w1 + b1,
+                               approximate=False) @ w2 + b2
+
+        y = fused_ffn(x, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(ref(x, w1, b1, w2, b2)),
+                                   rtol=5e-5, atol=5e-5)
+        g = jax.grad(lambda *a: jnp.sum(fused_ffn(*a) ** 2),
+                     argnums=(0, 1, 2, 3, 4))(x, w1, b1, w2, b2)
+        gr = jax.grad(lambda *a: jnp.sum(ref(*a) ** 2),
+                      argnums=(0, 1, 2, 3, 4))(x, w1, b1, w2, b2)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+    finally:
+        os.environ.pop("PADDLE_TPU_PALLAS_INTERPRET", None)
+
+
+def test_fused_ffn_op_fallback_parity():
+    """The fused_ffn OP falls back to the composed chain off-TPU /
+    non-aligned; both paths must agree with the encoder's unfused
+    result."""
+    from test_tail_ops import run_eager
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 60).astype("float32")     # 60 not MXU-aligned
+    w1 = (rng.randn(60, 120) * 0.05).astype("float32")
+    b1 = np.zeros(120, "float32")
+    w2 = (rng.randn(120, 60) * 0.05).astype("float32")
+    b2 = np.zeros(60, "float32")
+    r = np.asarray(run_eager(
+        "fused_ffn", {"X": x, "W1": w1, "B1": b1, "W2": w2, "B2": b2},
+        {"activation": "gelu"})["Out"][0])
+    want = np.asarray(
+        jax.nn.gelu(jnp.asarray(x) @ w1 + b1, approximate=False)
+        @ w2 + b2)
+    np.testing.assert_allclose(r, want, rtol=2e-5, atol=2e-5)
