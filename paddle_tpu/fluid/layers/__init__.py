@@ -1,13 +1,16 @@
 """fluid.layers namespace (reference python/paddle/fluid/layers/)."""
-from . import nn, tensor, detection
+from . import nn, tensor, detection, parity
 from .math_op_patch import monkey_patch_variable
 monkey_patch_variable()
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .parity import *  # noqa: F401,F403
 
 from .nn import __all__ as _nn_all
 from .tensor import __all__ as _tensor_all
 from .detection import __all__ as _det_all
+from .parity import __all__ as _parity_all
 
-__all__ = list(_nn_all) + list(_tensor_all) + list(_det_all)
+__all__ = list(_nn_all) + list(_tensor_all) + list(_det_all) \
+    + list(_parity_all)

@@ -1,0 +1,304 @@
+"""fluid.layers wrappers for the round-5 parity op tier (the public
+names the reference exposes in python/paddle/fluid/layers/{nn,loss,
+sequence_lod,detection}.py for these kernels)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "multiplex", "crop", "crop_tensor", "hinge_loss", "log_loss",
+    "cos_sim", "bpr_loss", "continuous_value_model", "reverse",
+    "expand_as", "pad_constant_like", "unpool", "cholesky",
+    "sequence_concat", "sequence_reshape", "dynamic_gru", "dynamic_lstm",
+    "fsp_matrix", "shuffle_batch", "partial_sum", "partial_concat",
+    "sigmoid_focal_loss", "yolov3_loss", "prroi_pool", "rank_attention",
+    "tree_conv", "sample_logits", "batch_fc",
+]
+
+
+def _single(op_type, inputs, attrs=None, out_slot="Out", dtype=None,
+            name=None, extra_outs=()):
+    from .tensor import _single_out_op
+    return _single_out_op(op_type, op_type, inputs, attrs, dtype,
+                          out_slot, name=name, extra_outs=extra_outs)
+
+
+def multiplex(inputs, index, name=None):
+    return _single("multiplex", {"X": list(inputs), "Ids": [index]},
+                   name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    ins = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Y"] = [shape]
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = [offsets]
+    return _single("crop", ins, attrs, name=name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    ins = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, (list, tuple)):
+        attrs["shape"] = list(shape)
+    elif shape is not None:
+        ins["Shape"] = [shape]
+    if isinstance(offsets, (list, tuple)):
+        attrs["offsets"] = list(offsets)
+    elif offsets is not None:
+        ins["Offsets"] = [offsets]
+    return _single("crop_tensor", ins, attrs, name=name)
+
+
+def hinge_loss(input, label, name=None):
+    return _single("hinge_loss", {"Logits": [input], "Labels": [label]},
+                   out_slot="Loss", name=name)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _single("log_loss", {"Predicted": [input], "Labels": [label]},
+                   {"epsilon": epsilon}, out_slot="Loss", name=name)
+
+
+def cos_sim(X, Y, name=None):
+    out, _, _ = _single("cos_sim", {"X": [X], "Y": [Y]}, name=name,
+                        extra_outs=(("XNorm", "float32"),
+                                    ("YNorm", "float32")))
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    return _single("bpr_loss", {"X": [input], "Label": [label]},
+                   out_slot="Y", name=name)
+
+
+def continuous_value_model(input, cvm, use_cvm=True, name=None):
+    return _single("cvm", {"X": [input], "CVM": [cvm]},
+                   {"use_cvm": use_cvm}, out_slot="Y", name=name)
+
+
+def reverse(x, axis, name=None):
+    return _single("reverse", {"X": [x]},
+                   {"axis": [axis] if isinstance(axis, int) else
+                    list(axis)}, name=name)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _single("expand_as", {"X": [x],
+                                 "target_tensor": [target_tensor]},
+                   name=name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _single("pad_constant_like", {"X": [x], "Y": [y]},
+                   {"pad_value": pad_value}, name=name)
+
+
+def unpool(x, indices, kernel_size=2, stride=2, padding=0,
+           output_size=None, name=None):
+    to2 = lambda v: [v, v] if isinstance(v, int) else list(v)
+    return _single("unpool", {"X": [x], "Indices": [indices]},
+                   {"ksize": to2(kernel_size), "strides": to2(stride),
+                    "paddings": to2(padding),
+                    "output_size": list(output_size or [])}, name=name)
+
+
+def cholesky(x, upper=False, name=None):
+    return _single("cholesky", {"X": [x]}, {"upper": upper}, name=name)
+
+
+def sequence_concat(input, seq_lens=None, name=None):
+    """Dense+lengths form: with seq_lens given, returns (out, new_lens)
+    — the packed tensor plus the combined valid lengths (the kernel's
+    SeqLenOut; the reference's LoD carries this implicitly)."""
+    ins = {"X": list(input)}
+    if seq_lens:
+        ins["SeqLen"] = list(seq_lens)
+        return _single("sequence_concat", ins, name=name,
+                       extra_outs=(("SeqLenOut", "int64"),))
+    return _single("sequence_concat", ins, name=name)
+
+
+def sequence_reshape(input, new_dim, seq_len=None, name=None):
+    """Dense+lengths form: with seq_len given, returns (out, new_lens)."""
+    ins = {"X": [input]}
+    if seq_len is not None:
+        ins["SeqLen"] = [seq_len]
+        return _single("sequence_reshape", ins, {"new_dim": new_dim},
+                       name=name, extra_outs=(("SeqLenOut", "int64"),))
+    return _single("sequence_reshape", ins, {"new_dim": new_dim},
+                   name=name)
+
+
+def dynamic_gru(input, weight, bias=None, h_0=None, origin_mode=False,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", name=None):
+    """Monolithic GRU over dense [B, T, 3D] gate inputs (the layer-level
+    form of the `gru` op; reference layers/rnn dynamic_gru wraps the
+    same kernel over LoD input)."""
+    ins = {"Input": [input], "Weight": [weight]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    helper = LayerHelper("dynamic_gru", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="gru", inputs=ins,
+                     outputs={"Hidden": [out]},
+                     attrs={"origin_mode": origin_mode,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "activation": candidate_activation})
+    return out
+
+
+def dynamic_lstm(input, weight, bias=None, h_0=None, c_0=None,
+                 use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", name=None):
+    """Monolithic LSTM over dense [B, T, 4D] gate inputs -> (hidden,
+    cell)."""
+    ins = {"Input": [input], "Weight": [weight]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    if h_0 is not None:
+        ins["H0"] = [h_0]
+    if c_0 is not None:
+        ins["C0"] = [c_0]
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hid = helper.create_variable_for_type_inference(input.dtype)
+    cell = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="lstm", inputs=ins,
+                     outputs={"Hidden": [hid], "Cell": [cell]},
+                     attrs={"use_peepholes": use_peepholes,
+                            "is_reverse": is_reverse,
+                            "gate_activation": gate_activation,
+                            "cell_activation": cell_activation,
+                            "candidate_activation": candidate_activation})
+    return hid, cell
+
+
+def fsp_matrix(x, y, name=None):
+    return _single("fsp", {"X": [x], "Y": [y]}, name=name)
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """Row shuffle with a fresh permutation per run: like the reference
+    layer, a persistable seed variable is threaded through Seed ->
+    SeedOut, so each executor step advances it (same var on both
+    slots)."""
+    helper = LayerHelper("shuffle_batch", name=name)
+    if seed is None or isinstance(seed, int):
+        seed_var = helper.create_global_variable(
+            shape=[1], dtype="int64", persistable=True,
+            value=float(seed or 0))
+    else:
+        seed_var = seed
+    out = helper.create_variable_for_type_inference(x.dtype)
+    idx = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(type="shuffle_batch",
+                     inputs={"X": [x], "Seed": [seed_var]},
+                     outputs={"Out": [out], "ShuffleIdx": [idx],
+                              "SeedOut": [seed_var]},
+                     attrs={"startup_seed": 0})
+    return out
+
+
+def partial_sum(input, start_index=0, length=-1, name=None):
+    return _single("partial_sum", {"X": list(input)},
+                   {"start_index": start_index, "length": length},
+                   name=name)
+
+
+def partial_concat(input, start_index=0, length=-1, name=None):
+    return _single("partial_concat", {"X": list(input)},
+                   {"start_index": start_index, "length": length},
+                   name=name)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25,
+                       name=None):
+    return _single("sigmoid_focal_loss",
+                   {"X": [x], "Label": [label], "FgNum": [fg_num]},
+                   {"gamma": gamma, "alpha": alpha}, name=name)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, scale_x_y=1.0, name=None):
+    ins = {"X": [x], "GTBox": [gt_box], "GTLabel": [gt_label]}
+    if gt_score is not None:
+        ins["GTScore"] = [gt_score]
+    out, _, _ = _single(
+        "yolov3_loss", ins,
+        {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+         "class_num": class_num, "ignore_thresh": ignore_thresh,
+         "downsample_ratio": downsample_ratio,
+         "use_label_smooth": use_label_smooth, "scale_x_y": scale_x_y},
+        out_slot="Loss", name=name,
+        extra_outs=(("ObjectnessMask", "float32"),
+                    ("GTMatchMask", "int32")))
+    return out
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    ins = {"X": [input], "ROIs": [rois]}
+    if batch_roi_nums is not None:
+        ins["BatchRoINums"] = [batch_roi_nums]
+    return _single("prroi_pool", ins,
+                   {"spatial_scale": spatial_scale,
+                    "pooled_height": pooled_height,
+                    "pooled_width": pooled_width}, name=name)
+
+
+def rank_attention(input, rank_offset, rank_param, max_rank=3,
+                   max_size=0, name=None):
+    out, _, _ = _single(
+        "rank_attention",
+        {"X": [input], "RankOffset": [rank_offset],
+         "RankParam": [rank_param]},
+        {"MaxRank": max_rank, "MaxSize": max_size}, name=name,
+        extra_outs=(("InputHelp", "float32"), ("InsRank", "float32")))
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2, name=None):
+    return _single("tree_conv",
+                   {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                    "Filter": [filter]},
+                   {"max_depth": max_depth}, name=name)
+
+
+def sample_logits(logits, label, num_samples, seed=0,
+                  remove_accidental_hits=True, name=None):
+    helper = LayerHelper("sample_logits", name=name)
+    outs = {s: [helper.create_variable_for_type_inference(d, True)]
+            for s, d in (("Samples", "int64"), ("Probabilities",
+                         "float32"), ("LogitsDim", "int64"),
+                         ("LabelsDim", "int64"),
+                         ("SampledLabels", "int64"))}
+    sl = helper.create_variable_for_type_inference(logits.dtype)
+    outs["SampledLogits"] = [sl]
+    helper.append_op(type="sample_logits",
+                     inputs={"Logits": [logits], "Labels": [label]},
+                     outputs=outs,
+                     attrs={"num_samples": num_samples, "seed": seed,
+                            "remove_accidental_hits":
+                                remove_accidental_hits,
+                            "use_customized_samples": False,
+                            "uniq": True})
+    return outs["Samples"][0], outs["Probabilities"][0], sl
+
+
+def batch_fc(input, param, bias=None, name=None):
+    ins = {"Input": [input], "W": [param]}
+    if bias is not None:
+        ins["Bias"] = [bias]
+    return _single("batch_fc", ins, name=name)

@@ -57,14 +57,22 @@ def create_global_var(shape, value, dtype, persistable=False,
 
 
 def _single_out_op(helper_name, op_type, inputs, attrs=None, dtype=None,
-                   out_slot="Out"):
-    helper = LayerHelper(helper_name)
-    first = next(iter(inputs.values()))[0]
+                   out_slot="Out", name=None, extra_outs=()):
+    """One primary output (dtype inferred from the first input) plus
+    optional auxiliary output slots as (slot, dtype) pairs."""
+    helper = LayerHelper(helper_name, name=name)
+    first = next(v[0] for v in inputs.values() if v)
     out = helper.create_variable_for_type_inference(
         dtype or (first.dtype if isinstance(first, Variable) else "float32"))
-    helper.append_op(type=op_type, inputs=inputs, outputs={out_slot: [out]},
+    outputs = {out_slot: [out]}
+    extras = []
+    for slot, edtype in extra_outs:
+        ev = helper.create_variable_for_type_inference(edtype, True)
+        outputs[slot] = [ev]
+        extras.append(ev)
+    helper.append_op(type=op_type, inputs=inputs, outputs=outputs,
                      attrs=attrs or {})
-    return out
+    return (out, *extras) if extras else out
 
 
 def cast(x, dtype):

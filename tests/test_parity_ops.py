@@ -673,3 +673,66 @@ def test_rank_attention_gather_contract():
     np.testing.assert_allclose(o[0], want0, rtol=1e-5)
     np.testing.assert_allclose(o[1], want1, rtol=1e-5)
     np.testing.assert_allclose(o[2], 0.0, atol=1e-7)
+
+
+def test_parity_layer_wrappers(fresh_programs):
+    """fluid.layers wrappers over the parity tier build + run through
+    the Executor (the reference's public layer names)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        a = layers.fill_constant([2, 4], "float32", 2.0)
+        b = layers.fill_constant([2, 4], "float32", 3.0)
+        sim = layers.cos_sim(a, b)
+        rev = layers.reverse(a, 1)
+        hl = layers.hinge_loss(a, b)
+        ps = layers.partial_sum([a, b], start_index=1, length=2)
+        spd = layers.data("spd", [3, 3], dtype="float32")
+        ch = layers.cholesky(spd)
+    exe = fluid.Executor()
+    exe.run(startup)
+    m = np.random.RandomState(0).randn(3, 3).astype("float32")
+    spd_v = m @ m.T + 3 * np.eye(3, dtype="float32")
+    fetches = [sim.name, rev.name, hl.name, ps.name, ch.name]
+    out = exe.run(main, feed={"spd": spd_v}, fetch_list=fetches)
+    np.testing.assert_allclose(out[0], 1.0, rtol=1e-6)   # parallel vecs
+    np.testing.assert_allclose(out[1], 2.0)
+    # hinge: label 3 -> (2*3-1)*2 = 10 > 1 -> max(0, 1-10) = 0
+    np.testing.assert_allclose(out[2], 0.0)
+    np.testing.assert_allclose(out[3], 5.0 * np.ones((2, 2)))
+    np.testing.assert_allclose(out[4], np.linalg.cholesky(spd_v),
+                               rtol=1e-5, atol=1e-5)
+    # dynamic_gru wrapper end-to-end
+    main2, start2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, start2):
+        g = layers.data("g", [-1, 5, 12], dtype="float32")
+        w = layers.create_parameter([4, 12], "float32", name="gru_w2")
+        hid = layers.dynamic_gru(g, w)
+        loss = layers.reduce_mean(hid)
+    exe.run(start2)
+    r = exe.run(main2, feed={"g": np.random.RandomState(0).randn(
+        2, 5, 12).astype("float32")}, fetch_list=[loss.name])
+    assert np.isfinite(r[0]).all()
+
+
+def test_shuffle_batch_layer_advances_seed(fresh_programs):
+    """The wrapper threads a persistable seed through Seed->SeedOut, so
+    consecutive runs draw DIFFERENT permutations (round-5 review fix)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [6, 2], dtype="float32")
+        out = layers.shuffle_batch(x)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xb = np.arange(12, dtype="float32").reshape(6, 2)
+    perms = []
+    for _ in range(4):
+        r = exe.run(main, feed={"x": xb}, fetch_list=[out.name])
+        perms.append(tuple(r[0][:, 0].astype(int).tolist()))
+        assert sorted(r[0][:, 0]) == sorted(xb[:, 0])   # a permutation
+    assert len(set(perms)) > 1, f"seed never advanced: {perms}"
