@@ -28,32 +28,26 @@ def multiplex(inputs, index, name=None):
                    name=name)
 
 
-def crop(x, shape=None, offsets=None, name=None):
+def _crop_common(op_type, shape_slot, x, shape, offsets, name):
     ins = {"X": [x]}
     attrs = {}
     if isinstance(shape, (list, tuple)):
         attrs["shape"] = list(shape)
     elif shape is not None:
-        ins["Y"] = [shape]
+        ins[shape_slot] = [shape]
     if isinstance(offsets, (list, tuple)):
         attrs["offsets"] = list(offsets)
     elif offsets is not None:
         ins["Offsets"] = [offsets]
-    return _single("crop", ins, attrs, name=name)
+    return _single(op_type, ins, attrs, name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _crop_common("crop", "Y", x, shape, offsets, name)
 
 
 def crop_tensor(x, shape=None, offsets=None, name=None):
-    ins = {"X": [x]}
-    attrs = {}
-    if isinstance(shape, (list, tuple)):
-        attrs["shape"] = list(shape)
-    elif shape is not None:
-        ins["Shape"] = [shape]
-    if isinstance(offsets, (list, tuple)):
-        attrs["offsets"] = list(offsets)
-    elif offsets is not None:
-        ins["Offsets"] = [offsets]
-    return _single("crop_tensor", ins, attrs, name=name)
+    return _crop_common("crop_tensor", "Shape", x, shape, offsets, name)
 
 
 def hinge_loss(input, label, name=None):
