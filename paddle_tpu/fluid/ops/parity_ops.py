@@ -49,7 +49,8 @@ register("l1_norm",
          lambda ctx, ins, attrs: out(jnp.sum(jnp.abs(x(ins)))))
 
 
-@register("cholesky", attrs={"upper": False})
+@register("cholesky", infer_shape=same_shape_as("X"),
+          attrs={"upper": False})
 def _cholesky(ctx, ins, attrs):
     l = jnp.linalg.cholesky(x(ins))
     if attrs.get("upper"):
@@ -66,7 +67,8 @@ def _multiplex(ctx, ins, attrs):
     return out(stack[ids, jnp.arange(n)])
 
 
-@register("reverse", attrs={"axis": []})
+@register("reverse", infer_shape=same_shape_as("X"),
+          attrs={"axis": []})
 def _reverse(ctx, ins, attrs):
     axes = attrs.get("axis") or [0]
     return out(jnp.flip(x(ins), axis=[int(a) for a in axes]))
@@ -129,7 +131,8 @@ def _crop_tensor(ctx, ins, attrs):
     return out(_crop_common(v, offsets, shape))
 
 
-@register("pad_constant_like", no_grad_slots=("X",),
+@register("pad_constant_like", infer_shape=same_shape_as("X"),
+          no_grad_slots=("X",),
           attrs={"pad_value": 0.0})
 def _pad_constant_like(ctx, ins, attrs):
     big, small = x(ins, "X"), x(ins, "Y")
@@ -219,7 +222,7 @@ def _batch_fc(ctx, ins, attrs):
 # losses
 # ---------------------------------------------------------------------------
 
-@register("hinge_loss")
+@register("hinge_loss", infer_shape=same_shape_as("Logits", "Loss"))
 def _hinge_loss(ctx, ins, attrs):
     """loss = max(0, 1 - (2y-1) * logit) (hinge_loss_op.cc)."""
     logits, y = x(ins, "Logits"), x(ins, "Labels")
@@ -227,7 +230,8 @@ def _hinge_loss(ctx, ins, attrs):
                slot="Loss")
 
 
-@register("log_loss", attrs={"epsilon": 1e-4})
+@register("log_loss", infer_shape=same_shape_as("Predicted", "Loss"),
+          attrs={"epsilon": 1e-4})
 def _log_loss(ctx, ins, attrs):
     p, y = x(ins, "Predicted"), x(ins, "Labels")
     eps = attrs.get("epsilon", 1e-4)
@@ -260,7 +264,8 @@ def _cos_sim(ctx, ins, attrs):
     return {"Out": [sim], "XNorm": [xn], "YNorm": [yn]}
 
 
-@register("sigmoid_focal_loss", no_grad_slots=("Label", "FgNum"),
+@register("sigmoid_focal_loss", infer_shape=same_shape_as("X"),
+          no_grad_slots=("Label", "FgNum"),
           attrs={"gamma": 2.0, "alpha": 0.25})
 def _sigmoid_focal_loss(ctx, ins, attrs):
     """detection/sigmoid_focal_loss_op: per-class focal BCE where Label
@@ -532,7 +537,25 @@ _ACTS = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh, "relu": jax.nn.relu,
          "identity": lambda v: v}
 
 
-@register("gru", no_grad_slots=("SeqLen",),
+
+
+def _rnn_hidden_infer(gates_per):
+    """Hidden shape = [B, T, G/gates_per] from the projected Input."""
+    def _infer(op):
+        v = op.invar("Input")
+        if v is None or not v.shape:
+            return
+        b, t, g = v.shape
+        d = g // gates_per if isinstance(g, int) and g > 0 else -1
+        for name in op.output("Hidden"):
+            op.block.create_var(name=name, shape=(b, t, d), dtype=v.dtype)
+        for name in op.output("Cell"):
+            op.block.create_var(name=name, shape=(b, t, d), dtype=v.dtype)
+    return _infer
+
+
+@register("gru", infer_shape=_rnn_hidden_infer(3),
+          no_grad_slots=("SeqLen",),
           attrs={"activation": "tanh", "gate_activation": "sigmoid",
                  "is_reverse": False, "origin_mode": False})
 def _gru(ctx, ins, attrs):
@@ -626,7 +649,8 @@ def _lstm_common(ins, attrs, with_proj):
     return hs, cs
 
 
-@register("lstm", no_grad_slots=("SeqLen",),
+@register("lstm", infer_shape=_rnn_hidden_infer(4),
+          no_grad_slots=("SeqLen",),
           attrs={"use_peepholes": False, "is_reverse": False,
                  "gate_activation": "sigmoid",
                  "cell_activation": "tanh",
