@@ -736,3 +736,28 @@ def test_shuffle_batch_layer_advances_seed(fresh_programs):
         perms.append(tuple(r[0][:, 0].astype(int).tolist()))
         assert sorted(r[0][:, 0]) == sorted(xb[:, 0])   # a permutation
     assert len(set(perms)) > 1, f"seed never advanced: {perms}"
+
+
+def test_gru_program_predictor_roundtrip(fresh_programs, tmp_path):
+    """Programs carrying the monolithic `gru` op serialize through
+    save_inference_model and execute in the Predictor — the
+    deserialized-reference-graph use case the op tier exists for."""
+    import paddle_tpu as paddle
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers
+    from paddle_tpu.inference import Config, Predictor
+    main, startup, scope = fresh_programs
+    with fluid.program_guard(main, startup):
+        g = layers.data("g", [-1, 5, 12], dtype="float32")
+        w = layers.create_parameter([4, 12], "float32", name="gru_w_rt")
+        hid = layers.dynamic_gru(g, w)
+    exe = fluid.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "gru_model")
+    fluid.io.save_inference_model(d, ["g"], [hid], exe,
+                                  main_program=main)
+    gv = np.random.RandomState(0).randn(2, 5, 12).astype("float32")
+    ref = exe.run(main, feed={"g": gv}, fetch_list=[hid.name])[0]
+    pred = Predictor(Config(model_dir=d))
+    out = pred.run([gv])[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
