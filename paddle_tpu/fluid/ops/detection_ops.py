@@ -325,3 +325,270 @@ def _multiclass_nms(ctx, ins, attrs):
     out_, num = jax.vmap(one_image)(boxes, scores)
     return {"Out": [out_], "Index": [jnp.zeros((1, 1), jnp.int32)],
             "NmsRoisNum": [num]}
+
+
+# ---------------------------------------------------------------------------
+# round-5 detection tier: matrix_nms, bipartite_match, target_assign,
+# distribute/collect_fpn_proposals, box_decoder_and_assign
+# ---------------------------------------------------------------------------
+
+@register("matrix_nms", grad=None,
+          attrs={"background_label": 0, "score_threshold": 0.05,
+                 "post_threshold": 0.0, "nms_top_k": 64,
+                 "keep_top_k": 100, "normalized": True,
+                 "use_gaussian": False, "gaussian_sigma": 2.0})
+def _matrix_nms(ctx, ins, attrs):
+    """Matrix NMS (detection/matrix_nms_op.cc, SOLOv2): suppression by a
+    DECAY MATRIX instead of sequential greedy removal — per class, box i
+    keeps score * min_j<i decay(iou_ij, iou_max_j). All-matrix math, so
+    unlike greedy NMS it maps perfectly onto the TPU (no sequential
+    dependency). Static shapes: Out [N, keep_top_k, 6] padded with
+    label -1, RoisNum [N]."""
+    boxes = x(ins, "BBoxes").astype(jnp.float32)     # [N, M, 4]
+    scores = x(ins, "Scores").astype(jnp.float32)    # [N, C, M]
+    N, M, _ = boxes.shape
+    C = scores.shape[1]
+    bg = int(attrs["background_label"])
+    topk = min(int(attrs["nms_top_k"]), M) if attrs["nms_top_k"] > 0 \
+        else M
+    keep_k = int(attrs["keep_top_k"]) if attrs["keep_top_k"] > 0 \
+        else C * topk
+    st = float(attrs["score_threshold"])
+    pt = float(attrs["post_threshold"])
+    sigma = float(attrs["gaussian_sigma"])
+    use_g = bool(attrs["use_gaussian"])
+
+    def per_class(sc, bx):                 # sc [M], bx [M, 4]
+        sc = jnp.where(sc > st, sc, 0.0)
+        order = jnp.argsort(-sc)[:topk]
+        s = sc[order]
+        b = bx[order]
+        iou = _iou_matrix(b, b, attrs.get("normalized", True))
+        tri = jnp.tril(iou, k=-1)           # iou(i, j<i)
+        iou_max = jnp.max(tri, axis=1)      # max overlap of j vs better
+        if use_g:
+            # reference decay_score<gaussian>: exp((max^2 - iou^2) * sigma)
+            decay = jnp.exp((iou_max[None, :] ** 2 - tri ** 2) * sigma)
+        else:
+            decay = (1.0 - tri) / jnp.maximum(1.0 - iou_max[None, :],
+                                              1e-10)
+        decay = jnp.where(
+            jnp.arange(topk)[None, :] < jnp.arange(topk)[:, None],
+            decay, 1.0)
+        ds = jnp.min(decay, axis=1) * s
+        ds = jnp.where(ds > pt, ds, 0.0)
+        return ds, order
+
+    def per_image(img_i, sc_img, bx_img):  # scalar, [C, M], [M, 4]
+        cls_ids = jnp.arange(C)
+        dss, orders = jax.vmap(lambda c: per_class(sc_img[c], bx_img))(
+            cls_ids)
+        valid_cls = (cls_ids != bg)[:, None]
+        dss = jnp.where(valid_cls, dss, 0.0)      # [C, topk]
+        flat = dss.reshape(-1)
+        # pad so Out is ALWAYS [keep_top_k, 6] (the documented static
+        # shape) even when C*topk < keep_top_k
+        pad = max(keep_k - C * topk, 0)
+        flat = jnp.concatenate([flat, jnp.zeros((pad,))])
+        sel = jnp.argsort(-flat)[:keep_k]
+        cls = (sel // topk).astype(jnp.float32)
+        box_idx = jnp.take(
+            jnp.concatenate([orders.reshape(-1),
+                             jnp.zeros((pad,), orders.dtype)]), sel)
+        out_rows = jnp.concatenate(
+            [jnp.where(flat[sel] > 0, cls, -1.0)[:, None],
+             flat[sel][:, None], bx_img[box_idx]], axis=1)
+        # Index rows carry the per-image batch offset (reference:
+        # start = i * num_boxes) so a flat [N*M, 4] gather works
+        return out_rows, (flat[sel] > 0).sum().astype(jnp.int32), \
+            (img_i * M + box_idx).astype(jnp.int32)
+
+    rows, nums, idx = jax.vmap(per_image)(jnp.arange(N), scores, boxes)
+    return {"Out": [rows], "Index": [idx.reshape(-1, 1)],
+            "RoisNum": [nums]}
+
+
+@register("bipartite_match", grad=None,
+          attrs={"match_type": "bipartite", "dist_threshold": 0.5})
+def _bipartite_match(ctx, ins, attrs):
+    """Greedy global bipartite matching (detection/bipartite_match_op.cc):
+    repeatedly take the largest remaining (row, col) entry, binding one
+    row to one col, min(R, C) rounds via fori_loop; optional
+    per_prediction pass assigns remaining cols whose best dist >=
+    threshold. DistMat [N, R, C] dense (LoD batch in the reference) ->
+    ColToRowMatchIndices / ColToRowMatchDist [N, C]."""
+    dist = x(ins, "DistMat").astype(jnp.float32)
+    if dist.ndim == 2:
+        dist = dist[None]
+    N, R, C = dist.shape
+    per_pred = attrs.get("match_type", "bipartite") == "per_prediction"
+    thr = float(attrs.get("dist_threshold", 0.5))
+
+    def one(d):
+        eps = 1e-6
+
+        def body(_, carry):
+            match, mdist, mask = carry
+            flat = jnp.where(mask, d, -jnp.inf).reshape(-1)
+            k = jnp.argmax(flat)
+            i, j = k // C, k % C
+            # zero-distance pairs stay UNMATCHED (reference skips
+            # dist < kEPS)
+            ok = flat[k] > eps
+            match = jnp.where(ok, match.at[j].set(i.astype(jnp.int32)),
+                              match)
+            mdist = jnp.where(ok, mdist.at[j].set(d[i, j]), mdist)
+            mask = jnp.where(ok, mask.at[i, :].set(False), mask)
+            mask = jnp.where(ok, mask.at[:, j].set(False), mask)
+            return match, mdist, mask
+
+        init = (jnp.full((C,), -1, jnp.int32), jnp.zeros((C,)),
+                jnp.ones((R, C), bool))
+        match, mdist, _ = jax.lax.fori_loop(0, min(R, C), body, init)
+        if per_pred:
+            best = jnp.max(d, axis=0)
+            arg = jnp.argmax(d, axis=0).astype(jnp.int32)
+            fill = (match == -1) & (best >= thr) & (best > eps)
+            match = jnp.where(fill, arg, match)
+            mdist = jnp.where(fill, best, mdist)
+        return match, mdist
+
+    match, mdist = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": [match],
+            "ColToRowMatchDist": [mdist.astype(jnp.float32)]}
+
+
+@register("target_assign", grad=None,
+          no_grad_slots=("MatchIndices", "NegIndices"),
+          attrs={"mismatch_value": 0})
+def _target_assign(ctx, ins, attrs):
+    """detection/target_assign_op.h over the dense design: X [N, L, K]
+    per-image candidate targets, MatchIndices [N, M] (-1 = unmatched) ->
+    Out [N, M, K] gathered rows (mismatch_value where unmatched),
+    OutWeight [N, M, 1]. NegIndices [N, Q] rows additionally get weight
+    1 with the mismatch value (negative mining)."""
+    v = x(ins, "X")
+    mi = x(ins, "MatchIndices").astype(jnp.int32)     # [N, M]
+    mv = attrs.get("mismatch_value", 0)
+    N, M = mi.shape
+    K = v.shape[-1]
+    matched = mi >= 0
+    gathered = jnp.take_along_axis(
+        v, jnp.clip(mi, 0, v.shape[1] - 1)[..., None], axis=1)
+    outv = jnp.where(matched[..., None], gathered,
+                     jnp.asarray(mv, v.dtype))
+    w = matched.astype(jnp.float32)[..., None]
+    neg = x(ins, "NegIndices")
+    if neg is not None:
+        neg = neg.astype(jnp.int32)
+        hit = (jnp.arange(M)[None, :, None]
+               == neg[:, None, :]).any(-1)             # [N, M]
+        outv = jnp.where(hit[..., None], jnp.asarray(mv, v.dtype), outv)
+        w = jnp.maximum(w, hit.astype(jnp.float32)[..., None])
+    return {"Out": [outv], "OutWeight": [w]}
+
+
+@register("distribute_fpn_proposals", grad=None,
+          attrs={"min_level": 2, "max_level": 5, "refer_level": 4,
+                 "refer_scale": 224, "pixel_offset": True})
+def _distribute_fpn_proposals(ctx, ins, attrs):
+    """detection/distribute_fpn_proposals_op.cc: route each RoI to the
+    FPN level floor(log2(sqrt(area)/refer_scale)) + refer_level, clipped
+    to [min, max]. Static shapes: every per-level output is [R, 4] with
+    that level's rois compacted to the front (stable order) and
+    MultiLevelRoIsNum giving the live counts; RestoreIndex maps the
+    level-sorted order back to the input order."""
+    rois = x(ins, "FpnRois").astype(jnp.float32)      # [R, 4]
+    lo, hi = int(attrs["min_level"]), int(attrs["max_level"])
+    refer_l, refer_s = int(attrs["refer_level"]), int(attrs["refer_scale"])
+    off = 1.0 if attrs.get("pixel_offset", True) else 0.0
+    R = rois.shape[0]
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(jnp.log2(scale / refer_s + 1e-6)) + refer_l
+    lvl = jnp.clip(lvl, lo, hi).astype(jnp.int32)
+    order = jnp.argsort(lvl, stable=True)             # level-major
+    restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+    outs = {"RestoreIndex": [restore.reshape(-1, 1)]}
+    multi, nums = [], []
+    for level in range(lo, hi + 1):
+        m = lvl == level
+        cnt = m.sum().astype(jnp.int32)
+        sel = jnp.argsort(~m, stable=True)            # level rois first
+        padded = jnp.where((jnp.arange(R) < cnt)[:, None], rois[sel],
+                           0.0)
+        multi.append(padded)
+        nums.append(cnt.reshape(1))
+    outs["MultiFpnRois"] = multi
+    # one RoisNum var PER LEVEL (matches the op's plural output slot);
+    # a single declared output still works — it receives level-min's
+    outs["MultiLevelRoIsNum"] = nums
+    return outs
+
+
+@register("collect_fpn_proposals", grad=None,
+          no_grad_slots=("MultiLevelRoIsNum",),
+          attrs={"post_nms_topN": 100})
+def _collect_fpn_proposals(ctx, ins, attrs):
+    """detection/collect_fpn_proposals_op.cc: concat per-level rois +
+    scores, keep the post_nms_topN best by score. MultiLevelRois list of
+    [Ri, 4], MultiLevelScores list of [Ri, 1]; the optional per-level
+    MultiLevelRoIsNum marks the LIVE prefix of each level (the static
+    padding distribute_fpn_proposals emits) — dead rows never reach the
+    top-k and RoisNum reports the live count."""
+    level_rois = [r.astype(jnp.float32)
+                  for r in ins.get("MultiLevelRois", [])]
+    level_scores = [s.astype(jnp.float32).reshape(-1)
+                    for s in ins.get("MultiLevelScores", [])]
+    rois = jnp.concatenate(level_rois, 0)
+    scores = jnp.concatenate(level_scores, 0)
+    nums = ins.get("MultiLevelRoIsNum")
+    if nums:
+        live = jnp.concatenate([
+            jnp.arange(r.shape[0]) < n.reshape(()).astype(jnp.int32)
+            for r, n in zip(level_rois, nums)])
+        scores = jnp.where(live, scores, -jnp.inf)
+    k = min(int(attrs["post_nms_topN"]), scores.shape[0])
+    sel = jnp.argsort(-scores)[:k]
+    n_live = (scores[sel] > -jnp.inf).sum().astype(jnp.int32)
+    return {"FpnRois": [rois[sel]],
+            "RoisNum": [n_live.reshape(1)]}
+
+
+@register("box_decoder_and_assign", grad=None,
+          no_grad_slots=("PriorBox", "PriorBoxVar"),
+          attrs={"box_clip": 4.135166556742356})
+def _box_decoder_and_assign(ctx, ins, attrs):
+    """detection/box_decoder_and_assign_op.cc: decode per-class deltas
+    against the prior (center-size form, variance-scaled, dw/dh clipped
+    at box_clip) and assign each roi the decoded box of its best
+    non-background class."""
+    prior = x(ins, "PriorBox").astype(jnp.float32)     # [M, 4]
+    pvar = x(ins, "PriorBoxVar")
+    tb = x(ins, "TargetBox").astype(jnp.float32)       # [M, 4*C]
+    sc = x(ins, "BoxScore").astype(jnp.float32)        # [M, C]
+    M = prior.shape[0]
+    C = sc.shape[1]
+    clip = float(attrs.get("box_clip", 4.135166556742356))
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    d = tb.reshape(M, C, 4)
+    if pvar is not None:
+        d = d * pvar.astype(jnp.float32).reshape(1, 1, 4)
+    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    dw = jnp.clip(dw, -clip, clip)
+    dh = jnp.clip(dh, -clip, clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                     cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0], axis=-1)
+    best = jnp.argmax(sc[:, 1:], axis=1) + 1           # skip background
+    assign = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": [dec.reshape(M, C * 4)],
+            "OutputAssignBox": [assign]}

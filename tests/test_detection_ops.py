@@ -203,3 +203,105 @@ def test_fluid_layers_detection_static():
             fetch_list=[iou])
     paddle.disable_static()
     np.testing.assert_allclose(np.asarray(got), [[1.0, 0.0]], atol=1e-6)
+
+
+# -- round-5 detection tier ------------------------------------------------
+from op_test import run_eager  # noqa: E402
+
+def test_matrix_nms_decay_and_dedup():
+    """Matrix NMS: duplicate high-IoU boxes get decayed below the post
+    threshold; distinct boxes survive with full score."""
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.2],
+                       [20, 20, 30, 30]]], "float32")
+    scores = np.array([[[0.0, 0.0, 0.0],      # background row
+                        [0.9, 0.8, 0.7]]], "float32")
+    r = run_eager("matrix_nms", {"BBoxes": boxes, "Scores": scores},
+                  {"background_label": 0, "score_threshold": 0.1,
+                   "post_threshold": 0.4, "nms_top_k": 3,
+                   "keep_top_k": 3, "use_gaussian": False})
+    out = np.asarray(r["Out"][0])[0]
+    num = int(np.asarray(r["RoisNum"][0])[0])
+    kept = out[out[:, 0] >= 0]
+    assert num == 2, (num, out)
+    # survivors: the 0.9 box and the distinct 0.7 box; the 0.8
+    # near-duplicate decayed below post_threshold and is gone
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], rtol=1e-6)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[[0.9, 0.1, 0.3],
+                      [0.8, 0.7, 0.2]]], "float32")   # [1, R=2, C=3]
+    r = run_eager("bipartite_match", {"DistMat": dist}, {})
+    m = np.asarray(r["ColToRowMatchIndices"][0])[0]
+    d = np.asarray(r["ColToRowMatchDist"][0])[0]
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7; col 2 unmatched
+    np.testing.assert_array_equal(m, [0, 1, -1])
+    np.testing.assert_allclose(d, [0.9, 0.7, 0.0])
+    # per_prediction fills col 2 from its best row if >= threshold
+    r2 = run_eager("bipartite_match", {"DistMat": dist},
+                   {"match_type": "per_prediction",
+                    "dist_threshold": 0.25})
+    m2 = np.asarray(r2["ColToRowMatchIndices"][0])[0]
+    np.testing.assert_array_equal(m2, [0, 1, 0])      # 0.3 >= 0.25
+
+
+def test_target_assign_gather():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)   # 3 gt rows
+    mi = np.array([[2, -1, 0, 1]], "int32")
+    r = run_eager("target_assign", {"X": x, "MatchIndices": mi},
+                  {"mismatch_value": -7})
+    out = np.asarray(r["Out"][0])[0]
+    w = np.asarray(r["OutWeight"][0])[0]
+    np.testing.assert_allclose(out[0], x[0, 2])
+    np.testing.assert_allclose(out[1], -7.0)
+    np.testing.assert_allclose(out[2], x[0, 0])
+    np.testing.assert_allclose(w.ravel(), [1, 0, 1, 1])
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],       # tiny  -> min level
+                     [0, 0, 500, 500],     # huge  -> max level
+                     [0, 0, 224, 224]], "float32")   # refer  -> level 4
+    r = run_eager("distribute_fpn_proposals", {"FpnRois": rois},
+                  {"min_level": 2, "max_level": 5, "refer_level": 4,
+                   "refer_scale": 224})
+    nums = np.concatenate([np.asarray(n)
+                           for n in r["MultiLevelRoIsNum"]])
+    np.testing.assert_array_equal(nums, [1, 0, 1, 1])
+    lvl2 = np.asarray(r["MultiFpnRois"][0])
+    np.testing.assert_allclose(lvl2[0], rois[0])
+    restore = np.asarray(r["RestoreIndex"][0]).ravel()
+    assert sorted(restore.tolist()) == [0, 1, 2]
+    # collect: top-2 by score across levels
+    c = run_eager("collect_fpn_proposals",
+                  {"MultiLevelRois": [rois[:1], rois[1:]],
+                   "MultiLevelScores": [np.array([[0.3]], "float32"),
+                                        np.array([[0.9], [0.1]],
+                                                 "float32")],
+                   "MultiLevelRoIsNum": [np.array([1], "int32"),
+                                         np.array([1], "int32")]},
+                  {"post_nms_topN": 2})
+    fr = np.asarray(c["FpnRois"][0])
+    np.testing.assert_allclose(fr[0], rois[1])        # 0.9 first
+    np.testing.assert_allclose(fr[1], rois[0])        # then 0.3
+    # the dead (padded) row at level 1 never reaches the top-k
+    np.testing.assert_array_equal(np.asarray(c["RoisNum"][0]), [2])
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "float32")       # w=h=10
+    pvar = np.array([0.1, 0.1, 0.2, 0.2], "float32")
+    # class 0 (bg): zero deltas; class 1: shift center by +1 x
+    tb = np.array([[0, 0, 0, 0, 1.0, 0, 0, 0]], "float32")
+    sc = np.array([[0.2, 0.8]], "float32")
+    r = run_eager("box_decoder_and_assign",
+                  {"PriorBox": prior, "PriorBoxVar": pvar,
+                   "TargetBox": tb, "BoxScore": sc}, {})
+    dec = np.asarray(r["DecodeBox"][0]).reshape(1, 2, 4)
+    asg = np.asarray(r["OutputAssignBox"][0])
+    np.testing.assert_allclose(dec[0, 0], prior[0], atol=1e-5)
+    # class 1: cx moved by 0.1*1.0*10 = 1
+    np.testing.assert_allclose(dec[0, 1], prior[0] + [1, 0, 1, 0],
+                               atol=1e-5)
+    np.testing.assert_allclose(asg[0], dec[0, 1], atol=1e-6)
