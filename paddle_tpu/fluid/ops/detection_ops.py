@@ -592,3 +592,58 @@ def _box_decoder_and_assign(ctx, ins, attrs):
         dec, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
     return {"DecodeBox": [dec.reshape(M, C * 4)],
             "OutputAssignBox": [assign]}
+
+
+@register("mine_hard_examples", grad=None,
+          no_grad_slots=("MatchIndices", "MatchDist"),
+          attrs={"neg_pos_ratio": 3.0, "neg_dist_threshold": 0.5,
+                 "sample_size": 0, "mining_type": "max_negative"})
+def _mine_hard_examples(ctx, ins, attrs):
+    """SSD OHEM (detection/mine_hard_examples_op.cc): rank eligible
+    priors by loss, keep the hardest negatives — max_negative caps at
+    neg_pos_ratio x positives, hard_example at sample_size (and demotes
+    unselected positives). Dense outputs: NegIndices [N, P] compacted,
+    -1 padded, NegRoisNum live counts, UpdatedMatchIndices [N, P]."""
+    cls = x(ins, "ClsLoss").astype(jnp.float32)        # [N, P]
+    loc = x(ins, "LocLoss")
+    mi = x(ins, "MatchIndices").astype(jnp.int32)      # [N, P]
+    dist = x(ins, "MatchDist").astype(jnp.float32)
+    kind = attrs.get("mining_type", "max_negative")
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    ndt = float(attrs.get("neg_dist_threshold", 0.5))
+    ssz = int(attrs.get("sample_size", 0))
+    if kind == "hard_example" and ssz <= 0:
+        # reference PADDLE_ENFORCE_GT(sample_size, 0): selecting nothing
+        # would silently demote every positive
+        raise ValueError(
+            "mine_hard_examples: mining_type='hard_example' requires "
+            "sample_size > 0")
+    N, P = mi.shape
+    loss = cls
+    if kind == "hard_example" and loc is not None:
+        loss = cls + loc.astype(jnp.float32)
+    if kind == "max_negative":
+        elig = (mi == -1) & (dist < ndt)
+    else:
+        elig = jnp.ones_like(mi, bool)
+    masked = jnp.where(elig, loss, -jnp.inf)
+    order = jnp.argsort(-masked, axis=1)               # hardest first
+    rank = jnp.argsort(order, axis=1)                  # rank per prior
+    n_elig = elig.sum(axis=1)
+    if kind == "max_negative":
+        n_pos = (mi != -1).sum(axis=1)
+        n_sel = jnp.minimum((n_pos * ratio).astype(jnp.int32), n_elig)
+    else:
+        n_sel = jnp.minimum(ssz, n_elig).astype(jnp.int32)
+    selected = elig & (rank < n_sel[:, None])
+    neg = selected & (mi == -1)
+    # compact negative indices to the front, -1 padded
+    neg_order = jnp.argsort(~neg, axis=1, stable=True)
+    n_neg = neg.sum(axis=1).astype(jnp.int32)
+    neg_idx = jnp.where(jnp.arange(P)[None, :] < n_neg[:, None],
+                        neg_order, -1).astype(jnp.int32)
+    upd = mi
+    if kind == "hard_example":
+        upd = jnp.where((mi > -1) & ~selected, -1, mi)
+    return {"NegIndices": [neg_idx], "NegRoisNum": [n_neg],
+            "UpdatedMatchIndices": [upd]}

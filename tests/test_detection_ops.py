@@ -305,3 +305,41 @@ def test_box_decoder_and_assign():
     np.testing.assert_allclose(dec[0, 1], prior[0] + [1, 0, 1, 0],
                                atol=1e-5)
     np.testing.assert_allclose(asg[0], dec[0, 1], atol=1e-6)
+
+
+def test_mine_hard_examples_max_negative():
+    """max_negative OHEM: hardest unmatched priors kept, capped at
+    neg_pos_ratio x positives."""
+    mi = np.array([[0, -1, -1, -1, 1, -1]], "int32")   # 2 positives
+    dist = np.zeros((1, 6), "float32")
+    cls = np.array([[9.0, 0.5, 3.0, 1.0, 9.0, 2.0]], "float32")
+    r = run_eager("mine_hard_examples",
+                  {"ClsLoss": cls, "MatchIndices": mi,
+                   "MatchDist": dist},
+                  {"neg_pos_ratio": 1.5, "neg_dist_threshold": 0.5,
+                   "mining_type": "max_negative"})
+    neg = np.asarray(r["NegIndices"][0])[0]
+    n = int(np.asarray(r["NegRoisNum"][0])[0])
+    # cap = floor(2 * 1.5) = 3 -> hardest negatives: 2 (3.0), 5 (2.0),
+    # 3 (1.0)
+    assert n == 3
+    assert sorted(neg[:3].tolist()) == [2, 3, 5]
+    assert (neg[3:] == -1).all()
+    np.testing.assert_array_equal(
+        np.asarray(r["UpdatedMatchIndices"][0]), mi)   # unchanged here
+
+
+def test_mine_hard_examples_hard_example_demotes():
+    mi = np.array([[0, -1, 1, -1]], "int32")
+    dist = np.zeros((1, 4), "float32")
+    cls = np.array([[0.1, 5.0, 0.2, 4.0]], "float32")
+    r = run_eager("mine_hard_examples",
+                  {"ClsLoss": cls, "MatchIndices": mi,
+                   "MatchDist": dist},
+                  {"sample_size": 2, "mining_type": "hard_example"})
+    # top-2 by loss: priors 1 and 3 (both negatives); positives 0 and 2
+    # were NOT selected -> demoted to -1
+    np.testing.assert_array_equal(
+        np.asarray(r["UpdatedMatchIndices"][0]), [[-1, -1, -1, -1]])
+    neg = np.asarray(r["NegIndices"][0])[0]
+    assert sorted(neg[:2].tolist()) == [1, 3]
