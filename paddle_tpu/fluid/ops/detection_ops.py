@@ -647,3 +647,76 @@ def _mine_hard_examples(ctx, ins, attrs):
         upd = jnp.where((mi > -1) & ~selected, -1, mi)
     return {"NegIndices": [neg_idx], "NegRoisNum": [n_neg],
             "UpdatedMatchIndices": [upd]}
+
+
+@register("retinanet_detection_output", grad=None,
+          no_grad_slots=("Anchors", "ImInfo"),
+          attrs={"score_threshold": 0.05, "nms_top_k": 1000,
+                 "keep_top_k": 100, "nms_threshold": 0.3,
+                 "nms_eta": 1.0})
+def _retinanet_detection_output(ctx, ins, attrs):
+    """RetinaNet head postprocess (detection/
+    retinanet_detection_output_op.cc): per FPN level, keep the
+    nms_top_k best (anchor, class) scores above score_threshold, decode
+    the deltas against the level's anchors (center-size, +1 pixel
+    convention, im_scale unscaling, image clip), pool levels and run the
+    class-wise greedy NMS via the multiclass_nms kernel. Sigmoid scores,
+    no background column; Out [N, keep_top_k, 6] padded label -1."""
+    from ..registry import require
+    bbox_levels = [b.astype(jnp.float32) for b in ins.get("BBoxes", [])]
+    score_levels = [s.astype(jnp.float32) for s in ins.get("Scores", [])]
+    anchor_levels = [a.astype(jnp.float32).reshape(-1, 4)
+                     for a in ins.get("Anchors", [])]
+    iminfo = x(ins, "ImInfo").astype(jnp.float32)      # [N, 3] h, w, scale
+    st = float(attrs["score_threshold"])
+    topk = int(attrs["nms_top_k"])
+
+    def decode_level(deltas, anchors, info):
+        # deltas [M, 4], anchors [M, 4]
+        ih = jnp.round(info[0] / info[2])
+        iw = jnp.round(info[1] / info[2])
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        w = jnp.exp(deltas[:, 2]) * aw
+        h = jnp.exp(deltas[:, 3]) * ah
+        box = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1], -1) / info[2]
+        lo = jnp.zeros((4,))
+        hi = jnp.stack([iw - 1, ih - 1, iw - 1, ih - 1])
+        return jnp.clip(box, lo, hi)
+
+    def per_image(boxes_i, scores_i, info):
+        all_boxes, all_scores = [], []
+        for deltas, sc, anchors in zip(boxes_i, scores_i, anchor_levels):
+            dec = decode_level(deltas, anchors, info)      # [M, 4]
+            scm = jnp.where(sc > st, sc, 0.0)              # [M, C]
+            k = min(topk, scm.size)
+            flat = scm.reshape(-1)
+            sel = jnp.argsort(-flat)[:k]
+            a_idx = sel // scm.shape[1]
+            all_boxes.append(dec[a_idx])
+            all_scores.append(
+                flat[sel][:, None]        # sub-threshold entries are 0
+                * jax.nn.one_hot(sel % scm.shape[1], scm.shape[1]))
+        return jnp.concatenate(all_boxes, 0), \
+            jnp.concatenate(all_scores, 0).T               # [C, total]
+
+    # one vmapped pass over the batch (multiclass_nms is batch-vmapped
+    # itself — per-image python calls would trace the NMS N times)
+    bx, sc = jax.vmap(per_image)(
+        tuple(bbox_levels), tuple(score_levels), iminfo)
+    nms = require("multiclass_nms")
+    r = nms.compute(ctx, {"BBoxes": [bx], "Scores": [sc]},
+                    {"score_threshold": st,
+                     "nms_top_k": topk,
+                     "keep_top_k": int(attrs["keep_top_k"]),
+                     "nms_threshold": float(attrs["nms_threshold"]),
+                     "nms_eta": float(attrs["nms_eta"]),
+                     "normalized": False,
+                     "background_label": -1})
+    return {"Out": [r["Out"][0]],
+            "NmsedNum": [jnp.asarray(r["NmsRoisNum"][0]).reshape(-1)]}

@@ -343,3 +343,38 @@ def test_mine_hard_examples_hard_example_demotes():
         np.asarray(r["UpdatedMatchIndices"][0]), [[-1, -1, -1, -1]])
     neg = np.asarray(r["NegIndices"][0])[0]
     assert sorted(neg[:2].tolist()) == [1, 3]
+
+
+def test_retinanet_detection_output():
+    """One FPN level, zero deltas: decoded boxes == anchors; sigmoid
+    per-class scores survive class-wise NMS (no background column)."""
+    anchors = np.array([[0, 0, 9, 9], [30, 30, 49, 49]], "float32")
+    deltas = np.zeros((1, 2, 4), "float32")
+    scores = np.array([[[0.9, 0.1], [0.02, 0.6]]], "float32")
+    iminfo = np.array([[100, 100, 1.0]], "float32")
+    r = run_eager("retinanet_detection_output",
+                  {"BBoxes": [deltas], "Scores": [scores],
+                   "Anchors": [anchors], "ImInfo": iminfo},
+                  {"score_threshold": 0.05, "nms_top_k": 10,
+                   "keep_top_k": 5, "nms_threshold": 0.3})
+    out = np.asarray(r["Out"][0])[0]
+    kept = out[out[:, 0] >= 0]
+    # three detections: (c0, 0.9, anchor0), (c1, 0.6, anchor1),
+    # (c1, 0.1, anchor0) — distinct boxes all survive NMS
+    assert len(kept) == 3, kept
+    order = np.argsort(-kept[:, 1])
+    np.testing.assert_allclose(kept[order[0], 1], 0.9)
+    np.testing.assert_allclose(kept[order[0], 2:], anchors[0], atol=1e-4)
+    np.testing.assert_allclose(kept[order[1], 1], 0.6)
+    np.testing.assert_allclose(kept[order[1], 2:], anchors[1], atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(r["NmsedNum"][0]), [3])
+    # im_scale unscaling: scale 2 halves the coordinates
+    iminfo2 = np.array([[100, 100, 2.0]], "float32")
+    r2 = run_eager("retinanet_detection_output",
+                   {"BBoxes": [deltas], "Scores": [scores],
+                    "Anchors": [anchors], "ImInfo": iminfo2},
+                   {"score_threshold": 0.05, "nms_top_k": 10,
+                    "keep_top_k": 5, "nms_threshold": 0.3})
+    out2 = np.asarray(r2["Out"][0])[0]
+    best = out2[np.argmax(out2[:, 1])]
+    np.testing.assert_allclose(best[2:], anchors[0] / 2.0, atol=1e-4)
