@@ -37,17 +37,26 @@ class HeartbeatWriter:
         self.interval = interval
         self._stop = threading.Event()
         self._thread = None
+        self._start_ts = None
 
     def start(self):
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._start_ts = time.time()
         self._touch()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
 
     def _touch(self):
-        with open(self.path, "w") as f:
-            f.write(str(time.time()))
+        # "start now" content lets stale_ranks compute the job's age
+        # (the startup grace window for ranks that haven't opted in
+        # yet). Write-then-rename: a truncate-in-place write could be
+        # torn by a concurrent stale_ranks read into a garbage
+        # start_ts that ends the grace window early
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{self._start_ts} {time.time()}")
+        os.replace(tmp, self.path)
 
     def _loop(self):
         while not self._stop.wait(self.interval):
@@ -75,26 +84,57 @@ def start_heartbeat(interval: float = 1.0):
     return _writer
 
 
-def stale_ranks(dir_: str, timeout: float, expected: int) -> list[int]:
+def stale_ranks(dir_: str, timeout: float, expected: int,
+                grace: float = 0.0) -> list[int]:
     """Ranks whose heartbeat file is missing-after-grace or older than
     `timeout` seconds. Ranks that never wrote a file are only reported
     once SOME rank has (otherwise scripts that don't opt in would always
-    look hung)."""
+    look hung), and — when `grace` > 0 — only once the job has been
+    beating for at least `grace` seconds (slow ranks legitimately write
+    their first heartbeat later than fast ones; the launcher passes its
+    heartbeat timeout here)."""
     now = time.time()
     seen_any = False
     stale = []
     ages = {}
+    job_age = None
     for r in range(expected):
         p = _hb_path(dir_, r)
         try:
-            ages[r] = now - os.path.getmtime(p)
+            mtime = os.path.getmtime(p)
+            ages[r] = now - mtime
             seen_any = True
         except OSError:
             ages[r] = None
+            continue
+        # job age from the writer's recorded "start now" stamp pair —
+        # only read when a grace window is in play. Only genuine
+        # two-token stamps count: pre-upgrade writers wrote a single
+        # PER-BEAT timestamp, and reading that (or the fresh file
+        # mtime) as a start stamp would pin job_age near zero for as
+        # long as the rank keeps beating — grace would never expire
+        # and never-written ranks would never be reported
+        if grace <= 0:
+            continue
+        try:
+            with open(p) as f:
+                tokens = f.read().split()
+            if len(tokens) >= 2:
+                age0 = now - float(tokens[0])
+                job_age = age0 if job_age is None \
+                    else max(job_age, age0)
+        except (OSError, ValueError):
+            pass
     if not seen_any:
         return []
+    # no start stamps at all (all-legacy writers): grace disabled,
+    # legacy missing-rank reporting applies
+    in_grace = grace > 0 and job_age is not None and job_age < grace
     for r, age in ages.items():
-        if age is None or age > timeout:
+        if age is None:
+            if not in_grace:
+                stale.append(r)
+        elif age > timeout:
             stale.append(r)
     return stale
 
@@ -102,15 +142,25 @@ def stale_ranks(dir_: str, timeout: float, expected: int) -> list[int]:
 class ElasticManager:
     """API-parity facade (reference fleet/elastic/manager.py): wraps the
     watchdog decision — should the job restart, and how many lives are
-    left."""
+    left. PS mode additionally tracks SINGLE-SERVER restarts: a dead PS
+    shard whose state lives in snapshots is respawned in place (workers'
+    transport retry loops reconnect and resume) without burning a
+    whole-job restart."""
 
     def __init__(self, max_restarts: int = 0, heartbeat_timeout: float = 30.0,
-                 heartbeat_dir: str | None = None, world_size: int = 1):
+                 heartbeat_dir: str | None = None, world_size: int = 1,
+                 max_server_restarts: int | None = None,
+                 startup_grace: float | None = None):
         self.max_restarts = max_restarts
         self.heartbeat_timeout = heartbeat_timeout
         self.heartbeat_dir = heartbeat_dir
         self.world_size = world_size
         self.restart_count = 0
+        self.max_server_restarts = max_restarts \
+            if max_server_restarts is None else max_server_restarts
+        self.server_restart_count = 0
+        self.startup_grace = heartbeat_timeout \
+            if startup_grace is None else startup_grace
 
     def should_restart(self) -> bool:
         return self.restart_count < self.max_restarts
@@ -118,8 +168,14 @@ class ElasticManager:
     def record_restart(self):
         self.restart_count += 1
 
+    def should_restart_server(self) -> bool:
+        return self.server_restart_count < self.max_server_restarts
+
+    def record_server_restart(self):
+        self.server_restart_count += 1
+
     def hung_ranks(self) -> list[int]:
         if not self.heartbeat_dir:
             return []
         return stale_ranks(self.heartbeat_dir, self.heartbeat_timeout,
-                           self.world_size)
+                           self.world_size, grace=self.startup_grace)

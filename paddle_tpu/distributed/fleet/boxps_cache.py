@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from .runtime.rpc import PSRemoteError
+
 __all__ = ["BoxPSWrapper"]
 
 
@@ -133,17 +135,44 @@ class BoxPSWrapper:
         """Push accumulated deltas, then (BoxPS EndPass) re-pull the
         dirty rows so the cache picks up other workers' merged updates.
         Only the per-interval aggregate crosses the wire — 1/flush_every
-        of the uncached pull+push traffic."""
+        of the uncached pull+push traffic.
+
+        Fault tolerance: a table whose push fails past the transport's
+        retry deadline KEEPS its delta/dirty state so the update is not
+        silently lost (the next flush re-sends it; within a single push
+        the transport's request-id dedup keeps retries exactly-once —
+        only a deadline-exceeded push abandoned mid-fanout can
+        double-apply on shards that already committed, see
+        docs/PS_WIRE_PROTOCOL.md), and the remaining tables still
+        flush; the first error re-raises at the end so the caller sees
+        the degraded shard."""
+        first_err: Exception | None = None
         for name, t in self._tables.items():
             dirty = np.flatnonzero(t.dirty[:t.n])
-            if len(dirty):
+            if not len(dirty):
+                continue
+            try:
                 self.fw.push_sparse(name, t.ids[dirty], t.delta[dirty],
                                     t.dim, lr=1.0)
-                t.delta[dirty] = 0.0
-                t.dirty[dirty] = False
-                if refresh:
+            except (ConnectionError, OSError, PSRemoteError) as e:
+                # transport outage OR a server-side dispatch error on
+                # this table: either way the other tables still flush
+                first_err = first_err or e
+                continue
+            t.delta[dirty] = 0.0
+            t.dirty[dirty] = False
+            if refresh:
+                try:
                     t.data[dirty] = self.fw.pull_sparse(
                         name, t.ids[dirty], t.dim)
+                except (ConnectionError, OSError, PSRemoteError) as e:
+                    # push landed; only the EndPass refresh failed —
+                    # the rows stay locally-consistent (stale vs other
+                    # workers until the next successful refresh) and
+                    # the remaining tables still flush
+                    first_err = first_err or e
+        if first_err is not None:
+            raise first_err
 
     # -- dense + misc pass-through --------------------------------------
     def pull_dense(self, name, shape):

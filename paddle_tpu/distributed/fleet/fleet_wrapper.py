@@ -13,7 +13,10 @@ mesh-sharded design (parallel/embedding.py); this tier serves the
 beyond-HBM PaddleRec regime. The worker's local step IS a jax program
 (fwd+bwd jitted); only pulls/pushes run host-side against the TCP
 PSClient (or in-process LargeScaleKV for local mode) — the reference's
-pslib RPC layer replaced by the KV arena in native/kv_store.cc.
+pslib RPC layer replaced by the KV arena in native/kv_store.cc, over
+the fault-tolerant transport in runtime/rpc.py (client retries with
+stable request ids; the server dedups, so a retried push applies
+exactly once).
 """
 from __future__ import annotations
 
@@ -110,6 +113,13 @@ class FleetWrapper:
             return self._client.size(table)
         t = self._local.get(table)
         return 0 if t is None else t.size()
+
+    def transport_stats(self) -> dict:
+        """Retry/timeout/reconnect counters from the PS transport
+        (empty in local mode) — the robustness tests and benchmarks
+        assert against these."""
+        return self._client.stats.as_dict() \
+            if self._client is not None else {}
 
     def stop(self):
         if self._client is not None:

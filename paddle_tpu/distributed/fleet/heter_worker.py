@@ -24,23 +24,25 @@ Here the split is functional and explicit:
       whatever jax device is present (TPU in prod, CPU in tests),
       applies local SGD to the dense params, and returns (loss, d_emb,
       d_wide) to the requesting CPU worker. Serves all CPU workers
-      concurrently over the same length-prefixed-pickle transport the
-      PS tier uses (async/Downpour semantics: no cross-worker barrier).
+      concurrently over the same fault-tolerant transport the PS tier
+      uses (async/Downpour semantics: no cross-worker barrier).
 
-The wire protocol reuses parameter_server_runtime's framing, so the
-whole topology (PS shards + dense worker + N cpu workers) is plain TCP
-on localhost in tests and across hosts in deployment.
+The wire protocol reuses runtime/rpc.py's data-only framing (no pickle
+on the receive path; optional PADDLE_PS_SECRET handshake), so the whole
+topology (PS shards + dense worker + N cpu workers) is plain TCP on
+localhost in tests and across hosts in deployment — and a retried
+"step" is applied exactly once (the dense server dedups request ids, so
+a reply lost to the network cannot double-apply an SGD update).
 """
 from __future__ import annotations
 
-import socket
 import socketserver
 import threading
 
 import numpy as np
 
-from .runtime.parameter_server_runtime import (LargeScaleKV, PSClient,
-                                               _recv_msg, _send_msg)
+from .runtime.parameter_server_runtime import LargeScaleKV, PSClient
+from .runtime.rpc import RpcClient, RpcServerState, serve_connection
 
 __all__ = ["HeterDenseWorker", "HeterCpuWorker"]
 
@@ -94,16 +96,15 @@ class HeterDenseWorker(socketserver.ThreadingTCPServer):
         self._grad_fn = jax.jit(
             jax.value_and_grad(dense_loss, argnums=(0, 1, 2)))
 
+        # "params" is the only read op; "step"/"stop" mutate and are
+        # deduped by request id (exactly-once across client retries)
+        self._rpc = RpcServerState(read_ops={"params", "ping"})
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    while True:
-                        req = _recv_msg(self.request)
-                        _send_msg(self.request, outer._dispatch(req))
-                except (ConnectionError, OSError):
-                    pass
+                serve_connection(self.request, outer._dispatch,
+                                 outer._rpc)
 
         super().__init__((host, int(port)), Handler)
 
@@ -125,7 +126,9 @@ class HeterDenseWorker(socketserver.ThreadingTCPServer):
             self._stop.set()
             threading.Thread(target=self.shutdown, daemon=True).start()
             return {"ok": True}
-        return {"error": f"unknown op {op!r}"}
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown heter op {op!r}")
 
     def _step(self, req: dict) -> dict:
         import jax.numpy as jnp
@@ -180,22 +183,19 @@ class HeterCpuWorker:
         else:
             self._local: dict[str, LargeScaleKV] = {}
             self._kv = None
-        host, port = dense_endpoint.rsplit(":", 1)
-        last = None
-        for attempt in range(30):
-            try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=300)
-                break
-            except OSError as e:
-                last = e
-                import time
-                time.sleep(0.2 * (attempt + 1))
-        else:
-            raise ConnectionError(
-                f"dense worker {dense_endpoint} unreachable: {last}")
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # fault-tolerant channel to the dense tier: retries/reconnects
+        # with a stable request id, deduped server-side, so a lost
+        # reply never double-applies a dense SGD step
+        self._dense = RpcClient(dense_endpoint)
         self.losses: list[float] = []
+
+    @property
+    def transport_stats(self) -> dict:
+        """Dense-channel + (when remote) PS-channel retry counters."""
+        stats = {"dense": self._dense.stats.as_dict()}
+        if self._kv is not None:
+            stats["ps"] = self._kv.stats.as_dict()
+        return stats
 
     # -- sparse tier ----------------------------------------------------
     def _pull(self, table: str, ids: np.ndarray, dim: int) -> np.ndarray:
@@ -225,14 +225,11 @@ class HeterCpuWorker:
         # host-side gather + flatten (the CPU side of the heter split)
         emb = emb_rows[inv].reshape(B, S * cfg.embed_dim)
         wide_sum = wide_rows[inv].reshape(B, S, 1).sum(axis=1)
-        _send_msg(self._sock, {
+        rep = self._dense.call({
             "op": "step", "emb": emb.astype(np.float32),
             "wide": wide_sum.astype(np.float32),
             "dense": np.asarray(dense, np.float32),
             "label": np.asarray(label, np.float32)})
-        rep = _recv_msg(self._sock)
-        if "error" in rep:
-            raise RuntimeError(rep["error"])
         # scatter activation grads back to rows: d_row accumulates over
         # every (b, s) occurrence of the id
         d_emb = np.asarray(rep["d_emb"]).reshape(B * S, cfg.embed_dim)
@@ -247,18 +244,15 @@ class HeterCpuWorker:
         return rep["loss"]
 
     def dense_params(self) -> dict:
-        _send_msg(self._sock, {"op": "params"})
-        return _recv_msg(self._sock)
+        return self._dense.call({"op": "params"})
 
     def stop_dense(self):
         try:
-            _send_msg(self._sock, {"op": "stop"})
-            _recv_msg(self._sock)
+            self._dense.call({"op": "stop"}, deadline=10.0)
         except (ConnectionError, OSError):
             pass
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._dense.close()
+        if self._kv is not None:
+            self._kv.close()

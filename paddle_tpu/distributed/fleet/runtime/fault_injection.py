@@ -1,0 +1,143 @@
+"""Fault-injection harness for the PS/heter RPC transport.
+
+Env-driven (all optional; the transport pays one attribute check per
+frame when nothing is configured):
+
+  PADDLE_PS_FAULT_DROP=p        drop an outgoing frame with prob p — the
+                                socket is closed instead, so the peer
+                                sees EOF and the client retry path runs
+  PADDLE_PS_FAULT_DELAY=sec     sleep before every send (latency)
+  PADDLE_PS_FAULT_TRUNCATE=p    send only the first half of a frame,
+                                then close the connection
+  PADDLE_PS_FAULT_CORRUPT=p     flip bytes inside the frame BODY (the
+                                header's length field stays intact so
+                                the peer reads a full frame and the CRC
+                                check rejects it — corrupting the length
+                                would model a hung peer, not a bad one)
+  PADDLE_PS_FAULT_KILL_AFTER=N  server: os._exit after N handled
+                                requests
+  PADDLE_PS_FAULT_KILL_POINT=recv|reply   kill before dispatch (request
+                                lost) or after commit-before-reply (the
+                                hard exactly-once case); default reply
+  PADDLE_PS_FAULT_SIDE=client|server|both   which transport end injects
+                                (default both — set it when client and
+                                server share one process env)
+  PADDLE_PS_FAULT_SEED=n        deterministic fault schedule
+
+Counters (`injector().counters`) are exposed for tests and benchmarks.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["FaultInjector", "injector", "reset_injector"]
+
+KILL_EXIT_CODE = 23
+
+
+class FaultInjector:
+    """One process-wide schedule of transport faults."""
+
+    def __init__(self, drop: float = 0.0, delay: float = 0.0,
+                 truncate: float = 0.0, corrupt: float = 0.0,
+                 kill_after: int = 0, kill_point: str = "reply",
+                 side: str = "both", seed: int = 0):
+        self.drop = drop
+        self.delay = delay
+        self.truncate = truncate
+        self.corrupt = corrupt
+        self.kill_after = kill_after
+        self.kill_point = kill_point
+        self.side = side
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+        self._requests = 0
+        self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
+                         "corrupted": 0, "requests": 0}
+
+    @classmethod
+    def from_env(cls) -> "FaultInjector":
+        e = os.environ.get
+        return cls(
+            drop=float(e("PADDLE_PS_FAULT_DROP", "0") or 0),
+            delay=float(e("PADDLE_PS_FAULT_DELAY", "0") or 0),
+            truncate=float(e("PADDLE_PS_FAULT_TRUNCATE", "0") or 0),
+            corrupt=float(e("PADDLE_PS_FAULT_CORRUPT", "0") or 0),
+            kill_after=int(e("PADDLE_PS_FAULT_KILL_AFTER", "0") or 0),
+            kill_point=e("PADDLE_PS_FAULT_KILL_POINT", "reply"),
+            side=e("PADDLE_PS_FAULT_SIDE", "both"),
+            seed=int(e("PADDLE_PS_FAULT_SEED", "0") or 0))
+
+    @property
+    def active(self) -> bool:
+        return bool(self.drop or self.delay or self.truncate
+                    or self.corrupt or self.kill_after)
+
+    def _applies(self, side: str | None) -> bool:
+        return self.side == "both" or side is None or side == self.side
+
+    # -- frame mangling (called from rpc.send_frame) --------------------
+    def mangle(self, frame: bytes, body_off: int,
+               side: str | None) -> tuple[bytes, str]:
+        """Returns (frame', action) where action is one of
+        "send" | "drop" | "truncate"."""
+        if not self._applies(side):
+            return frame, "send"
+        with self._lock:
+            if self.delay:
+                self.counters["delayed"] += 1
+                delay = self.delay
+            else:
+                delay = 0.0
+            if self.drop and self._rng.rand() < self.drop:
+                self.counters["dropped"] += 1
+                return frame, "drop"
+            if self.truncate and self._rng.rand() < self.truncate:
+                self.counters["truncated"] += 1
+                return frame, "truncate"
+            if self.corrupt and len(frame) > body_off \
+                    and self._rng.rand() < self.corrupt:
+                self.counters["corrupted"] += 1
+                buf = bytearray(frame)
+                pos = body_off + int(
+                    self._rng.randint(0, len(frame) - body_off))
+                buf[pos] ^= 0xFF
+                frame = bytes(buf)
+        if delay:
+            time.sleep(delay)
+        return frame, "send"
+
+    # -- server kill switch ---------------------------------------------
+    def count_request(self):
+        """Server side, one call per received request; returns True when
+        the kill threshold was just crossed."""
+        with self._lock:
+            self._requests += 1
+            self.counters["requests"] = self._requests
+            return bool(self.kill_after
+                        and self._requests >= self.kill_after)
+
+    def maybe_kill(self, point: str, armed: bool):
+        if armed and self.kill_point == point:
+            os._exit(KILL_EXIT_CODE)
+
+
+_injector: FaultInjector | None = None
+
+
+def injector() -> FaultInjector:
+    """Process-wide injector, configured from env on first use."""
+    global _injector
+    if _injector is None:
+        _injector = FaultInjector.from_env()
+    return _injector
+
+
+def reset_injector(inj: FaultInjector | None = None):
+    """Tests: swap in a fresh injector (None = re-read the env)."""
+    global _injector
+    _injector = inj

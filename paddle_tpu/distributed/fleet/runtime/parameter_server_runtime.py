@@ -7,19 +7,29 @@ and the fleet runtime glue (distributed/fleet/runtime/
 parameter_server_runtime.py).  TPU stance (SURVEY §7): embedding tables
 that FIT in HBM should use the mesh-sharded design in
 paddle_tpu.parallel.embedding; this host tier serves the beyond-HBM
-PaddleRec configs, with key-hash sharding across servers and a
-pickle-over-TCP protocol (one request per pull/push batch — the
-Communicator's merge semantics come from batched numpy application).
+PaddleRec configs, with key-hash sharding across servers over the
+fault-tolerant RPC layer in runtime/rpc.py (data-only wire format with
+optional HMAC handshake — no pickle anywhere on the receive path;
+one request per pull/push batch — the Communicator's merge semantics
+come from batched numpy application).
+
+Fault tolerance (docs/PS_WIRE_PROTOCOL.md): clients retry with
+deadlines/backoff and stable request ids; the server dedups mutating
+ops, snapshots its tables to distributed/fs.py storage, and
+`PSServer.restart_from_snapshot` resumes a killed shard so workers
+reconnect instead of restarting the job.
 """
 from __future__ import annotations
 
-import pickle
-import socket
+import json
+import os
 import socketserver
-import struct
 import threading
 
 import numpy as np
+
+from .rpc import (RpcClient, RpcServerState, TransportStats,
+                  serve_connection)
 
 __all__ = ["ParameterServerRuntime", "LargeScaleKV", "PSServer", "PSClient"]
 
@@ -96,67 +106,75 @@ class LargeScaleKV:
                 return self._native.size()
             return len(self._index)
 
-    def save(self, path: str):
+    def export_state(self) -> dict:
+        """Snapshot-ready state: keys/rows plus (numpy path) the RNG
+        stream, so rows initialised AFTER a restore reproduce the
+        original run bit-for-bit."""
         with self._lock:
             if self._native is not None:
                 keys, rows = self._native.export()
+                rng = None
             else:
                 keys = np.fromiter(self._index, np.int64,
                                    len(self._index))
                 slots = np.fromiter(self._index.values(), np.int64,
                                     len(self._index))
-                rows = self._data[slots]
-            with open(path, "wb") as f:
-                pickle.dump({"dim": self.dim, "keys": keys,
-                             "rows": rows}, f, protocol=4)
+                rows = self._data[slots].copy()
+                rng = self._rng.get_state()
+        st = {"dim": self.dim, "init_std": self.init_std,
+              "seed": self.seed, "keys": keys, "rows": rows}
+        if rng is not None:
+            st["rng"] = {"alg": rng[0],
+                         "key": np.asarray(rng[1], np.uint32),
+                         "pos": int(rng[2]), "has_gauss": int(rng[3]),
+                         "cached": float(rng[4])}
+        return st
 
-    def load(self, path: str):
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
+    def import_state(self, st: dict):
         with self._lock:
-            self.dim = blob["dim"]
+            self.dim = int(st["dim"])
+            self.init_std = float(st.get("init_std", self.init_std))
+            self.seed = int(st.get("seed", self.seed))
+            keys = np.asarray(st["keys"], np.int64)
+            rows = np.asarray(st["rows"], np.float32)
             if self._native is not None:
                 from ....native import NativeKV
                 # keep the instance seed so fresh rows created after a
                 # restore stay reproducible
                 self._native = NativeKV(self.dim, self.init_std,
                                         self.seed)
-                if len(blob["keys"]):
-                    self._native.import_(blob["keys"], blob["rows"])
+                if len(keys):
+                    self._native.import_(keys, rows)
                 return
-            self._data = np.ascontiguousarray(blob["rows"])
-            self._index = {int(k): i for i, k in enumerate(blob["keys"])}
+            self._data = np.ascontiguousarray(rows)
+            self._index = {int(k): i for i, k in enumerate(keys)}
+            rng = st.get("rng")
+            if rng is not None:
+                self._rng.set_state((
+                    str(rng["alg"]), np.asarray(rng["key"], np.uint32),
+                    int(rng["pos"]), int(rng["has_gauss"]),
+                    float(rng["cached"])))
+
+    def save(self, path: str):
+        """Persist as npz (data-only; loads with allow_pickle=False)."""
+        st = self.export_state()
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, dim=np.int64(st["dim"]),
+                     init_std=np.float64(st["init_std"]),
+                     keys=st["keys"], rows=st["rows"])
+        os.replace(tmp, path)
+
+    def load(self, path: str):
+        with np.load(path, allow_pickle=False) as blob:
+            self.import_state({"dim": int(blob["dim"]),
+                               "init_std": float(blob["init_std"]),
+                               "keys": blob["keys"],
+                               "rows": blob["rows"]})
 
 
-# ---------------------------------------------------------------------------
-# transport: length-prefixed pickle over TCP
-# ---------------------------------------------------------------------------
-
-def _send_msg(sock, obj):
-    blob = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack("<Q", len(blob)) + blob)
-    return 8 + len(blob)
-
-
-def _recv_msg_sized(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        hdr += chunk
-    n = struct.unpack("<Q", hdr)[0]
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return pickle.loads(bytes(buf)), 8 + n
-
-
-def _recv_msg(sock):
-    return _recv_msg_sized(sock)[0]
+# transport: runtime/rpc.py frames (header + dtype/shape-tagged ndarray
+# segments — data-only, no pickle on the receive path)
 
 
 class _SyncRound:
@@ -269,12 +287,31 @@ class PSServer(socketserver.ThreadingTCPServer):
     """One PS shard: serves pull/push/save/size for its tables (reference
     listen_and_serv_op RunAsyncLoop — apply-on-arrival, no global
     barrier; RunSyncLoop when the sync ops are used). Port 0 binds an
-    ephemeral port; `endpoint` reports it."""
+    ephemeral port; `endpoint` reports it.
+
+    Graceful degradation: with `snapshot_dir` set (arg or
+    PADDLE_PS_SNAPSHOT_DIR), the shard snapshots its tables + dedup
+    state every `snapshot_every` applied pushes (and every
+    `snapshot_interval` seconds) and restores them on construction, so
+    a killed shard resumes via `restart_from_snapshot` while clients
+    retry-reconnect. Recovery covers the async push path; sync/DGC
+    round state is volatile by design (those jobs restart the round)."""
 
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, endpoint: str, worker_timeout: float = 60.0):
+    # ops that never mutate server state: exempt from dedup caching
+    READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
+                          "heartbeat"})
+    # mutating ops whose effects the snapshot tier persists
+    _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
+
+    def __init__(self, endpoint: str, worker_timeout: float = 60.0,
+                 snapshot_dir: str | None = None,
+                 snapshot_every: int | None = None,
+                 snapshot_interval: float | None = None,
+                 secret: str | None = None, fs=None,
+                 auto_restore: bool = True):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
@@ -287,19 +324,231 @@ class PSServer(socketserver.ThreadingTCPServer):
         self._beats: dict[int, float] = {}
         self._dgc: dict[str, _DGCRound] = {}
         self._beats_lock = threading.Lock()
+
+        env = os.environ.get
+        self.snapshot_dir = snapshot_dir \
+            if snapshot_dir is not None \
+            else (env("PADDLE_PS_SNAPSHOT_DIR") or None)
+        self.snapshot_every = snapshot_every \
+            if snapshot_every is not None \
+            else int(env("PADDLE_PS_SNAPSHOT_EVERY", "64") or 0)
+        self.snapshot_interval = snapshot_interval \
+            if snapshot_interval is not None \
+            else float(env("PADDLE_PS_SNAPSHOT_INTERVAL", "0") or 0)
+        if fs is None:
+            from ....distributed.fs import LocalFS
+            fs = LocalFS()
+        self._fs = fs
+        self._snap_lock = threading.Lock()
+        self._snap_io_lock = threading.Lock()  # one snapshot writer
+        # apply+dedup-commit vs snapshot-export atomicity: concurrent
+        # pushes and the exporter share this RLock (engaged only when
+        # snapshots are on — commit_scope returns None otherwise), so a
+        # restored snapshot can never hold an applied push without its
+        # dedup id or vice versa. RLock: the snapshot hook itself runs
+        # inside a push's commit scope.
+        self._apply_lock = threading.RLock()
+        self._snap_seq = 0       # exports, monotone (under apply lock)
+        self._snap_written = 0   # newest seq on disk (under io lock)
+        self._mutations = 0
+        self.snapshots_taken = 0
+        self._rpc = RpcServerState(read_ops=self.READ_OPS,
+                                   secret=secret,
+                                   after_commit=self._after_commit,
+                                   commit_scope=self._commit_scope)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
-                try:
-                    while True:
-                        req = _recv_msg(self.request)
-                        _send_msg(self.request, outer._dispatch(req))
-                except (ConnectionError, OSError):
-                    pass
+                serve_connection(self.request, outer._dispatch,
+                                 outer._rpc)
 
         super().__init__((host, int(port)), Handler)
         self.endpoint = f"{host}:{self.server_address[1]}"
+        if auto_restore and self.snapshot_dir \
+                and self._fs.is_file(self.snapshot_path):
+            self.load_snapshot()
+        self._snap_stop = threading.Event()
+        if self.snapshot_dir and self.snapshot_interval > 0:
+            threading.Thread(target=self._snapshot_loop,
+                             daemon=True).start()
+
+    # -- snapshot/recovery tier ----------------------------------------
+    @property
+    def snapshot_path(self) -> str | None:
+        if not self.snapshot_dir:
+            return None
+        tag = self.endpoint.replace(":", "_")
+        return os.path.join(self.snapshot_dir, f"ps_{tag}.snap.npz")
+
+    def _commit_scope(self, op: str):
+        # only the non-blocking async mutations take the shared lock;
+        # barrier/DGC dispatch blocks on straggler trainers and their
+        # round state is volatile by design (not snapshot-covered)
+        if op == "push" and self.snapshot_dir:
+            return self._apply_lock
+        return None
+
+    def _after_commit(self, op: str):
+        if op not in self._SNAPSHOT_OPS:
+            return
+        with self._snap_lock:
+            self._mutations += 1
+            due = bool(self.snapshot_dir and self.snapshot_every
+                       and self._mutations % self.snapshot_every == 0)
+        if due:
+            self.snapshot()
+
+    def _snapshot_loop(self):
+        while not self._snap_stop.wait(self.snapshot_interval):
+            self.snapshot()
+
+    def snapshot(self):
+        """Consistent table+dedup snapshot. Runs before the mutating
+        reply is sent (`after_commit` hook), so a crash between apply
+        and reply still resolves to exactly-once: the retried request
+        hits the restored dedup set.
+
+        Locking: the EXPORT runs under `_apply_lock` (tables and dedup
+        ids must come from the same instant, or a crash-restore could
+        double-apply or drop a concurrent worker's push); the npz
+        write runs under `_snap_io_lock` only, so concurrent pushes
+        proceed during disk IO. Lock order is always apply -> io (the
+        push-commit path enters here already holding the apply RLock);
+        a sequence number keeps a slow older writer from clobbering a
+        newer snapshot. Cost note: each snapshot serializes all tables
+        + the dedup reply cache — size the stride
+        (PADDLE_PS_SNAPSHOT_EVERY) to the table volume; =1 is the
+        write-through durability mode the exactly-once tests use."""
+        path = self.snapshot_path
+        if path is None:
+            return
+        with self._apply_lock:
+            arrays = self._export_arrays()
+            self._snap_seq += 1
+            seq = self._snap_seq
+        with self._snap_io_lock:
+            if seq <= self._snap_written:
+                return  # a newer export already reached disk
+            self._write_snapshot(path, arrays)
+            self._snap_written = seq
+            self.snapshots_taken += 1
+
+    def _export_arrays(self) -> dict:
+        arrays: dict[str, np.ndarray] = {}
+        meta = {"version": 1, "endpoint": self.endpoint,
+                "mutations": self._mutations, "tables": {}}
+        with self._tables_lock:
+            items = list(self.tables.items())
+        for name, t in items:
+            st = t.export_state()
+            tmeta = {"dim": st["dim"], "init_std": st["init_std"],
+                     "seed": st["seed"]}
+            arrays[f"k:{name}"] = st["keys"]
+            arrays[f"r:{name}"] = st["rows"]
+            rng = st.get("rng")
+            if rng is not None:
+                tmeta["rng"] = {"alg": rng["alg"], "pos": rng["pos"],
+                                "has_gauss": rng["has_gauss"],
+                                "cached": rng["cached"]}
+                arrays[f"s:{name}"] = rng["key"]
+            meta["tables"][name] = tmeta
+        ids, blobs = self._rpc.dedup.export()
+        arrays["dedup_ids"] = ids
+        arrays["dedup_lens"] = np.array([len(b) for b in blobs],
+                                        np.int64)
+        arrays["dedup_blob"] = np.frombuffer(
+            b"".join(blobs), np.uint8) if blobs else \
+            np.empty(0, np.uint8)
+        arrays["meta"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), np.uint8)
+        return arrays
+
+    def _write_snapshot(self, path: str, arrays: dict):
+        from ....distributed.fs import LocalFS
+        self._fs.mkdirs(self.snapshot_dir)
+        if isinstance(self._fs, LocalFS):
+            # fast path: write beside the target, atomic rename
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            self._fs.mv(tmp, path, overwrite=True)
+            return
+        # remote fs (HDFSClient &co): stage locally, upload, rename
+        import tempfile
+        fd, local = tempfile.mkstemp(suffix=".snap.npz")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **arrays)
+            remote_tmp = f"{path}.tmp"
+            self._fs.delete(remote_tmp)
+            self._fs.upload(local, remote_tmp)
+            self._fs.mv(remote_tmp, path, overwrite=True)
+        finally:
+            if os.path.exists(local):
+                os.unlink(local)
+
+    def load_snapshot(self, path: str | None = None):
+        from ....distributed.fs import LocalFS
+        path = path or self.snapshot_path
+        local = path
+        staged = None
+        if not isinstance(self._fs, LocalFS):
+            import tempfile
+            fd, staged = tempfile.mkstemp(suffix=".snap.npz")
+            os.close(fd)
+            os.unlink(staged)  # fs.download copies onto a fresh path
+            self._fs.download(path, staged)
+            local = staged
+        try:
+            self._load_snapshot_file(local)
+        finally:
+            if staged and os.path.exists(staged):
+                os.unlink(staged)
+
+    def _load_snapshot_file(self, path: str):
+        with np.load(path, allow_pickle=False) as blob:
+            meta = json.loads(bytes(blob["meta"]).decode("utf-8"))
+            tables: dict[str, LargeScaleKV] = {}
+            for name, tmeta in meta["tables"].items():
+                t = LargeScaleKV(int(tmeta["dim"]),
+                                 init_std=float(tmeta["init_std"]),
+                                 seed=int(tmeta["seed"]))
+                st = {"dim": tmeta["dim"],
+                      "init_std": tmeta["init_std"],
+                      "seed": tmeta["seed"],
+                      "keys": blob[f"k:{name}"],
+                      "rows": blob[f"r:{name}"]}
+                if "rng" in tmeta:
+                    st["rng"] = dict(tmeta["rng"],
+                                     key=blob[f"s:{name}"])
+                t.import_state(st)
+                tables[name] = t
+            ids = blob["dedup_ids"]
+            lens = blob["dedup_lens"].tolist()
+            raw = blob["dedup_blob"].tobytes()
+            blobs, off = [], 0
+            for n in lens:
+                blobs.append(raw[off:off + n])
+                off += n
+        with self._tables_lock:
+            self.tables = tables
+        self._rpc.dedup.import_(ids, blobs)
+        with self._snap_lock:
+            self._mutations = int(meta.get("mutations", 0))
+
+    @classmethod
+    def restart_from_snapshot(cls, endpoint: str, snapshot_dir: str,
+                              **kwargs) -> "PSServer":
+        """Bring a killed shard back on its endpoint, restoring tables,
+        dedup ids, and RNG streams from the latest snapshot (workers'
+        retry loops reconnect on their own)."""
+        return cls(endpoint, snapshot_dir=snapshot_dir,
+                   auto_restore=True, **kwargs)
+
+    def server_close(self):
+        self._snap_stop.set()
+        super().server_close()
 
     def table(self, name: str, dim: int,
               init_std: float = 0.01) -> LargeScaleKV:
@@ -359,20 +608,14 @@ class PSServer(socketserver.ThreadingTCPServer):
             # sparse gradient round (DGC transport, reference dgc_op.h +
             # sparse allreduce in operators/collective): accumulate each
             # trainer's top-k (idx, val) pairs; seal when all arrived.
-            # Timeouts surface as an error PAYLOAD — TimeoutError is an
-            # OSError subclass the connection handler would swallow
-            try:
-                return self._dgc_round(req["table"], int(req["trainers"])
-                                       ).push(int(req["worker"]),
-                                              req["idx"], req["val"])
-            except (TimeoutError, RuntimeError) as e:
-                return {"error": str(e)}
+            # Timeouts propagate — serve_connection turns any dispatch
+            # exception into an error frame instead of a dead socket
+            return self._dgc_round(req["table"], int(req["trainers"])
+                                   ).push(int(req["worker"]),
+                                          req["idx"], req["val"])
         if op == "dgc_pull":
-            try:
-                return self._dgc_round(req["table"], int(req["trainers"])
-                                       ).pull(int(req["worker"]))
-            except (TimeoutError, RuntimeError) as e:
-                return {"error": str(e)}
+            return self._dgc_round(req["table"], int(req["trainers"])
+                                   ).pull(int(req["worker"]))
         raise ValueError(f"unknown PS op {op!r}")
 
     def _dgc_round(self, table: str, trainers: int) -> "_DGCRound":
@@ -422,53 +665,43 @@ class PSServer(socketserver.ThreadingTCPServer):
 
 class PSClient:
     """Worker-side stub: key-hash routing across server shards (reference
-    ps_dispatcher hash dispatch + Communicator send path)."""
+    ps_dispatcher hash dispatch + Communicator send path), one
+    fault-tolerant RpcClient channel per shard (retry with stable
+    request ids, per-request deadlines, backoff — reference brpc
+    channel timeout_ms/max_retry)."""
 
-    def __init__(self, endpoints: list[str]):
+    # sync-mode barrier (and DGC round) calls legitimately block
+    # server-side for up to 300s waiting on straggler trainers — their
+    # per-attempt timeout must outlast that
+    BARRIER_TIMEOUT = 340.0
+
+    def __init__(self, endpoints: list[str], secret: str | None = None,
+                 timeout: float | None = None,
+                 deadline: float | None = None,
+                 max_retries: int | None = None,
+                 backoff: float | None = None):
         self.endpoints = list(endpoints)
-        self._socks: list[socket.socket | None] = [None] * len(endpoints)
-        self._locks = [threading.Lock() for _ in endpoints]
+        # wire + fault accounting shared across shard channels
+        # (bench/diagnostics read .bytes_out/.bytes_in; robustness
+        # tests read .stats)
+        self.stats = TransportStats()
+        self._clients = [
+            RpcClient(ep, stats=self.stats, secret=secret,
+                      timeout=timeout, deadline=deadline,
+                      max_retries=max_retries, backoff=backoff)
+            for ep in self.endpoints]
         self._pool = None  # lazy persistent fan-out pool
-        # wire accounting (bench/diagnostics): bytes on the TCP
-        # transport; own lock — _call runs concurrently from the
-        # per-endpoint fan-out threads
-        self.bytes_out = 0
-        self.bytes_in = 0
-        self._bytes_lock = threading.Lock()
 
-    def _sock(self, i: int) -> socket.socket:
-        if self._socks[i] is None:
-            import time
-            host, port = self.endpoints[i].rsplit(":", 1)
-            # retry the connect: workers routinely start before their
-            # server finished binding (reference brpc channel retries)
-            last = None
-            for attempt in range(30):
-                try:
-                    # generous timeout: sync-mode barrier calls block
-                    # server-side until the whole trainer round arrives
-                    s = socket.create_connection((host, int(port)),
-                                                 timeout=330)
-                    break
-                except OSError as e:
-                    last = e
-                    time.sleep(min(0.2 * (attempt + 1), 2.0))
-            else:
-                raise ConnectionError(
-                    f"PS server {self.endpoints[i]} unreachable: {last}")
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks[i] = s
-        return self._socks[i]
+    @property
+    def bytes_out(self) -> int:
+        return self.stats.bytes_out
 
-    def _call(self, i: int, req: dict):
-        with self._locks[i]:
-            s = self._sock(i)
-            n_out = _send_msg(s, req)
-            obj, n_in = _recv_msg_sized(s)
-        with self._bytes_lock:
-            self.bytes_out += n_out
-            self.bytes_in += n_in
-        return obj
+    @property
+    def bytes_in(self) -> int:
+        return self.stats.bytes_in
+
+    def _call(self, i: int, req: dict, **kw):
+        return self._clients[i].call(req, **kw)
 
     def _route(self, keys: np.ndarray) -> np.ndarray:
         return (keys.astype(np.int64) % len(self.endpoints)).astype(np.int64)
@@ -527,7 +760,8 @@ class PSClient:
         self._fanout([
             (lambda i=i: self._call(i, {"op": "send_barrier",
                                         "worker": worker,
-                                        "trainers": trainers}))
+                                        "trainers": trainers},
+                                    timeout=self.BARRIER_TIMEOUT))
             for i in range(len(self.endpoints))])
 
     def fetch_barrier(self, worker: int, trainers: int):
@@ -535,7 +769,8 @@ class PSClient:
         self._fanout([
             (lambda i=i: self._call(i, {"op": "fetch_barrier",
                                         "worker": worker,
-                                        "trainers": trainers}))
+                                        "trainers": trainers},
+                                    timeout=self.BARRIER_TIMEOUT))
             for i in range(len(self.endpoints))])
 
     def size(self, table: str) -> int:
@@ -576,18 +811,17 @@ class PSClient:
             calls.append((lambda i=i, m=m: self._call(
                 i, {"op": "dgc_push", "table": name, "idx": idx[m],
                     "val": val[m], "worker": worker,
-                    "trainers": trainers})))
-        for r in self._fanout(calls):
-            if isinstance(r, dict) and "error" in r:
-                raise RuntimeError(f"dgc_push failed: {r['error']}")
+                    "trainers": trainers},
+                timeout=self.BARRIER_TIMEOUT)))
+        # round failures (straggler timeout, trainer-count change)
+        # surface as PSRemoteError from the error frame
+        self._fanout(calls)
         parts = self._fanout([
             (lambda i=i: self._call(i, {"op": "dgc_pull", "table": name,
                                         "worker": worker,
-                                        "trainers": trainers}))
+                                        "trainers": trainers},
+                                    timeout=self.BARRIER_TIMEOUT))
             for i in range(len(self.endpoints))])
-        for p in parts:
-            if "error" in p:
-                raise RuntimeError(f"dgc_pull failed: {p['error']}")
         midx = np.concatenate([p["idx"] for p in parts])
         mval = np.concatenate([p["val"] for p in parts])
         order = np.argsort(midx, kind="stable")
@@ -597,10 +831,8 @@ class PSClient:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
-        for s in self._socks:
-            if s is not None:
-                s.close()
-        self._socks = [None] * len(self.endpoints)
+        for c in self._clients:
+            c.close()
 
 
 class ParameterServerRuntime:

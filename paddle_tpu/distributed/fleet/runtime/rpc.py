@@ -1,0 +1,662 @@
+"""Fault-tolerant RPC layer for the PS/heter tier.
+
+Replaces the seed's length-prefixed-pickle transport with a data-only
+wire format plus client retry and server dedup. Reference analog: the
+brpc channel options (timeout_ms / max_retry / backoff) and the
+gRPC/BRPC request framing under operators/distributed/, re-expressed as
+a dependency-free protocol:
+
+  frame   := header || body
+  header  := magic u16 | ver u8 | flags u8 | req_id u64 | crc u32
+             | body_len u64                      (24 bytes, little-endian)
+  body    := skel_len u32 | skeleton(JSON) | segment*
+  segment := dtype u8 | ndim u8 | dims i64*ndim | raw row-major bytes
+
+The skeleton is plain JSON (dict/list/str/number/bool/null) where every
+ndarray was replaced by {"__nd__": k}; segments carry the arrays in
+order. Decoding therefore never evaluates attacker-controlled code —
+`json.loads` plus `np.frombuffer` against a dtype whitelist — unlike the
+pickle path this replaces (ADVICE: RCE if bound beyond localhost).
+
+Integrity/auth:
+  * crc32 over the body rejects corrupted frames (fault tolerance, not
+    security — CRC is not a MAC).
+  * optional shared-secret handshake: when PADDLE_PS_SECRET is set on
+    the server, every connection must answer an HMAC-SHA256 challenge
+    before the first request. See docs/PS_WIRE_PROTOCOL.md for the
+    remaining trusted-network assumptions.
+
+Client semantics (`RpcClient.call`):
+  * per-request deadline + per-attempt timeout,
+  * exponential backoff with jitter, bounded retries/reconnects,
+  * a stable request id across retries; the server dedups mutating ops
+    by id, so a retried gradient push is applied exactly once.
+"""
+from __future__ import annotations
+
+import contextlib
+import hmac
+import hashlib
+import json
+import os
+import random
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .fault_injection import injector
+
+__all__ = [
+    "WireError", "PSAuthError", "PSRemoteError", "PSDeadlineError",
+    "encode_body", "decode_body", "send_frame", "recv_frame",
+    "TransportStats", "RpcClient", "DedupCache", "RpcServerState",
+    "serve_connection", "PROTOCOL_VERSION",
+]
+
+PROTOCOL_VERSION = 1
+_MAGIC = 0x7053                      # "Sp" — PS rpc
+_HDR = struct.Struct("<HBBQIQ")      # magic, ver, flags, req_id, crc, len
+HEADER_SIZE = _HDR.size
+F_ERROR = 1
+F_HANDSHAKE = 2
+_MAX_BODY = 1 << 31                  # sanity bound on a length field
+
+_ND_KEY = "__nd__"
+
+# dtype whitelist: receiving anything else is a wire error, never an
+# object/pickle dtype
+_DTYPES = [np.dtype(s) for s in (
+    "float32", "float64", "float16", "int64", "int32", "int16", "int8",
+    "uint64", "uint32", "uint16", "uint8", "bool")]
+_DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
+
+
+class WireError(ConnectionError):
+    """Malformed/corrupt frame — the connection is no longer trusted."""
+
+
+class PSAuthError(RuntimeError):
+    """Handshake failure. Not retryable."""
+
+
+class PSRemoteError(RuntimeError):
+    """The server dispatched the request and replied with an error."""
+
+
+class PSDeadlineError(ConnectionError):
+    """Retries/deadline exhausted without a successful round-trip."""
+
+
+# ---------------------------------------------------------------------------
+# body codec: JSON skeleton + dtype/shape-tagged ndarray segments
+# ---------------------------------------------------------------------------
+
+def encode_body(obj) -> bytes:
+    arrays: list[np.ndarray] = []
+
+    def strip(o):
+        if isinstance(o, np.ndarray):
+            arrays.append(o)
+            return {_ND_KEY: len(arrays) - 1}
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, dict):
+            return {str(k): strip(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return [strip(v) for v in o]
+        return o
+
+    skel = json.dumps(strip(obj)).encode("utf-8")
+    parts = [struct.pack("<I", len(skel)), skel]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        code = _DTYPE_CODE.get(a.dtype)
+        if code is None:
+            raise TypeError(
+                f"dtype {a.dtype} is not wire-safe (whitelist: "
+                f"{[str(d) for d in _DTYPES]})")
+        parts.append(struct.pack("<BB", code, a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        parts.append(a.tobytes())
+    return b"".join(parts)
+
+
+def decode_body(buf: bytes):
+    if len(buf) < 4:
+        raise WireError("body too short")
+    (skel_len,) = struct.unpack_from("<I", buf, 0)
+    if 4 + skel_len > len(buf):
+        raise WireError("skeleton length exceeds body")
+    try:
+        skel = json.loads(buf[4:4 + skel_len].decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise WireError(f"bad skeleton: {e}") from None
+    arrays: list[np.ndarray] = []
+    off = 4 + skel_len
+    while off < len(buf):
+        if off + 2 > len(buf):
+            raise WireError("truncated segment header")
+        code, ndim = struct.unpack_from("<BB", buf, off)
+        off += 2
+        if code >= len(_DTYPES) or ndim > 16:
+            raise WireError(f"bad segment tag ({code}, {ndim})")
+        if off + 8 * ndim > len(buf):
+            raise WireError("truncated segment dims")
+        dims = struct.unpack_from(f"<{ndim}q", buf, off)
+        off += 8 * ndim
+        if any(d < 0 for d in dims):
+            raise WireError(f"negative dim {dims}")
+        dt = _DTYPES[code]
+        # python-int product: immune to the int64 overflow a hostile
+        # dims vector could use to slip past the bounds check
+        count = 1
+        for d in dims:
+            count *= d
+        nbytes = count * dt.itemsize if ndim else dt.itemsize
+        if nbytes > len(buf) - off:
+            raise WireError("segment data exceeds body")
+        try:
+            arr = np.frombuffer(buf, dt, count=nbytes // dt.itemsize,
+                                offset=off).reshape(dims)
+        except ValueError as e:
+            raise WireError(f"bad segment geometry: {e}") from None
+        arrays.append(arr)
+        off += nbytes
+
+    def build(o):
+        if isinstance(o, dict):
+            if set(o) == {_ND_KEY} and isinstance(o[_ND_KEY], int):
+                k = o[_ND_KEY]
+                if not 0 <= k < len(arrays):
+                    raise WireError(f"dangling array ref {k}")
+                return arrays[k]
+            return {k: build(v) for k, v in o.items()}
+        if isinstance(o, list):
+            return [build(v) for v in o]
+        return o
+
+    return build(skel)
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, obj, req_id: int = 0,
+               flags: int = 0, side: str | None = None) -> int:
+    body = encode_body(obj)
+    frame = _HDR.pack(_MAGIC, PROTOCOL_VERSION, flags, req_id,
+                      zlib.crc32(body), len(body)) + body
+    inj = injector()
+    if inj.active:
+        frame, action = inj.mangle(frame, HEADER_SIZE, side)
+        if action == "drop":
+            sock.close()
+            raise ConnectionError("fault-injected frame drop")
+        if action == "truncate":
+            try:
+                sock.sendall(frame[:max(len(frame) // 2, 1)])
+            finally:
+                sock.close()
+            raise ConnectionError("fault-injected frame truncation")
+    sock.sendall(frame)
+    return len(frame)
+
+
+def _recvn(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket, side: str | None = None):
+    """Returns (obj, req_id, flags, frame_bytes). Raises WireError on a
+    frame that fails validation — the stream is desynced, the caller
+    must close the connection."""
+    hdr = _recvn(sock, HEADER_SIZE)
+    magic, ver, flags, req_id, crc, body_len = _HDR.unpack(hdr)
+    if magic != _MAGIC:
+        raise WireError(f"bad magic 0x{magic:04x}")
+    if ver != PROTOCOL_VERSION:
+        raise WireError(f"protocol version {ver} != {PROTOCOL_VERSION}")
+    if body_len > _MAX_BODY:
+        raise WireError(f"body length {body_len} exceeds bound")
+    body = _recvn(sock, body_len)
+    if zlib.crc32(body) != crc:
+        raise WireError("crc mismatch (corrupt frame)")
+    return decode_body(body), req_id, flags, HEADER_SIZE + body_len
+
+
+# ---------------------------------------------------------------------------
+# handshake: protocol version + optional HMAC shared secret
+# ---------------------------------------------------------------------------
+
+def _mac(secret: str, nonce: str) -> str:
+    return hmac.new(secret.encode(), nonce.encode(),
+                    hashlib.sha256).hexdigest()
+
+
+def server_handshake(sock: socket.socket, secret: str | None):
+    nonce = os.urandom(16).hex() if secret else None
+    send_frame(sock, {"ver": PROTOCOL_VERSION, "nonce": nonce},
+               flags=F_HANDSHAKE)
+    reply, _rid, flags, _n = recv_frame(sock)
+    if not flags & F_HANDSHAKE:
+        raise WireError("expected handshake reply")
+    if secret is not None:
+        mac = reply.get("mac") if isinstance(reply, dict) else None
+        if not (isinstance(mac, str)
+                and hmac.compare_digest(mac, _mac(secret, nonce))):
+            send_frame(sock, {"error": "authentication failed",
+                              "kind": "auth"}, flags=F_ERROR)
+            raise PSAuthError("client failed the PADDLE_PS_SECRET "
+                              "challenge")
+    send_frame(sock, {"ok": True}, flags=F_HANDSHAKE)
+
+
+def client_handshake(sock: socket.socket, secret: str | None):
+    hello, _rid, flags, _n = recv_frame(sock)
+    if not flags & F_HANDSHAKE or not isinstance(hello, dict):
+        raise WireError("expected handshake hello")
+    if hello.get("ver") != PROTOCOL_VERSION:
+        raise PSAuthError(
+            f"server protocol version {hello.get('ver')} != "
+            f"{PROTOCOL_VERSION}")
+    nonce = hello.get("nonce")
+    if nonce is not None and secret is None:
+        raise PSAuthError(
+            "server requires a shared secret — set PADDLE_PS_SECRET")
+    mac = _mac(secret, nonce) if nonce is not None else None
+    send_frame(sock, {"mac": mac}, flags=F_HANDSHAKE)
+    ok, _rid, flags, _n = recv_frame(sock)
+    if flags & F_ERROR:
+        raise PSAuthError(str(ok.get("error", "handshake rejected"))
+                          if isinstance(ok, dict) else "rejected")
+    if not flags & F_HANDSHAKE:
+        raise WireError("expected handshake ack")
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class TransportStats:
+    """Thread-safe transport counters, shared across a client's
+    per-endpoint connections (tests/benchmarks read these)."""
+
+    _FIELDS = ("requests", "retries", "reconnects", "timeouts",
+               "corrupt_frames", "remote_errors", "deadline_exceeded")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes_out = 0
+        self.bytes_in = 0
+        for f in self._FIELDS:
+            setattr(self, f, 0)
+
+    def add(self, field: str, n: int = 1):
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def add_bytes(self, n_out: int, n_in: int):
+        with self._lock:
+            self.bytes_out += n_out
+            self.bytes_in += n_in
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            d = {f: getattr(self, f) for f in self._FIELDS}
+            d["bytes_out"] = self.bytes_out
+            d["bytes_in"] = self.bytes_in
+            return d
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+class RpcClient:
+    """One endpoint's fault-tolerant channel: lazy connect + handshake,
+    per-request deadline, exponential backoff with jitter, bounded
+    retries, and stable request ids for server-side dedup."""
+
+    def __init__(self, endpoint: str, stats: TransportStats | None = None,
+                 secret: str | None = None,
+                 timeout: float | None = None,
+                 deadline: float | None = None,
+                 max_retries: int | None = None,
+                 backoff: float | None = None,
+                 backoff_max: float = 2.0):
+        self.endpoint = endpoint
+        self.stats = stats if stats is not None else TransportStats()
+        self.secret = secret if secret is not None \
+            else os.environ.get("PADDLE_PS_SECRET")
+        self.timeout = timeout if timeout is not None \
+            else _env_float("PADDLE_PS_TIMEOUT", 60.0)
+        self.deadline = deadline if deadline is not None \
+            else _env_float("PADDLE_PS_DEADLINE", 600.0)
+        self.max_retries = max_retries if max_retries is not None \
+            else int(_env_float("PADDLE_PS_RETRIES", 64))
+        self.backoff = backoff if backoff is not None \
+            else _env_float("PADDLE_PS_BACKOFF", 0.05)
+        self.backoff_max = backoff_max
+        self._sock: socket.socket | None = None
+        self._ever_connected = False
+        self._lock = threading.Lock()
+        # request ids stay unique across client restarts of THIS process
+        # but not across client processes — a 32-bit random token
+        # namespaces the 32-bit sequence
+        self._token = int.from_bytes(os.urandom(4), "little")
+        self._seq = 0
+
+    def _next_id(self) -> int:
+        self._seq = (self._seq + 1) & 0xFFFFFFFF
+        return (self._token << 32) | self._seq
+
+    def _connect(self, attempt_timeout: float):
+        host, port = self.endpoint.rsplit(":", 1)
+        s = socket.create_connection((host, int(port)),
+                                     timeout=attempt_timeout)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            client_handshake(s, self.secret)
+        except BaseException:
+            s.close()
+            raise
+        if self._ever_connected:
+            self.stats.add("reconnects")
+        self._ever_connected = True
+        self._sock = s
+
+    def _drop(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def call(self, req, timeout: float | None = None,
+             deadline: float | None = None):
+        """One request/reply round-trip; retried with the same request
+        id until success, the deadline, or the retry bound."""
+        per_attempt = timeout if timeout is not None else self.timeout
+        deadline_ts = time.monotonic() + (
+            deadline if deadline is not None else self.deadline)
+        req_id = None
+        attempt = 0
+        last: Exception | None = None
+        with self._lock:
+            self.stats.add("requests")
+            while True:
+                remaining = deadline_ts - time.monotonic()
+                if remaining <= 0 or attempt > self.max_retries:
+                    self.stats.add("deadline_exceeded")
+                    raise PSDeadlineError(
+                        f"PS request to {self.endpoint} failed after "
+                        f"{attempt} attempt(s): {last}") from last
+                try:
+                    if self._sock is None:
+                        self._connect(min(5.0, max(remaining, 0.1)))
+                    if req_id is None:
+                        req_id = self._next_id()
+                    s = self._sock
+                    s.settimeout(min(per_attempt, max(remaining, 0.1)))
+                    n_out = send_frame(s, req, req_id=req_id,
+                                       side="client")
+                    rep, rid, flags, n_in = recv_frame(s, side="client")
+                    self.stats.add_bytes(n_out, n_in)
+                    if rid != req_id:
+                        raise WireError(
+                            f"reply id {rid:#x} != request {req_id:#x}")
+                    if flags & F_ERROR:
+                        self.stats.add("remote_errors")
+                        msg = rep.get("error", "remote error") \
+                            if isinstance(rep, dict) else str(rep)
+                        if isinstance(rep, dict) \
+                                and rep.get("kind") == "auth":
+                            raise PSAuthError(msg)
+                        raise PSRemoteError(msg)
+                    return rep
+                except (PSAuthError, PSRemoteError):
+                    raise
+                except WireError as e:
+                    last = e
+                    self.stats.add("corrupt_frames")
+                except socket.timeout as e:
+                    last = e
+                    self.stats.add("timeouts")
+                except (ConnectionError, OSError) as e:
+                    last = e
+                self._drop()
+                self.stats.add("retries")
+                attempt += 1
+                pause = min(self.backoff * (2 ** (attempt - 1)),
+                            self.backoff_max)
+                time.sleep(pause * (0.5 + random.random()))
+
+    def close(self):
+        with self._lock:
+            self._drop()
+
+
+# ---------------------------------------------------------------------------
+# server-side connection loop: handshake + dedup + error replies
+# ---------------------------------------------------------------------------
+
+_FRESH = object()
+
+
+_NULL_SCOPE = contextlib.nullcontext()
+
+
+def _reply_nbytes(obj) -> int:
+    """Rough retained size of a cached reply (arrays dominate)."""
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes + 64
+    if isinstance(obj, dict):
+        return 64 + sum(_reply_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return 64 + sum(_reply_nbytes(v) for v in obj)
+    return 64
+
+
+class DedupCache:
+    """Request-id -> reply memo for mutating ops (exactly-once across
+    client retries). `begin` parks duplicate ids that race an in-flight
+    original; entries are evicted FIFO past `capacity` entries or
+    `max_bytes` of retained reply payload (the heter dense tier caches
+    gradient-bundle replies — an entry-count bound alone would retain
+    gigabytes)."""
+
+    def __init__(self, capacity: int = 65536,
+                 max_bytes: int = 256 * (1 << 20)):
+        self.capacity = capacity
+        self.max_bytes = max_bytes
+        self._cond = threading.Condition()
+        self._done: dict[int, object] = {}
+        self._order: list[int] = []
+        self._bytes = 0
+        # newest committed req_id per client token (req_id >> 32): a
+        # client serializes its calls, so only its LATEST request can
+        # be mid-retry — protecting that one entry per client from
+        # eviction closes the evicted-while-retrying double-apply
+        # window at O(#clients) extra retention. The token set itself
+        # is FIFO-bounded (first-seen order) so weeks of client churn
+        # cannot pin unbounded replies; an expelled token's entry just
+        # becomes normally evictable again.
+        self._newest: dict[int, int] = {}
+        self._token_order: list[int] = []
+        self.token_capacity = 4096
+        self._inflight: set[int] = set()
+
+    def begin(self, req_id: int):
+        """Returns the cached reply for a duplicate, or _FRESH (and
+        marks the id in-flight) for a first arrival."""
+        with self._cond:
+            while True:
+                if req_id in self._done:
+                    return self._done[req_id]
+                if req_id not in self._inflight:
+                    self._inflight.add(req_id)
+                    return _FRESH
+                if not self._cond.wait(timeout=600):
+                    raise TimeoutError(
+                        f"duplicate request {req_id:#x} stuck behind an "
+                        f"in-flight original")
+
+    def commit(self, req_id: int, reply):
+        with self._cond:
+            self._inflight.discard(req_id)
+            if req_id not in self._done:
+                self._done[req_id] = reply
+                self._order.append(req_id)
+                self._bytes += _reply_nbytes(reply)
+                token = req_id >> 32
+                if token not in self._newest:
+                    self._token_order.append(token)
+                    while len(self._token_order) > self.token_capacity:
+                        self._newest.pop(self._token_order.pop(0),
+                                         None)
+                self._newest[token] = req_id
+                # evict FIFO past the entry/byte bound, but never a
+                # client's newest entry — that one may be mid-retry
+                scanned = 0
+                while scanned < len(self._order) and \
+                        (len(self._order) > self.capacity
+                         or self._bytes > self.max_bytes):
+                    old = self._order.pop(0)
+                    if self._newest.get(old >> 32) == old:
+                        self._order.append(old)  # protected; keep
+                        scanned += 1
+                        continue
+                    gone = self._done.pop(old, None)
+                    if gone is not None:
+                        self._bytes -= _reply_nbytes(gone)
+            self._cond.notify_all()
+
+    def abort(self, req_id: int):
+        with self._cond:
+            self._inflight.discard(req_id)
+            self._cond.notify_all()
+
+    # -- snapshot support ----------------------------------------------
+    def export(self) -> tuple[np.ndarray, list[bytes]]:
+        with self._cond:
+            ids = np.array(self._order, np.uint64)
+            blobs = [encode_body(self._done[i]) for i in self._order]
+        return ids, blobs
+
+    def import_(self, ids: np.ndarray, blobs: list[bytes]):
+        with self._cond:
+            self._done.clear()
+            self._order = []
+            self._bytes = 0
+            self._newest = {}
+            self._token_order = []
+            for i, blob in zip(ids.tolist(), blobs):
+                reply = decode_body(blob)
+                self._done[int(i)] = reply
+                self._order.append(int(i))
+                self._bytes += _reply_nbytes(reply)
+                if (int(i) >> 32) not in self._newest:
+                    self._token_order.append(int(i) >> 32)
+                self._newest[int(i) >> 32] = int(i)
+            self._cond.notify_all()
+
+
+class RpcServerState:
+    """Per-server transport state shared by all connection handlers."""
+
+    def __init__(self, read_ops=frozenset(), secret: str | None = None,
+                 dedup_capacity: int = 65536, after_commit=None,
+                 commit_scope=None):
+        self.read_ops = frozenset(read_ops)
+        self.secret = secret if secret is not None \
+            else os.environ.get("PADDLE_PS_SECRET")
+        self.dedup = DedupCache(dedup_capacity)
+        # called with the op name after a mutating op was dispatched and
+        # its dedup entry recorded, BEFORE the reply is sent — the
+        # snapshot hook runs here so a post-snapshot crash still yields
+        # exactly-once on retry
+        self.after_commit = after_commit
+        # optional op -> lock/context-manager hook: when set, dispatch
+        # + dedup.commit + after_commit run inside it, so a concurrent
+        # snapshot export can never observe an applied mutation whose
+        # dedup id is missing (or vice versa). Only ops whose dispatch
+        # cannot block should return a scope — a barrier op waiting on
+        # straggler trainers inside a shared lock would stall the shard
+        self.commit_scope = commit_scope
+
+
+def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
+    """One connection's request loop. Application errors become error
+    frames; transport errors end the connection (the client's retry
+    path owns recovery)."""
+    inj = injector()
+    try:
+        server_handshake(sock, state.secret)
+        while True:
+            req, req_id, _flags, _n = recv_frame(sock, side="server")
+            armed = inj.count_request() if inj.active else False
+            if inj.active:
+                inj.maybe_kill("recv", armed)
+            op = req.get("op") if isinstance(req, dict) else None
+            mutating = op not in state.read_ops
+            if mutating and req_id:
+                cached = state.dedup.begin(req_id)
+                if cached is not _FRESH:
+                    if inj.active:
+                        inj.maybe_kill("reply", armed)
+                    send_frame(sock, cached, req_id=req_id,
+                               side="server")
+                    continue
+            scope = state.commit_scope(op) \
+                if state.commit_scope is not None else None
+            err = None
+            with scope if scope is not None else _NULL_SCOPE:
+                try:
+                    rep = dispatch(req)
+                except Exception as e:
+                    # application/dispatch failure (including barrier
+                    # timeouts): report as an error frame instead of
+                    # silently killing the connection
+                    if mutating and req_id:
+                        state.dedup.abort(req_id)
+                    err = {"error": f"{type(e).__name__}: {e}",
+                           "kind": "app"}
+                else:
+                    if mutating and req_id:
+                        state.dedup.commit(req_id, rep)
+            if err is not None:
+                send_frame(sock, err, req_id=req_id, flags=F_ERROR,
+                           side="server")
+                continue
+            if mutating and state.after_commit is not None:
+                # outside the commit scope (a snapshot's disk write
+                # must not stall other pushes on the commit lock) but
+                # before the reply: a crash in here still resolves to
+                # exactly-once — the mutation IS committed, so the
+                # client's retry lands on the dedup cache. Failures
+                # (e.g. snapshot disk error) propagate and close the
+                # connection for the same reason.
+                state.after_commit(op)
+            if inj.active:
+                inj.maybe_kill("reply", armed)
+            send_frame(sock, rep, req_id=req_id, side="server")
+    except (PSAuthError, WireError, ConnectionError, OSError):
+        pass
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass

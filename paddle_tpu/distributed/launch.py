@@ -48,6 +48,27 @@ def _parse(argv):
                    help="elastic: seconds without a heartbeat before a "
                         "rank counts as hung (ranks opt in via "
                         "distributed.elastic.start_heartbeat)")
+    p.add_argument("--ps_snapshot_dir", type=str, default=None,
+                   help="PS mode: server snapshot directory "
+                        "(PADDLE_PS_SNAPSHOT_DIR for the children); "
+                        "with --max_restarts > 0 a dead server is "
+                        "respawned ALONE from its snapshot instead of "
+                        "restarting the whole job. The dir is CLEARED "
+                        "at every job(-re)start — snapshots are "
+                        "intra-job fault tolerance (workers replay "
+                        "from scratch on a full restart; resuming "
+                        "stale tables would double-apply their "
+                        "pushes); use save/load_model for cross-job "
+                        "resume. Default: a temp dir when PS-mode "
+                        "elastic restarts are enabled")
+    p.add_argument("--ps_snapshot_every", type=int, default=1,
+                   help="PS mode: snapshot the server tables every N "
+                        "applied pushes (PADDLE_PS_SNAPSHOT_EVERY). "
+                        "Default 1 = write-through: a respawned server "
+                        "loses NO acknowledged push. N>1 trades that "
+                        "durability for throughput — a crash can "
+                        "silently drop up to N-1 acked pushes on "
+                        "respawn (see docs/PS_WIRE_PROTOCOL.md)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -73,43 +94,82 @@ def get_cluster_env(rank, endpoints, role="TRAINER", servers="",
     return env
 
 
+def _spawn_one(name, env_over, argv, log_dir):
+    env = dict(os.environ)
+    env.update(env_over)
+    if log_dir:
+        fh = open(os.path.join(log_dir, f"{name}.log"), "a")
+        stdout = stderr = fh
+    else:
+        fh, stdout, stderr = None, None, None
+    return [name, subprocess.Popen(argv, env=env, stdout=stdout,
+                                   stderr=stderr), fh]
+
+
 def _spawn_children(specs, log_dir):
-    """specs: list of (name, env_overrides, argv). Returns Popen list."""
+    """specs: list of (name, env_overrides, argv). Returns proc list."""
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-    procs = []
-    for name, env_over, argv in specs:
-        env = dict(os.environ)
-        env.update(env_over)
-        if log_dir:
-            fh = open(os.path.join(log_dir, f"{name}.log"), "w")
-            stdout = stderr = fh
-        else:
-            fh, stdout, stderr = None, None, None
-        procs.append((name, subprocess.Popen(argv, env=env, stdout=stdout,
-                                             stderr=stderr), fh))
-    return procs
+    return [_spawn_one(name, env_over, argv, log_dir)
+            for name, env_over, argv in specs]
 
 
-def _watch(procs, manager=None):
+def _watch(procs, manager=None, specs=None, log_dir=None):
     """Poll children; on failure or a hung heartbeat kill the rest
     (reference launch.py:214 watch + terminate_local_trainers). Returns
     (rc, needs_restart): the elastic loop in `launch` respawns when the
-    manager still has restarts left."""
+    manager still has restarts left.
+
+    Graceful PS degradation: when `specs` carries a snapshot dir for a
+    dead `server.*` child and the manager still has server-restart
+    budget, ONLY that shard is respawned — it restores from its
+    snapshot and the workers' transport retry loops reconnect, so one
+    dead PS server no longer costs a whole-job restart."""
+    specs = specs or {}
     try:
         while True:
             alive = False
-            for name, p, _ in procs:
+            for entry in procs:
+                name, p, fh = entry
                 rc = p.poll()
                 if rc is None:
                     alive = True
                 elif rc != 0:
+                    spec = specs.get(name)
+                    if spec is not None and manager is not None \
+                            and name.startswith("server.") \
+                            and manager.should_restart_server():
+                        manager.record_server_restart()
+                        sys.stderr.write(
+                            f"[launch] PS {name} exited with code {rc}; "
+                            f"restarting it from snapshot "
+                            f"({manager.server_restart_count}/"
+                            f"{manager.max_server_restarts})\n")
+                        if fh:
+                            fh.close()
+                        entry[:] = _spawn_one(name, spec[0], spec[1],
+                                              log_dir)
+                        alive = True
+                        continue
                     sys.stderr.write(
                         f"[launch] {name} exited with code {rc}; "
                         f"terminating the job\n")
                     _kill_all(procs)
                     return rc, True
             if not alive:
+                return 0, False
+            # PS mode: servers run forever — the job is DONE when every
+            # worker/trainer child finished cleanly (reference fleetrun
+            # tears servers down once trainers exit)
+            worker_rcs = [p.poll() for name, p, _ in procs
+                          if not name.startswith("server.")]
+            if worker_rcs and all(rc == 0 for rc in worker_rcs) \
+                    and any(name.startswith("server.")
+                            for name, _, _ in procs):
+                sys.stderr.write(
+                    "[launch] all workers finished; stopping PS "
+                    "servers\n")
+                _kill_all(procs)
                 return 0, False
             if manager is not None:
                 hung = manager.hung_ranks()
@@ -197,6 +257,19 @@ def launch(argv=None):
         hb_dir = tempfile.mkdtemp(prefix="paddle_elastic_hb_")
         for _name, env, _argv in specs:
             env["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
+    ps_mode = bool(args.servers or args.workers)
+    snap_dir = args.ps_snapshot_dir
+    if ps_mode and args.max_restarts > 0 and snap_dir is None:
+        import tempfile
+        snap_dir = tempfile.mkdtemp(prefix="paddle_ps_snap_")
+    server_specs = {}
+    if snap_dir:
+        for name, env, argv in specs:
+            if name.startswith("server."):
+                env["PADDLE_PS_SNAPSHOT_DIR"] = snap_dir
+                env["PADDLE_PS_SNAPSHOT_EVERY"] = \
+                    str(args.ps_snapshot_every)
+                server_specs[name] = (env, argv)
     manager = ElasticManager(
         max_restarts=args.max_restarts,
         heartbeat_timeout=args.heartbeat_timeout,
@@ -207,11 +280,21 @@ def launch(argv=None):
         if hb_dir:  # fresh heartbeat epoch per attempt
             for f in os.listdir(hb_dir):
                 os.unlink(os.path.join(hb_dir, f))
+        if snap_dir and os.path.isdir(snap_dir):
+            # whole-job (re)start: workers replay from scratch with
+            # fresh request ids, so a server resuming mid-run tables
+            # from a stale snapshot would double-apply every first-life
+            # push — servers must start fresh too. (Single-server
+            # respawn inside _watch intentionally KEEPS the snapshot:
+            # there the workers' in-flight state continues.)
+            for f in os.listdir(snap_dir):
+                os.unlink(os.path.join(snap_dir, f))
         procs = _spawn_children(specs, args.log_dir)
         # forward SIGTERM to the job
         signal.signal(signal.SIGTERM, lambda *a: (_kill_all(procs),
                                                   sys.exit(143)))
-        rc, needs_restart = _watch(procs, manager)
+        rc, needs_restart = _watch(procs, manager, specs=server_specs,
+                                   log_dir=args.log_dir)
         if rc == 0 or manager is None or not needs_restart \
                 or not manager.should_restart():
             return rc

@@ -1066,7 +1066,8 @@ def _fused_ffn_op(ctx, ins, attrs):
     for s in v.shape[:-1]:
         m *= s
     from ...ops.pallas_ffn import can_use_fused_ffn, fused_ffn
-    if act in ("gelu", "relu") and can_use_fused_ffn(m, h, i):
+    if act in ("gelu", "relu") and can_use_fused_ffn(
+            m, h, i, itemsize=v.dtype.itemsize):
         return out(fused_ffn(v, w1, b1, w2, b2, act))
     # composed fallback (non-aligned dims / pallas disabled / other act)
     hid = v.reshape(m, h) @ w1 + b1

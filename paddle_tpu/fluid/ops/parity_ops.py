@@ -75,10 +75,31 @@ def _reverse(ctx, ins, attrs):
 
 
 def _crop_common(v, offsets, shape):
-    # offsets may be traced (dynamic_slice supports that); shape is static
-    shape = [v.shape[i] if s in (-1, 0) and i < v.ndim else int(s)
-             for i, s in enumerate(shape)]
-    return jax.lax.dynamic_slice(v, list(offsets), shape)
+    # offsets may be traced (dynamic_slice supports that); shape is
+    # static and LITERAL here — callers resolve any 0/-1 expansion
+    # before calling (ADVICE: expanding to the full input dim under a
+    # nonzero offset made dynamic_slice clamp the start and silently
+    # return a shifted window)
+    return jax.lax.dynamic_slice(v, list(offsets),
+                                 [int(s) for s in shape])
+
+
+def _expand_crop_shape(v, shape, offsets, what):
+    """Resolve 0/-1 shape entries to the REMAINING extent
+    (dim - offset). Needs compile-time offsets: with a traced offset
+    the output shape would be dynamic, which XLA cannot express —
+    reject instead of returning a shifted window."""
+    if not any(s in (-1, 0) for s in shape):
+        return [int(s) for s in shape]
+    static = []
+    for o in offsets:
+        if isinstance(o, jax.core.Tracer):
+            raise NotImplementedError(
+                f"{what}: shape entries 0/-1 need compile-time offsets "
+                "(static output shapes on TPU)")
+        static.append(int(o))
+    return [v.shape[i] - static[i] if s in (-1, 0) and i < v.ndim
+            else int(s) for i, s in enumerate(shape)]
 
 
 def _static_ints(t, what):
@@ -103,6 +124,7 @@ def _crop(ctx, ins, attrs):
     offs = x(ins, "Offsets")
     offsets = list(offs.ravel()) if offs is not None \
         else (attrs["offsets"] or [0] * v.ndim)
+    shape = _expand_crop_shape(v, shape, offsets, "crop")
     return out(_crop_common(v, offsets, shape))
 
 
@@ -114,20 +136,11 @@ def _crop_tensor(ctx, ins, attrs):
     shape = _static_ints(st, "crop_tensor Shape") if st is not None \
         else attrs["shape"]
     offs = x(ins, "Offsets")
-    if offs is not None:
-        offsets = list(offs.ravel())
-        static_offs = None if isinstance(offs, jax.core.Tracer) \
-            else [int(o) for o in np.asarray(offs)]
-    else:
-        offsets = attrs["offsets"] or [0] * v.ndim
-        static_offs = offsets
-    if any(s == -1 for s in shape):
-        if static_offs is None:
-            raise NotImplementedError(
-                "crop_tensor: shape -1 entries need compile-time "
-                "offsets (static output shapes on TPU)")
-        shape = [v.shape[i] - static_offs[i] if s == -1 else s
-                 for i, s in enumerate(shape)]
+    offsets = list(offs.ravel()) if offs is not None \
+        else (attrs["offsets"] or [0] * v.ndim)
+    # 0/-1 entries expand to the remaining extent (dim - offset), same
+    # resolution as v1 crop — needs compile-time offsets
+    shape = _expand_crop_shape(v, shape, offsets, "crop_tensor")
     return out(_crop_common(v, offsets, shape))
 
 
@@ -186,8 +199,11 @@ def _shuffle_batch(ctx, ins, attrs):
         if sd is not None \
         else jnp.int32(attrs.get("startup_seed", 0))
     perm = jax.random.permutation(jax.random.PRNGKey(seed), v.shape[0])
-    return {"Out": [v[perm]], "ShuffleIdx": [perm.astype(jnp.int64)],
-            "SeedOut": [(seed.astype(jnp.int64) + 1).reshape(1)]}
+    # int32 on purpose (ADVICE): without jax_enable_x64 an int64
+    # request silently truncates to int32 with a per-call UserWarning;
+    # the dense design controls both producer and consumer
+    return {"Out": [v[perm]], "ShuffleIdx": [perm.astype(jnp.int32)],
+            "SeedOut": [(seed + 1).astype(jnp.int32).reshape(1)]}
 
 
 # shuffle_batch's backward (un-permute by ShuffleIdx, reference
@@ -325,8 +341,10 @@ def _cross_entropy2(ctx, ins, attrs):
     match = jnp.take_along_axis(p, li[:, None], axis=1)
     loss = jnp.where(li[:, None] == ig, 0.0,
                      -jnp.log(jnp.maximum(match, 1e-20)))
+    # shape metadata as int32 (ADVICE: jnp int64 truncates + warns
+    # without x64; shapes here are far below 2**31)
     return {"Y": [loss], "MatchX": [match],
-            "XShape": [jnp.asarray(p.shape, jnp.int64)]}
+            "XShape": [jnp.asarray(p.shape, jnp.int32)]}
 
 
 @register("cvm", no_grad_slots=("CVM",), attrs={"use_cvm": True})
@@ -830,9 +848,12 @@ def _sample_logits(ctx, ins, attrs):
                                  int(attrs.get("seed", 0)))
         # log-uniform (Zipf) sampler, the reference's LogUniformSampler
         u = jax.random.uniform(key, (n, s))
-        neg = (jnp.exp(u * jnp.log(float(c + 1))) - 1.0).astype(jnp.int64)
+        # int32 ids (ADVICE: an int64 request without x64 truncates to
+        # int32 anyway, with a UserWarning per call; vocab ids on this
+        # path are far below 2**31)
+        neg = (jnp.exp(u * jnp.log(float(c + 1))) - 1.0).astype(jnp.int32)
         neg = jnp.clip(neg, 0, c - 1)
-        samples = jnp.concatenate([labels.astype(jnp.int64), neg], axis=1)
+        samples = jnp.concatenate([labels.astype(jnp.int32), neg], axis=1)
         p = (jnp.log((samples + 2.0) / (samples + 1.0))
              / jnp.log(float(c + 1)))
         probs = p
@@ -844,12 +865,14 @@ def _sample_logits(ctx, ins, attrs):
         neg_part = samples[:, nt:]
         acc = (neg_part[:, :, None] == labels[:, None, :]).any(-1)
         sl = sl.at[:, nt:].add(jnp.where(acc, -1e20, 0.0))
-    sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int64)[None, :],
+    sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int32)[None, :],
                               (n, 1))
     return {"Samples": [samples], "Probabilities": [probs],
             "SampledLogits": [sl], "SampledLabels": [sampled_labels],
-            "LogitsDim": [jnp.asarray(logits.shape, jnp.int64)],
-            "LabelsDim": [jnp.asarray(labels.shape, jnp.int64)]}
+            # int32 shape metadata (ADVICE: int64 truncates + warns
+            # without jax_enable_x64)
+            "LogitsDim": [jnp.asarray(logits.shape, jnp.int32)],
+            "LabelsDim": [jnp.asarray(labels.shape, jnp.int32)]}
 
 
 # ---------------------------------------------------------------------------

@@ -29,6 +29,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_attention import on_tpu
 
+# jax renamed TPUCompilerParams -> CompilerParams across releases;
+# accept either so the kernel runs on the toolchain actually installed
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 __all__ = ["fused_ffn", "can_use_fused_ffn"]
 
 
@@ -36,16 +41,49 @@ def _interpret() -> bool:
     return not on_tpu()
 
 
-def can_use_fused_ffn(m: int, h: int, i: int) -> bool:
+def _vmem_budget() -> int:
+    return int(os.environ.get("PADDLE_TPU_FFN_VMEM_BUDGET",
+                              14 * (1 << 20)))
+
+
+def _pick_blocks(m: int, h: int, i: int,
+                 itemsize: int) -> tuple[int, int] | None:
+    """Largest (bm, bi) whose VMEM working set fits the budget: the f32
+    (bm, h) accumulator scratch plus the double-buffered x/W1/b1/W2/b2/
+    out blocks. Scaling bm (and bi) down with h is what keeps large-h
+    models on the fused path instead of failing Mosaic compilation at
+    runtime (ADVICE: ~16 MiB usable VMEM on v5e; 8 MiB scratch alone at
+    bm=512/h=4096)."""
+    budget = _vmem_budget()
+    for bm in (512, 256, 128):
+        if m % bm:
+            continue
+        for bi in (512, 256, 128):
+            if i % bi:
+                continue
+            scratch = bm * h * 4
+            blocks = 2 * itemsize * (bm * h      # x block
+                                     + h * bi + bi   # W1, b1
+                                     + bi * h + h    # W2, b2
+                                     + bm * h)       # out block
+            if scratch + blocks <= budget:
+                return bm, bi
+    return None
+
+
+def can_use_fused_ffn(m: int, h: int, i: int, itemsize: int = 4) -> bool:
     if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
         return False
     if os.environ.get("PADDLE_TPU_DISABLE_FFN_FUSION"):
         return False
     if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
         return False
-    # MXU-aligned shapes only; fall back to the XLA chain otherwise
+    # MXU-aligned shapes that fit the VMEM budget; fall back to the XLA
+    # chain otherwise (callers pass the activation itemsize — bf16
+    # fits shapes f32 cannot)
     return (m % 256 == 0 and h % 128 == 0 and i % 512 == 0
-            and h <= 4096)
+            and h <= 4096
+            and _pick_blocks(m, h, i, itemsize) is not None)
 
 
 def _erf_poly(z):
@@ -109,7 +147,7 @@ def _ffn_fwd_impl(x2, w1, b1, w2, b2, act_name, bm, bi):
         out_specs=pl.BlockSpec((bm, h), lambda mi, ji: (mi, 0)),
         out_shape=jax.ShapeDtypeStruct((m, h), x2.dtype),
         scratch_shapes=[pltpu.VMEM((bm, h), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(x2, w1, b1.reshape(1, i), w2, b2.reshape(1, h))
@@ -126,9 +164,15 @@ def _fused_ffn_fwd(x, w1, b1, w2, b2, act_name):
     h = shape[-1]
     x2 = x.reshape(-1, h)
     m = x2.shape[0]
-    bm = 512 if m % 512 == 0 else 256
-    bi = 512
-    y = _ffn_fwd_impl(x2, w1, b1, w2, b2, act_name, bm, bi)
+    i = w1.shape[1]
+    blocks = _pick_blocks(m, h, i, x.dtype.itemsize)
+    if blocks is None:
+        # no block shape fits VMEM (or m isn't block-aligned): run the
+        # composed XLA chain rather than fail Mosaic compilation
+        hid = _ACTS[act_name](x2 @ w1 + b1)
+        y = (hid.astype(x.dtype) @ w2 + b2).astype(x.dtype)
+    else:
+        y = _ffn_fwd_impl(x2, w1, b1, w2, b2, act_name, *blocks)
     return y.reshape(shape), (x, w1, b1, w2, b2)
 
 

@@ -1,0 +1,30 @@
+"""Killable PS server for the fault-tolerance tests.
+
+Env: PS_ENDPOINT (required), PADDLE_PS_SNAPSHOT_DIR/_EVERY (snapshot
+tier), any PADDLE_PS_FAULT_* (e.g. KILL_AFTER to die mid-run with
+fault_injection.KILL_EXIT_CODE). Restore from an existing snapshot is
+automatic (PSServer auto_restore). Prints one READY JSON line, then
+serves until killed.
+"""
+import json
+import os
+
+os.environ.setdefault("PADDLE_TPU_DISABLE_NATIVE", "1")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+    import PSServer  # noqa: E402
+
+
+def main():
+    server = PSServer(os.environ["PS_ENDPOINT"])
+    restored = bool(server.snapshot_dir
+                    and server.tables)  # auto_restore already ran
+    print(json.dumps({"endpoint": server.endpoint,
+                      "restored": restored,
+                      "pid": os.getpid()}), flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -220,3 +220,106 @@ def test_while_loop_side_effect_body_skips_masked_scan(fresh_programs):
     dirty = build(True)
     assert int(dirty.attr("max_trip_count")) == 0, \
         dirty.attr("max_trip_count")
+
+
+def test_ffn_vmem_gate_scales_blocks_with_h(monkeypatch):
+    """ADVICE medium: can_use_fused_ffn admitted h=4096 shapes whose
+    VMEM working set exceeds ~16 MiB on v5e — now the gate sizes (bm,
+    bi) under a byte budget and rejects what cannot fit, so large-h
+    models take the XLA chain instead of failing Mosaic compilation."""
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    from paddle_tpu.ops.pallas_ffn import _pick_blocks, can_use_fused_ffn
+
+    # the validated small shape still runs fused
+    assert can_use_fused_ffn(256, 128, 512)
+    # f32 at h=4096 cannot fit any block shape -> gate rejects
+    assert not can_use_fused_ffn(512, 4096, 16384, itemsize=4)
+    assert _pick_blocks(512, 4096, 16384, 4) is None
+    # bf16 at h=4096 fits a scaled-down block -> gate admits
+    assert can_use_fused_ffn(512, 4096, 16384, itemsize=2)
+    bm, bi = _pick_blocks(512, 4096, 16384, 2)
+    assert bm < 512, "bm must scale down with h"
+    # chosen blocks respect the budget: f32 scratch + double-buffered
+    # operand/out blocks
+    budget = 14 * (1 << 20)
+    assert bm * 4096 * 4 + 2 * 2 * (2 * bm * 4096 + 2 * bi * 4096
+                                    + bi + 4096) <= budget
+
+
+def test_ffn_oversize_falls_back_to_chain_not_crash(monkeypatch):
+    """fused_ffn called directly on a shape the VMEM budget rejects
+    must compute via the XLA chain (same numerics), not die in Mosaic."""
+    import jax
+    import jax.numpy as jnp
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("PADDLE_TPU_FFN_VMEM_BUDGET", "65536")  # tiny
+    from paddle_tpu.ops.pallas_ffn import fused_ffn
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(256, 128).astype("float32"))
+    w1 = jnp.asarray((rng.randn(128, 512) * 0.05).astype("float32"))
+    b1 = jnp.asarray(rng.randn(512).astype("float32") * 0.1)
+    w2 = jnp.asarray((rng.randn(512, 128) * 0.05).astype("float32"))
+    b2 = jnp.asarray(rng.randn(128).astype("float32") * 0.1)
+    y = fused_ffn(x, w1, b1, w2, b2)
+    ref = jax.nn.gelu(x @ w1 + b1, approximate=False) @ w2 + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_shape_metadata_outputs_are_int32_without_warnings():
+    """ADVICE low: LogitsDim/LabelsDim, cross_entropy2 XShape and
+    shuffle_batch ShuffleIdx/SeedOut asked for int64 and silently
+    truncated to int32 with a UserWarning per call — they now emit
+    int32 explicitly."""
+    import warnings
+    from op_test import run_eager
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "error", message=".*truncated to dtype int32.*")
+        r = run_eager("shuffle_batch",
+                      {"X": np.arange(12, dtype="float32").reshape(6, 2)},
+                      {"startup_seed": 5})
+        assert np.asarray(r["ShuffleIdx"][0]).dtype == np.int32
+        assert np.asarray(r["SeedOut"][0]).dtype == np.int32
+
+        p = np.full((3, 4), 0.25, "float32")
+        lab = np.array([[0], [1], [2]], "int64")
+        r = run_eager("cross_entropy2", {"X": p, "Label": lab}, {})
+        xshape = np.asarray(r["XShape"][0])
+        assert xshape.dtype == np.int32
+        np.testing.assert_array_equal(xshape, [3, 4])
+
+        logits = np.random.RandomState(0).randn(4, 16).astype("float32")
+        labels = np.array([[1], [3], [5], [7]], "int64")
+        r = run_eager("sample_logits",
+                      {"Logits": logits, "Labels": labels},
+                      {"num_samples": 2})
+        assert np.asarray(r["LogitsDim"][0]).dtype == np.int32
+        assert np.asarray(r["LabelsDim"][0]).dtype == np.int32
+        np.testing.assert_array_equal(np.asarray(r["LogitsDim"][0]),
+                                      [4, 16])
+
+
+def test_crop_zero_shape_entry_respects_offset():
+    """ADVICE low: v1 crop expanded shape entries 0/-1 to the FULL
+    input dim regardless of offset; dynamic_slice then clamped the
+    start and returned a silently shifted window. 0/-1 now means the
+    remaining extent (dim - offset)."""
+    from op_test import run_eager
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    r = run_eager("crop", {"X": x}, {"offsets": [1, 2], "shape": [2, 0]})
+    np.testing.assert_array_equal(np.asarray(r["Out"][0]), x[1:3, 2:6])
+    r = run_eager("crop", {"X": x}, {"offsets": [1, 2], "shape": [-1, 3]})
+    np.testing.assert_array_equal(np.asarray(r["Out"][0]), x[1:4, 2:5])
+    # zero offset keeps the old full-dim meaning
+    r = run_eager("crop", {"X": x}, {"offsets": [0, 0], "shape": [0, 0]})
+    np.testing.assert_array_equal(np.asarray(r["Out"][0]), x)
+    # crop_tensor: 0 keeps its keep-dim meaning (offset-adjusted), and
+    # -1 still infers the remaining extent
+    r = run_eager("crop_tensor", {"X": x},
+                  {"offsets": [0, 0], "shape": [0, 3]})
+    np.testing.assert_array_equal(np.asarray(r["Out"][0]), x[:, :3])
+    r = run_eager("crop_tensor", {"X": x},
+                  {"offsets": [1, 2], "shape": [0, -1]})
+    np.testing.assert_array_equal(np.asarray(r["Out"][0]), x[1:4, 2:])

@@ -80,6 +80,68 @@ def test_launcher_gives_up_after_max_restarts(tmp_path):
     assert res.stderr.count("elastic restart") == 1
 
 
+def test_launcher_respawns_dead_ps_server_alone(tmp_path):
+    """PS-mode graceful degradation: a PS server that dies mid-run is
+    respawned ALONE from its snapshot (workers ride the outage on their
+    transport retry loop) — no whole-job restart."""
+    import socket as socketmod
+    ports = []
+    for _ in range(2):
+        s = socketmod.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    script = tmp_path / "ps_job.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np\n"
+        "role = os.environ['TRAINING_ROLE']\n"
+        "if role == 'PSERVER':\n"
+        "    snap = os.environ['PADDLE_PS_SNAPSHOT_DIR']\n"
+        "    if not os.path.exists(snap) or not os.listdir(snap):\n"
+        "        # first life: arm the kill switch; the respawned life\n"
+        "        # finds a snapshot and serves normally\n"
+        "        os.environ['PADDLE_PS_FAULT_KILL_AFTER'] = '25'\n"
+        "        os.environ['PADDLE_PS_FAULT_KILL_POINT'] = 'reply'\n"
+        "    from paddle_tpu.distributed.fleet.runtime."
+        "parameter_server_runtime import PSServer\n"
+        "    PSServer(os.environ['PADDLE_CURRENT_ENDPOINT'])"
+        ".serve_forever()\n"
+        "else:\n"
+        "    from paddle_tpu.distributed.fleet.runtime."
+        "parameter_server_runtime import PSClient\n"
+        "    eps = os.environ['PADDLE_PSERVERS_IP_PORT_LIST']"
+        ".split(',')\n"
+        "    cl = PSClient(eps, backoff=0.02, deadline=120.0)\n"
+        "    base = cl.pull('t', 4, [0]).copy()\n"
+        "    for k in range(60):\n"
+        "        cl.push('t', 4, [0], np.ones((1, 4)), lr=1.0)\n"
+        "    final = cl.pull('t', 4, [0])\n"
+        "    np.testing.assert_allclose(base - final, 60.0, rtol=1e-6)\n"
+        "    assert cl.stats.retries > 0, cl.stats.as_dict()\n"
+        "    print('PS WORKER OK', flush=True)\n")
+    env = _env()
+    env["PADDLE_TPU_DISABLE_NATIVE"] = "1"
+    env["PADDLE_PS_SNAPSHOT_EVERY"] = "1"
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         f"--servers=127.0.0.1:{ports[0]}",
+         f"--workers=127.0.0.1:{ports[1]}",
+         "--max_restarts=2",
+         "--ps_snapshot_dir", str(tmp_path / "snap"),
+         "--ps_snapshot_every=1",
+         "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (res.stderr, res.stdout)
+    assert "restarting it from snapshot" in res.stderr, res.stderr
+    assert "elastic restart" not in res.stderr  # no whole-job restart
+    logs = ""
+    for f in sorted(os.listdir(tmp_path / "logs")):
+        logs += open(tmp_path / "logs" / f).read()
+    assert "PS WORKER OK" in logs
+
+
 def test_launcher_kills_hung_rank_via_heartbeat(tmp_path):
     """A rank that starts a heartbeat then hangs (stops beating) is
     detected and the job restarted; second life completes."""
