@@ -610,6 +610,58 @@ def bench_widedeep(batch=4096, steps=20, warmup=3):
     return rec
 
 
+def bench_serving(num_requests=48, num_slots=8, hidden=512, layers=8,
+                  heads=8, max_new=64, seed=0):
+    """Offline serving throughput through paddle_tpu.serving: a fixed
+    request mix (prompt lens 16..192, outputs 16..max_new) continuously
+    batched over the paged KV cache. Reports end-to-end tokens/sec
+    (prefill+decode, compile EXCLUDED via a warmup mix that touches
+    every bucket), p50/p99 request latency at that offered load, page
+    occupancy and the compile-per-bucket counters."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, GPTDecodeModel
+
+    cfg = GPTConfig(hidden_size=hidden, num_layers=layers, num_heads=heads,
+                    max_position_embeddings=512, vocab_size=8192)
+    model = GPTDecodeModel(cfg, seed=seed)
+    eng = Engine(model, num_slots=num_slots, num_pages=256, page_size=16,
+                 max_seq_len=448)
+    rng = np.random.RandomState(seed)
+
+    def mix(n):
+        out = []
+        for _ in range(n):
+            plen = int(rng.choice([16, 31, 64, 100, 128, 192]))
+            mnt = int(rng.choice([16, 32, max_new]))
+            out.append((rng.randint(0, cfg.vocab_size, (plen,)), mnt))
+        return out
+
+    # warmup: one prompt per length choice so EVERY prefill bucket (and
+    # the decode program) compiles before the timed window — a random
+    # warmup mix can miss a bucket and charge its XLA compile to the
+    # measurement
+    for plen in (16, 31, 64, 100, 128, 192):
+        eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), 16)
+    eng.run_until_idle()
+    reqs = [eng.submit(p, m) for p, m in mix(num_requests)]
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    dt = time.perf_counter() - t0
+    ntok = sum(len(r.generated) for r in reqs)
+    lats = sorted(r.latency() for r in reqs)
+    st = eng.stats()
+    return {"metric": "serving_decode_tokens_per_sec",
+            "value": round(ntok / dt, 1), "unit": "tokens/sec",
+            "requests": num_requests, "slots": num_slots,
+            "model": f"gpt-h{hidden}-l{layers}",
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 1),
+            "p99_ms": round(lats[min(len(lats) - 1,
+                                     int(0.99 * len(lats)))] * 1e3, 1),
+            "compiles": st["compiles"],
+            "preemptions": st["preemptions"],
+            "pool_pages": st["pool"]["num_pages"]}
+
+
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
     predictor parity path): save -> load -> timed ZeroCopyRun.
@@ -730,6 +782,8 @@ def main():
         rec = bench_widedeep()
     elif which == "infer":
         rec = bench_infer_latency()
+    elif which == "serving":
+        rec = bench_serving()
     elif which == "gpt_1p3b":
         rec = bench_gpt_1p3b()
     else:
@@ -759,6 +813,10 @@ def main():
                 ("infer_latency",
                  lambda: bench_infer_latency(steps=15, warmup=3),
                  lambda: bench_infer_latency(steps=5, warmup=1)),
+                ("serving",
+                 lambda: bench_serving(),
+                 lambda: bench_serving(num_requests=12, hidden=256,
+                                       layers=4, heads=4, max_new=32)),
                 ("flash_attn", bench_flash_attn,
                  lambda: bench_flash_attn(steps=6, warmup=1)),
                 ("resnet50",

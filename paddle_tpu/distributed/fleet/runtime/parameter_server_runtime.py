@@ -335,6 +335,8 @@ class PSServer(socketserver.ThreadingTCPServer):
         self.snapshot_interval = snapshot_interval \
             if snapshot_interval is not None \
             else float(env("PADDLE_PS_SNAPSHOT_INTERVAL", "0") or 0)
+        self.snapshot_compact_every = int(
+            env("PADDLE_PS_SNAPSHOT_COMPACT_EVERY", "64") or 0)
         if fs is None:
             from ....distributed.fs import LocalFS
             fs = LocalFS()
@@ -349,13 +351,25 @@ class PSServer(socketserver.ThreadingTCPServer):
         # inside a push's commit scope.
         self._apply_lock = threading.RLock()
         self._snap_seq = 0       # exports, monotone (under apply lock)
-        self._snap_written = 0   # newest seq on disk (under io lock)
+        self._snap_written = 0   # newest BASE seq on disk (under io lock)
         self._mutations = 0
+        # dirty-table tracking (ROADMAP open item: write-through
+        # snapshots were O(all-table bytes) per push): pushes mark their
+        # table dirty; a snapshot exports ONLY dirty tables into a delta
+        # file unless a full base is due (first snapshot / compaction)
+        self._dirty: set[str] = set()
+        self._base_written = False
+        self._deltas_since_base = 0
+        self._last_export_mutations = -1
+        self._snap_pending = False   # a DUE snapshot failed; retry owes it
         self.snapshots_taken = 0
+        self.full_snapshots = 0
+        self.delta_snapshots = 0
         self._rpc = RpcServerState(read_ops=self.READ_OPS,
                                    secret=secret,
                                    after_commit=self._after_commit,
-                                   commit_scope=self._commit_scope)
+                                   commit_scope=self._commit_scope,
+                                   after_retry=self._after_retry)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -368,6 +382,7 @@ class PSServer(socketserver.ThreadingTCPServer):
         if auto_restore and self.snapshot_dir \
                 and self._fs.is_file(self.snapshot_path):
             self.load_snapshot()
+            self._base_written = True
         self._snap_stop = threading.Event()
         if self.snapshot_dir and self.snapshot_interval > 0:
             threading.Thread(target=self._snapshot_loop,
@@ -399,47 +414,153 @@ class PSServer(socketserver.ThreadingTCPServer):
         if due:
             self.snapshot()
 
+    def _after_retry(self, op: str):
+        """Dedup-hit retry of a mutating op: the original after_commit
+        may have died mid-snapshot (failed export/write re-merged the
+        dirty marks and raised before the reply). Finish that owed
+        persistence WITHOUT counting a new mutation. Keyed on the
+        explicit failure flag — a merely-dirty table under a stride/
+        interval policy (snapshot_every=N>1) is NOT owed a snapshot,
+        so flaky-link retries cannot degrade N-stride configs to
+        write-through IO."""
+        if op not in self._SNAPSHOT_OPS or not self.snapshot_dir:
+            return
+        with self._snap_lock:
+            pending = self._snap_pending
+        if pending:
+            self.snapshot()
+
     def _snapshot_loop(self):
         while not self._snap_stop.wait(self.snapshot_interval):
             self.snapshot()
 
-    def snapshot(self):
+    def _delta_path(self, seq: int) -> str:
+        tag = self.endpoint.replace(":", "_")
+        return os.path.join(self.snapshot_dir,
+                            f"ps_{tag}.delta_{seq:010d}.npz")
+
+    def _delta_files(self) -> list[tuple[int, str]]:
+        """(seq, filename) of every delta on storage, sorted by seq."""
+        tag = self.endpoint.replace(":", "_")
+        prefix, suffix = f"ps_{tag}.delta_", ".npz"
+        _dirs, files = self._fs.ls_dir(self.snapshot_dir)
+        out = []
+        for f in files:
+            if f.startswith(prefix) and f.endswith(suffix):
+                try:
+                    out.append((int(f[len(prefix):-len(suffix)]), f))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def snapshot(self, full: bool | None = None):
         """Consistent table+dedup snapshot. Runs before the mutating
         reply is sent (`after_commit` hook), so a crash between apply
         and reply still resolves to exactly-once: the retried request
         hits the restored dedup set.
 
-        Locking: the EXPORT runs under `_apply_lock` (tables and dedup
-        ids must come from the same instant, or a crash-restore could
-        double-apply or drop a concurrent worker's push); the npz
-        write runs under `_snap_io_lock` only, so concurrent pushes
-        proceed during disk IO. Lock order is always apply -> io (the
-        push-commit path enters here already holding the apply RLock);
-        a sequence number keeps a slow older writer from clobbering a
-        newer snapshot. Cost note: each snapshot serializes all tables
-        + the dedup reply cache — size the stride
-        (PADDLE_PS_SNAPSHOT_EVERY) to the table volume; =1 is the
-        write-through durability mode the exactly-once tests use."""
+        Incremental tier (ROADMAP open item): the first snapshot (and
+        every `snapshot_compact_every`-th thereafter) writes the full
+        base npz; in between, a snapshot writes a DELTA npz holding
+        only the tables dirtied since the previous export, plus the
+        dedup/mutation state, so write-through durability
+        (PADDLE_PS_SNAPSHOT_EVERY=1) costs O(touched-table bytes) per
+        push instead of O(all-table bytes). Restore = base + deltas in
+        sequence order; base writes garbage-collect superseded deltas.
+
+        Locking: the EXPORT runs under `_apply_lock` (tables, dirty
+        set, and dedup ids must come from the same instant, or a
+        crash-restore could double-apply or drop a concurrent worker's
+        push); the npz write runs under `_snap_io_lock` only, so
+        concurrent pushes proceed during disk IO. Lock order is always
+        apply -> io (the push-commit path enters here already holding
+        the apply RLock). A slow older BASE writer is kept from
+        clobbering a newer base by the sequence check; delta files are
+        per-seq, so late writes cannot clobber anything and the
+        seq-ordered replay at load time makes write order irrelevant.
+
+        Known benign race: before the FIRST base write lands on disk,
+        concurrent exporters each see _base_written=False and export a
+        redundant full base (the io-side seq check discards all but
+        the newest). Pure transient startup IO — deciding the base
+        optimistically instead would let a racing DELTA land on disk
+        with no base beneath it, turning a crash in that window into
+        real data loss, so the wasted export is the correct trade."""
         path = self.snapshot_path
         if path is None:
             return
         with self._apply_lock:
-            arrays = self._export_arrays()
+            with self._snap_lock:
+                dirty = set(self._dirty)
+                self._dirty.clear()
+            if full is not True and self._base_written and not dirty \
+                    and self._mutations == self._last_export_mutations:
+                # nothing changed since the last export: an idle server
+                # on a snapshot_interval timer must not churn empty
+                # deltas (or periodic full bases) forever
+                return
             self._snap_seq += 1
             seq = self._snap_seq
+            try:
+                do_full = full if full is not None else (
+                    not self._base_written
+                    or (self.snapshot_compact_every
+                        and self._deltas_since_base
+                        >= self.snapshot_compact_every))
+                arrays = self._export_arrays(
+                    seq, names=None if do_full else dirty,
+                    kind="base" if do_full else "delta")
+                self._last_export_mutations = self._mutations
+            except BaseException:
+                with self._snap_lock:
+                    self._dirty |= dirty
+                    self._snap_pending = True
+                raise
+        try:
+            self._write_snapshot_files(path, arrays, seq, do_full)
+        except BaseException:
+            # the dirty marks were consumed by this export; a failed
+            # export/write must put them back (and flag the owed
+            # snapshot for the retry hook) or every later delta would
+            # silently omit these tables until the next full base
+            with self._snap_lock:
+                self._dirty |= dirty
+                self._snap_pending = True
+            raise
+        with self._snap_lock:
+            self._snap_pending = False
+
+    def _write_snapshot_files(self, path, arrays, seq, do_full):
         with self._snap_io_lock:
-            if seq <= self._snap_written:
-                return  # a newer export already reached disk
-            self._write_snapshot(path, arrays)
-            self._snap_written = seq
+            if do_full:
+                if seq <= self._snap_written:
+                    # a newer base already reached disk; our dirty set
+                    # is covered by it (exported later = superset state)
+                    return
+                self._write_snapshot(path, arrays)
+                self._snap_written = seq
+                self._base_written = True
+                self._deltas_since_base = 0
+                self.full_snapshots += 1
+                for dseq, fname in self._delta_files():
+                    if dseq <= seq:
+                        self._fs.delete(
+                            os.path.join(self.snapshot_dir, fname))
+            else:
+                self._write_snapshot(self._delta_path(seq), arrays)
+                self._deltas_since_base += 1
+                self.delta_snapshots += 1
             self.snapshots_taken += 1
 
-    def _export_arrays(self) -> dict:
+    def _export_arrays(self, seq: int = 0, names: set | None = None,
+                       kind: str = "base") -> dict:
         arrays: dict[str, np.ndarray] = {}
-        meta = {"version": 1, "endpoint": self.endpoint,
+        meta = {"version": 2, "kind": kind, "seq": seq,
+                "endpoint": self.endpoint,
                 "mutations": self._mutations, "tables": {}}
         with self._tables_lock:
-            items = list(self.tables.items())
+            items = [(n, t) for n, t in self.tables.items()
+                     if names is None or n in names]
         for name, t in items:
             st = t.export_state()
             tmeta = {"dim": st["dim"], "init_std": st["init_std"],
@@ -489,8 +610,26 @@ class PSServer(socketserver.ThreadingTCPServer):
                 os.unlink(local)
 
     def load_snapshot(self, path: str | None = None):
+        """Restore base + every delta with a newer sequence number, in
+        sequence order (each delta replaces the tables it names and the
+        full dedup/mutation state it captured — last write wins)."""
+        base_meta = self._load_one(path or self.snapshot_path,
+                                   replace=True)
+        last_seq = int(base_meta.get("seq", 0))
+        if self.snapshot_dir:
+            for dseq, fname in self._delta_files():
+                if dseq <= last_seq:
+                    continue
+                self._load_one(os.path.join(self.snapshot_dir, fname),
+                               replace=False)
+                last_seq = dseq
+        with self._apply_lock:
+            self._snap_seq = max(self._snap_seq, last_seq)
+        self._snap_written = max(self._snap_written,
+                                 int(base_meta.get("seq", 0)))
+
+    def _load_one(self, path: str, replace: bool) -> dict:
         from ....distributed.fs import LocalFS
-        path = path or self.snapshot_path
         local = path
         staged = None
         if not isinstance(self._fs, LocalFS):
@@ -501,12 +640,12 @@ class PSServer(socketserver.ThreadingTCPServer):
             self._fs.download(path, staged)
             local = staged
         try:
-            self._load_snapshot_file(local)
+            return self._load_snapshot_file(local, replace)
         finally:
             if staged and os.path.exists(staged):
                 os.unlink(staged)
 
-    def _load_snapshot_file(self, path: str):
+    def _load_snapshot_file(self, path: str, replace: bool = True) -> dict:
         with np.load(path, allow_pickle=False) as blob:
             meta = json.loads(bytes(blob["meta"]).decode("utf-8"))
             tables: dict[str, LargeScaleKV] = {}
@@ -532,10 +671,14 @@ class PSServer(socketserver.ThreadingTCPServer):
                 blobs.append(raw[off:off + n])
                 off += n
         with self._tables_lock:
-            self.tables = tables
+            if replace:
+                self.tables = tables
+            else:
+                self.tables.update(tables)
         self._rpc.dedup.import_(ids, blobs)
         with self._snap_lock:
             self._mutations = int(meta.get("mutations", 0))
+        return meta
 
     @classmethod
     def restart_from_snapshot(cls, endpoint: str, snapshot_dir: str,
@@ -557,15 +700,28 @@ class PSServer(socketserver.ThreadingTCPServer):
                 self.tables[name] = LargeScaleKV(dim, init_std=init_std)
             return self.tables[name]
 
+    def _mark_dirty(self, name: str):
+        with self._snap_lock:
+            self._dirty.add(name)
+
     def _dispatch(self, req: dict):
         op = req["op"]
         if op == "pull":
-            return self.table(req["table"], req["dim"],
-                              req.get("init_std", 0.01)).pull(req["keys"])
+            t = self.table(req["table"], req["dim"],
+                           req.get("init_std", 0.01))
+            n0 = t.size()
+            out = t.pull(req["keys"])
+            if self.snapshot_dir and t.size() != n0:
+                # lazy row init consumed the table's rng stream — the
+                # next delta must carry this table even without a push
+                self._mark_dirty(req["table"])
+            return out
         if op == "push":
             self.table(req["table"], req["dim"],
                        req.get("init_std", 0.01)).push(
                 req["keys"], req["grads"], req.get("lr", 1.0))
+            if self.snapshot_dir:
+                self._mark_dirty(req["table"])
             return True
         if op == "save":
             tag = self.endpoint.replace(":", "_")
@@ -590,6 +746,10 @@ class PSServer(socketserver.ThreadingTCPServer):
                     # full-batch step when each trainer computes the mean
                     # loss of its batch shard
                     self.table(table, dim).push(keys, grads, lr / n)
+                    if self.snapshot_dir:
+                        # sync-mode mutation: the post-barrier delta
+                        # snapshot must carry these tables too
+                        self._mark_dirty(table)
             return self._sync_state(req["trainers"]).send_barrier(
                 req["worker"], apply_fn)
         if op == "fetch_barrier":

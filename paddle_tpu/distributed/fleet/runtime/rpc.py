@@ -579,11 +579,17 @@ class RpcServerState:
 
     def __init__(self, read_ops=frozenset(), secret: str | None = None,
                  dedup_capacity: int = 65536, after_commit=None,
-                 commit_scope=None):
+                 commit_scope=None, after_retry=None):
         self.read_ops = frozenset(read_ops)
         self.secret = secret if secret is not None \
             else os.environ.get("PADDLE_PS_SECRET")
         self.dedup = DedupCache(dedup_capacity)
+        # called with the op name when a MUTATING request is answered
+        # from the dedup cache (client retry): the original dispatch may
+        # have died between commit and its after_commit side effect, so
+        # this is the hook's chance to finish pending persistence. It
+        # must be idempotent and must NOT count a new mutation.
+        self.after_retry = after_retry
         # called with the op name after a mutating op was dispatched and
         # its dedup entry recorded, BEFORE the reply is sent — the
         # snapshot hook runs here so a post-snapshot crash still yields
@@ -615,6 +621,8 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
             if mutating and req_id:
                 cached = state.dedup.begin(req_id)
                 if cached is not _FRESH:
+                    if state.after_retry is not None:
+                        state.after_retry(op)
                     if inj.active:
                         inj.maybe_kill("reply", armed)
                     send_frame(sock, cached, req_id=req_id,

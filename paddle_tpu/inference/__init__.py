@@ -12,6 +12,7 @@ as device arrays in a private scope.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -135,6 +136,12 @@ class Predictor:
         load_quantized_weights(config.model_dir(), self._scope)
         self._inputs = {n: PredictorTensor(n) for n in self._feed_names}
         self._outputs = {n: PredictorTensor(n) for n in self._fetch_names}
+        # one predictor, many threads: the handle tensors are shared
+        # mutable state, so run() (set inputs -> execute -> set outputs)
+        # must be atomic or two concurrent callers interleave buffers
+        # (reference semantics: one ZeroCopy predictor per thread, but a
+        # lock is cheaper than a clone and the jit cache is shared)
+        self._run_lock = threading.RLock()
         if config._use_bf16:
             # real bf16 inference: params live in HBM as bf16, matmuls hit
             # the MXU at full rate; outputs are cast back to fp32 in run()
@@ -165,7 +172,16 @@ class Predictor:
 
     def run(self, inputs: Sequence[np.ndarray] | None = None):
         """Positional-inputs convenience (returns list of np arrays) or
-        handle-style (copy_from_cpu then run() with no args)."""
+        handle-style (copy_from_cpu then run() with no args).
+
+        Thread-safe: concurrent run() calls serialize on an internal
+        lock (handle-style callers that copy_from_cpu OUTSIDE run()
+        from several threads still race by construction — use
+        positional inputs or one predictor per thread for that)."""
+        with self._run_lock:
+            return self._run_locked(inputs)
+
+    def _run_locked(self, inputs):
         from ..fluid.scope import scope_guard
         if inputs is not None:
             if len(inputs) != len(self._feed_names):

@@ -206,11 +206,14 @@ def _causal_attention(q, k, v, n_heads, impl="xla"):
                            causal=True)
         return o.transpose(0, 2, 1, 3).reshape(B, T, D)
     if impl == "flash":
+        from ..ops.flash_attention import _flash_wins
         from ..ops.pallas_attention import flash_attention
-        o = flash_attention(q.transpose(0, 2, 1, 3),
-                            k.transpose(0, 2, 1, 3),
-                            v.transpose(0, 2, 1, 3), causal=True)
-        return o.transpose(0, 2, 1, 3).reshape(B, T, D)
+        qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+        # same measure-once gate as the fused_attention op: the Pallas
+        # kernel only keeps the hot path on shapes where it beats XLA
+        if _flash_wins(qh, kh, vh, None, None, True):
+            o = flash_attention(qh, kh, vh, causal=True)
+            return o.transpose(0, 2, 1, 3).reshape(B, T, D)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
     mask = jnp.tril(jnp.ones((T, T), bool))
     scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)

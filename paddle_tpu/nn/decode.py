@@ -15,9 +15,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["beam_search"]
+__all__ = ["beam_search", "greedy_decode"]
 
 _NEG = -1e9
+
+
+def greedy_decode(logits_fn: Callable, prompt, max_new_tokens: int,
+                  eos_id: int | None = None):
+    """Reference sequential greedy decode: full-context recompute each
+    step, argmax, stop on EOS/max_new_tokens.
+
+    logits_fn(ids [1, T] int32) -> logits [1, T, V]. O(T^2) per token —
+    this is the CORRECTNESS oracle the serving tier's paged-KV decode
+    (paddle_tpu.serving) is tested token-for-token against, and a
+    dependency-free decode for scripts that don't need a KV cache.
+
+    Returns the generated tokens as a python list (prompt excluded).
+    """
+    ids = np.asarray(prompt, np.int32).reshape(1, -1)
+    out: list[int] = []
+    for _ in range(max_new_tokens):
+        logits = logits_fn(jnp.asarray(ids))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        if eos_id is not None and tok == eos_id:
+            break
+        ids = np.concatenate([ids, [[tok]]], axis=1)
+    return out
 
 
 def beam_search(step_fn: Callable, batch_size: int, beam_size: int,

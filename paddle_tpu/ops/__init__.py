@@ -9,5 +9,7 @@ reference implementation when Pallas is unavailable (CPU tests).
 from . import flash_attention  # noqa: F401
 from . import pallas_attention  # noqa: F401
 from . import pallas_layer_norm  # noqa: F401
+from . import paged_attention  # noqa: F401
 
-__all__ = ["flash_attention", "pallas_attention", "pallas_layer_norm"]
+__all__ = ["flash_attention", "pallas_attention", "pallas_layer_norm",
+           "paged_attention"]

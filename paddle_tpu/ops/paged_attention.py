@@ -1,0 +1,207 @@
+"""Ragged paged-attention decode (PAPERS.md: Ragged Paged Attention).
+
+The serving tier stores each request's KV history in fixed-size pages of
+a preallocated HBM pool; decode computes one new token per in-flight
+request ("slot") against its own ragged-length history. Two
+implementations behind one function:
+
+  * gather-based XLA: k_pages[page_table] gathers each slot's pages into
+    a [S, M*ps] context, masked past ctx_len — one fused XLA computation,
+    the portable default;
+  * a Pallas TPU kernel: grid (slot, page), page indices scalar-prefetched
+    so each program DMAs exactly one page from HBM, online-softmax
+    accumulation in VMEM scratch — the TPU-native shape of the kernel
+    (same design as the stock ragged-paged-attention kernels).
+
+Selection runs through ops/autobench.prefer — the same measure-once gate
+that arbitrates Pallas-vs-XLA flash attention — so the hand kernel only
+holds the hot path on shapes where it measures faster.
+
+Layouts:
+  q          [S, H, d]        one query token per slot
+  k/v_pages  [P, ps, H, d]    the page pools
+  page_table [S, M] int32     pool index of each slot's m-th page
+  ctx_lens   [S] int32        valid history length per slot (>= 1)
+Returns     [S, H, d]
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..fluid.registry import register, same_shape_as
+from ..fluid.ops.common import x
+from .pallas_attention import on_tpu
+
+__all__ = ["paged_attention_decode", "paged_attention_xla",
+           "paged_attention_pallas"]
+
+_NEG = -1e30
+
+
+def paged_attention_xla(q, k_pages, v_pages, page_table, ctx_lens,
+                        scale=None):
+    """Gather-based reference path; fully fused by XLA."""
+    S, H, d = q.shape
+    ps = k_pages.shape[1]
+    M = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    k = k_pages[page_table].reshape(S, M * ps, H, d)
+    v = v_pages[page_table].reshape(S, M * ps, H, d)
+    logits = jnp.einsum("shd,sthd->sht", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(M * ps, dtype=jnp.int32)[None, :]
+    logits = jnp.where(pos[:, None, :] < ctx_lens[:, None, None],
+                       logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("sht,sthd->shd", probs.astype(v.dtype), v)
+    return o.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (slot, page); page_table + ctx_lens scalar-prefetched
+# so the k/v BlockSpec index_map can steer each program's DMA at one page.
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, page_size, scale):
+    s, m = pl.program_id(0), pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(m == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0].astype(jnp.float32)            # [H, d]
+    k = k_ref[0].astype(jnp.float32)            # [ps, H, d]
+    scores = jnp.einsum("hd,phd->hp", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    idx = m * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, 1)
+    scores = jnp.where(idx < len_ref[s], scores, _NEG)
+
+    m_prev = m_ref[...]                          # [H, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, -1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)                  # [H, ps]
+    p = jnp.where(idx < len_ref[s], p, 0.0)      # kill exp(-NEG - -NEG)=1
+    l_ref[...] = alpha * l_ref[...] + jnp.sum(p, -1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)             # [ps, H, d]
+    pv = jnp.einsum("hp,phd->hd", p, v,
+                    preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * alpha + pv
+    m_ref[...] = m_new
+
+    @pl.when(m == n_pages - 1)
+    def _fin():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pages, v_pages, page_table, ctx_lens,
+                           scale=None, interpret=None):
+    S, H, d = q.shape
+    ps = k_pages.shape[1]
+    M = page_table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = not on_tpu()
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, M),
+        in_specs=[
+            pl.BlockSpec((1, H, d), lambda s, m, pt, ln: (s, 0, 0)),
+            pl.BlockSpec((1, ps, H, d),
+                         lambda s, m, pt, ln: (pt[s, m], 0, 0, 0)),
+            pl.BlockSpec((1, ps, H, d),
+                         lambda s, m, pt, ln: (pt[s, m], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, d), lambda s, m, pt, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, d), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, page_size=ps,
+                               scale=float(scale))
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, d), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def _auto_impl(q, k_pages, page_table) -> str:
+    """Measure-once arbitration (TPU only; everywhere else the gathered
+    XLA path is the portable winner and interpret-mode timing would be
+    meaningless)."""
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") or pltpu is None \
+            or not on_tpu():
+        return "xla"
+    from . import autobench
+    S, H, d = q.shape
+    P, ps = k_pages.shape[0], k_pages.shape[1]
+    M = page_table.shape[1]
+    key = ("paged_attention", S, H, d, P, ps, M, str(q.dtype))
+
+    def make_args():
+        import numpy as np
+        rng = np.random.RandomState(0)
+        qq = jnp.asarray(rng.randn(S, H, d), q.dtype)
+        kk = jnp.asarray(rng.randn(P, ps, H, d), q.dtype)
+        vv = jnp.asarray(rng.randn(P, ps, H, d), q.dtype)
+        pt = jnp.asarray(rng.randint(0, P, (S, M)), jnp.int32)
+        ln = jnp.asarray(rng.randint(1, M * ps + 1, (S,)), jnp.int32)
+        return qq, kk, vv, pt, ln
+
+    return autobench.prefer(
+        key,
+        {"xla": paged_attention_xla,
+         "pallas": lambda *a: paged_attention_pallas(*a, interpret=False)},
+        make_args, default="xla")
+
+
+def paged_attention_decode(q, k_pages, v_pages, page_table, ctx_lens,
+                           scale=None, impl=None):
+    """Ragged paged-attention decode; see module docstring for layouts.
+
+    impl: None = auto (XLA everywhere; on TPU the Pallas kernel is
+    auto-benchmarked per shape and used where it wins), or force
+    "xla" / "pallas"."""
+    if impl is None:
+        impl = _auto_impl(q, k_pages, page_table)
+    if impl == "pallas":
+        return paged_attention_pallas(q, k_pages, v_pages, page_table,
+                                      ctx_lens, scale)
+    return paged_attention_xla(q, k_pages, v_pages, page_table, ctx_lens,
+                               scale)
+
+
+@register("paged_attention", grad=None,
+          infer_shape=same_shape_as("Q"),
+          attrs={"scale": 0.0, "impl": ""},
+          no_grad_slots=("PageTable", "CtxLens"))
+def _paged_attention_op(ctx, ins, attrs):
+    """Op form so deserialized/static serving programs can spell the
+    decode step as a graph op (inference-only: grad=None)."""
+    q = x(ins, "Q")
+    o = paged_attention_decode(
+        q, x(ins, "KCache"), x(ins, "VCache"), x(ins, "PageTable"),
+        x(ins, "CtxLens"), scale=attrs.get("scale") or None,
+        impl=attrs.get("impl") or None)
+    return {"Out": [o]}

@@ -1,0 +1,36 @@
+"""jax version compat for shard_map.
+
+`jax.shard_map` (with `axis_names` / `check_vma`) only exists in newer
+jax; this image ships 0.4.37 where the API is
+`jax.experimental.shard_map.shard_map` with `check_rep`. Every manual-
+SPMD call site routes through this wrapper so the parallel tier runs on
+both. On the old API `axis_names` is dropped — the call sites only
+reference their named axis inside the body and leave the other mesh
+axes unmentioned in the specs (replicated), which is exactly the
+semantics full-manual shard_map gives them; `check_vma=False` maps to
+`check_rep=False`.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return new(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   **kw)
+    from jax.experimental.shard_map import shard_map as old
+    # `axis_names` is dropped: the old API's partial-auto spelling
+    # (auto=complement) cannot differentiate through gather/psum on
+    # 0.4.x, while full-manual matches these call sites' semantics —
+    # each body only references its named axis and leaves the others
+    # unmentioned in the specs (replicated)
+    return old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=bool(check_vma))

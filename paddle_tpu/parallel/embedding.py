@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ._compat import shard_map as _shard_map
+
 __all__ = ["ShardedEmbedding", "sharded_embedding_lookup"]
 
 
@@ -45,7 +47,7 @@ def sharded_embedding_lookup(table, ids, mesh: Mesh, axis: str = "mp"):
         rows = jnp.where(hit[..., None], rows, 0)
         return jax.lax.psum(rows, axis)
 
-    return jax.shard_map(
+    return _shard_map(
         spmd, mesh=mesh, in_specs=(P(axis, None), P()), out_specs=P(),
         axis_names=frozenset({axis}), check_vma=False)(table, ids)
 

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._compat import shard_map as _shard_map
+
 __all__ = ["pipeline_apply", "num_ticks"]
 
 
@@ -92,7 +94,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, mb_inputs,
         # caller's slice of [-1] compile to a plain shard read
         return outbuf[None]
 
-    stacked = jax.shard_map(
+    stacked = _shard_map(
         spmd, mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(axis_name),

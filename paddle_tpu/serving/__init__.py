@@ -1,0 +1,36 @@
+"""paddle_tpu.serving — continuous-batching inference with a paged KV
+cache (docs/SERVING.md).
+
+The ROADMAP's serving-side subsystem: the single-request ZeroCopy
+`Predictor` (paddle_tpu.inference) answers one client; this package
+serves MANY — queued requests are continuously batched into a
+fixed-shape decode step over a paged KV cache (Ragged Paged Attention,
+PAPERS.md), with capacity-based admission, deadlines, preemption,
+backpressure and /stats counters.
+
+Quickstart (in-process):
+
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, GPTDecodeModel
+
+    model = GPTDecodeModel(GPTConfig.tiny())
+    with Engine(model, num_slots=8, num_pages=64, page_size=16) as eng:
+        tokens = eng.generate([1, 2, 3], max_new_tokens=16)
+
+Network mode (PS wire format, see serving/frontend.py):
+
+    from paddle_tpu.serving import ServingServer, ServingClient
+    srv = ServingServer(engine).start()          # engine-owned thread
+    out = ServingClient(srv.endpoint).generate([1, 2, 3], 16)
+"""
+from .kv_cache import PagePool, PageTable, defrag_plan, pages_needed
+from .scheduler import QueueFull, Request, Scheduler
+from .model import GPTDecodeModel
+from .engine import Engine
+from .frontend import ServingClient, ServingServer
+
+__all__ = [
+    "PagePool", "PageTable", "pages_needed", "defrag_plan",
+    "Request", "Scheduler", "QueueFull",
+    "GPTDecodeModel", "Engine", "ServingServer", "ServingClient",
+]

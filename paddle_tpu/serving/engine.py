@@ -1,0 +1,321 @@
+"""Serving engine: continuous-batching decode loop over a paged cache.
+
+One Engine = one model + one preallocated page pool + one fixed-shape
+slot batch. Each scheduler iteration (`step()`):
+
+  1. expire deadlines (queued + running; preempted requests free ALL
+     their pages back to the pool immediately);
+  2. admit queued requests into free slots (capacity-gated FIFO), run
+     one jitted PREFILL per admission (prompt KV -> pages, first token);
+  3. run ONE jitted DECODE over the whole slot batch (inactive slots
+     ride along pointed at the trash page) and record each slot's token,
+     evicting on EOS / max_new_tokens.
+
+Compilation contract: decode is one program per (slots, pages) bucket —
+an Engine has exactly one such bucket, so one compile for its lifetime;
+prefill compiles once per prompt-length bucket (page-aligned power-of-
+two padding). `stats()["compiles"]` counts actual traces (the counter
+increments inside the traced function, which only runs at trace time) —
+tests assert at-most-one per bucket.
+
+Threading: `submit()` may be called from any number of frontend threads
+(bounded queue = backpressure); the step loop runs either on the
+caller's thread (`run_until_idle`, deterministic tests) or on the
+engine's own scheduler thread (`start()`).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import defaultdict, deque
+
+import numpy as np
+
+from .kv_cache import PagePool, defrag_plan
+from .scheduler import QueueFull, Request, Scheduler
+
+__all__ = ["Engine", "QueueFull"]
+
+
+def _bucket_len(n: int, page_size: int) -> int:
+    """Smallest page-aligned power-of-two-pages length >= n."""
+    pages = max(1, math.ceil(n / page_size))
+    return page_size * (1 << (pages - 1).bit_length())
+
+
+class Engine:
+    def __init__(self, model, num_slots: int = 8, num_pages: int = 64,
+                 page_size: int = 16, max_seq_len: int | None = None,
+                 eos_id: int | None = None, max_queue: int = 256):
+        import jax
+
+        self.model = model
+        self.eos_id = eos_id
+        self.page_size = page_size
+        self.num_pages = num_pages
+        # the hard sequence ceiling is min(pool capacity, requested cap,
+        # MODEL position limit) — without the model term a request could
+        # decode past wpe and jnp.take would clip instead of erroring,
+        # returning garbage tokens with status "done"
+        model_cap = getattr(model, "max_positions", None)
+        cap = min(max_seq_len or num_pages * page_size,
+                  num_pages * page_size,
+                  model_cap if model_cap else num_pages * page_size)
+        # floor to a page multiple: prefill buckets are page-aligned and
+        # must never pad past the model's position table
+        if cap < page_size:
+            raise ValueError(
+                f"page_size {page_size} exceeds the sequence ceiling "
+                f"{cap} (model/pool/max_seq_len)")
+        self.max_seq_len = (cap // page_size) * page_size
+        self.max_pages_per_req = max(
+            1, min(num_pages, self.max_seq_len // page_size))
+        self.num_slots = num_slots
+        self.pool = PagePool(num_pages, page_size)
+        self.scheduler = Scheduler(self.pool, num_slots, self.max_seq_len,
+                                   max_queue=max_queue)
+        self.trash_page = num_pages      # model pools carry P+1 pages
+        self.cache = model.init_cache(num_pages, page_size)
+
+        self._compiles: dict[str, int] = defaultdict(int)
+        self._latencies: deque[float] = deque(maxlen=4096)
+        self._tok_window: deque[tuple[float, int]] = deque(maxlen=512)
+        self._tokens_total = 0
+        self._steps = 0
+        self._lock = threading.Lock()    # step loop exclusivity
+        self._stats_lock = threading.Lock()  # deque append vs snapshot
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        # donation halves cache HBM on device backends; CPU jit would
+        # only warn about it
+        donate = self._donate = jax.default_backend() != "cpu"
+        S, M = num_slots, self.max_pages_per_req
+        compiles = self._compiles
+
+        def prefill(params, cache, tokens, true_len, page_row):
+            compiles[f"prefill[{tokens.shape[0]}]"] += 1  # trace-time
+            cache, logits = model.prefill(params, cache, tokens,
+                                          true_len, page_row)
+            import jax.numpy as jnp
+            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        def decode(params, cache, tokens, positions, tables):
+            compiles[f"decode[slots={S},pages={M}]"] += 1  # trace-time
+            cache, logits = model.decode(params, cache, tokens,
+                                         positions, tables)
+            import jax.numpy as jnp
+            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+
+        kw = {"donate_argnums": (1,)} if donate else {}
+        self._prefill = jax.jit(prefill, **kw)
+        self._decode = jax.jit(decode, **kw)
+
+    # -- submission (any thread) ---------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 16,
+               deadline: float | None = None,
+               eos_id: int | None = None) -> Request:
+        """Enqueue a request. `deadline` is RELATIVE seconds from now;
+        raises QueueFull (backpressure) when the queue is at capacity."""
+        req = Request(prompt, max_new_tokens,
+                      deadline=None if deadline is None
+                      else time.monotonic() + deadline,
+                      eos_id=eos_id if eos_id is not None else self.eos_id)
+        self.scheduler.submit(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 deadline: float | None = None,
+                 timeout: float | None = 120.0) -> np.ndarray:
+        """Blocking convenience: submit + wait (requires the scheduler
+        thread running, or another thread driving step())."""
+        return self.submit(prompt, max_new_tokens,
+                           deadline=deadline).result(timeout)
+
+    # -- step loop -----------------------------------------------------
+    def _row(self, req: Request | None) -> list[int]:
+        if req is None:
+            return [self.trash_page] * self.max_pages_per_req
+        return req.table.padded(self.max_pages_per_req,
+                                fill=self.trash_page)
+
+    def _run_prefill(self, req: Request):
+        import jax.numpy as jnp
+        T = _bucket_len(req.prompt.size, self.page_size)
+        T = min(T, self.max_pages_per_req * self.page_size)
+        toks = np.zeros((T,), np.int32)
+        toks[:req.prompt.size] = req.prompt
+        self.cache, tok = self._prefill(
+            self.model.params, self.cache, jnp.asarray(toks),
+            np.int32(req.prompt.size), jnp.asarray(self._row(req),
+                                                   dtype=jnp.int32))
+        self._note_tokens(1)
+        if self.scheduler.record_token(req, int(tok)):
+            self._note_done(req)
+
+    def step(self) -> bool:
+        """One scheduler iteration; returns True if any work was done."""
+        import jax.numpy as jnp
+        with self._lock:
+            for r in self.scheduler.expire_deadlines():
+                self._note_done(r)
+            for req in self.scheduler.admit():
+                try:
+                    self._run_prefill(req)
+                except Exception as e:
+                    # a poison request fails ALONE: evict it with its
+                    # pages, keep the engine serving everyone else
+                    req.error = f"prefill failed: {type(e).__name__}: {e}"
+                    self.scheduler.evict(req, "error")
+                    self._note_done(req)
+                    self._recover_cache("failed prefill")
+            active = [(i, r) for i, r in enumerate(self.scheduler.slots)
+                      if r is not None]
+            if not active:
+                return bool(self.scheduler.queue_depth)
+            S = self.num_slots
+            tokens = np.zeros((S,), np.int32)
+            positions = np.zeros((S,), np.int32)
+            tables = np.full((S, self.max_pages_per_req), self.trash_page,
+                             np.int32)
+            for i, r in active:
+                tokens[i] = r.generated[-1]
+                positions[i] = r.position
+                tables[i] = self._row(r)
+            try:
+                self.cache, next_toks = self._decode(
+                    self.model.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(tables))
+                next_toks = np.asarray(next_toks)
+            except Exception as e:
+                # a decode-step failure poisons the whole slot batch (the
+                # cache buffer may be donated/invalid): fail the in-flight
+                # requests with their pages freed rather than wedging them
+                for _i, r in active:
+                    r.error = f"decode failed: {type(e).__name__}: {e}"
+                    self.scheduler.evict(r, "error")
+                    self._note_done(r)
+                self._recover_cache("failed decode")
+                raise
+            self._note_tokens(len(active))
+            self._steps += 1
+            for i, r in active:
+                if self.scheduler.record_token(r, int(next_toks[i])):
+                    self._note_done(r)
+            return True
+
+    def _recover_cache(self, why: str):
+        """After a failed jitted call on a DONATING backend the cache
+        buffer may already be consumed — rebuild it and fail whatever
+        in-flight KV it held (CPU never donates: old cache stays valid,
+        surviving requests keep decoding)."""
+        if not self._donate:
+            return
+        for r in list(self.scheduler.active_requests()):
+            r.error = f"kv cache lost to a {why} (donated buffer)"
+            self.scheduler.evict(r, "error")
+            self._note_done(r)
+        self.cache = self.model.init_cache(self.num_pages, self.page_size)
+
+    def cancel(self, req: Request) -> bool:
+        """Abandon a request (frontend timeout, client gone): dequeue or
+        preempt it, freeing its pages. False if it already finished."""
+        with self._lock:
+            return self.scheduler.cancel(req)
+
+    def run_until_idle(self, max_steps: int = 100000):
+        for _ in range(max_steps):
+            self.step()
+            if self.scheduler.idle:
+                return
+        raise RuntimeError(f"not idle after {max_steps} steps")
+
+    def defrag(self):
+        """Compact live pages to the low end of the pool (between steps)."""
+        with self._lock:
+            tables = [r.table for r in self.scheduler.active_requests()]
+            mapping = defrag_plan(self.pool, tables)
+            self.cache = self.model.apply_defrag(self.cache, mapping)
+            return mapping
+
+    # -- background thread ---------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    worked = self.step()
+                except Exception:
+                    # step() already failed the affected requests; the
+                    # serving thread must survive a poison step or every
+                    # later request wedges against a dead engine
+                    import traceback
+                    traceback.print_exc()
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                if not worked and self.scheduler.idle:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="serving-engine")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- stats ---------------------------------------------------------
+    def _note_tokens(self, n: int):
+        with self._stats_lock:
+            self._tokens_total += n
+            self._tok_window.append((time.monotonic(), n))
+
+    def _note_done(self, req: Request):
+        lat = req.latency()
+        if lat is not None:
+            with self._stats_lock:
+                self._latencies.append(lat)
+
+    def stats(self) -> dict:
+        """/stats counters: queue depth, latency percentiles, tokens/sec,
+        page-pool occupancy, preemptions, compiles per bucket."""
+        with self._stats_lock:  # the step thread appends concurrently
+            lats = sorted(self._latencies)
+            w = list(self._tok_window)
+            total = self._tokens_total
+
+        def pct(p):
+            if not lats:
+                return None
+            return round(lats[min(len(lats) - 1,
+                                  int(p / 100 * len(lats)))] * 1e3, 3)
+
+        tps = 0.0
+        if len(w) >= 2 and w[-1][0] > w[0][0]:
+            tps = sum(n for _, n in w[1:]) / (w[-1][0] - w[0][0])
+        return {**self.scheduler.stats(),
+                "pool": self.pool.stats(),
+                "steps": self._steps,
+                "tokens_generated": total,
+                "tokens_per_sec": round(tps, 2),
+                "latency_ms_p50": pct(50), "latency_ms_p99": pct(99),
+                "completed_seen": len(lats),
+                "compiles": dict(self._compiles)}

@@ -1,0 +1,151 @@
+"""Network front-end for the serving engine.
+
+Reuses the PR-1 fault-tolerant PS wire format
+(distributed/fleet/runtime/rpc.py: data-only frames, CRC, optional
+PADDLE_PS_SECRET HMAC handshake, client retry with stable request ids,
+server-side dedup) — so a retried `generate` that raced a connection
+drop is served from the dedup cache instead of decoding twice.
+
+Ops:
+  {"op": "generate", "prompt": <int ndarray>, "max_new_tokens": n,
+   "deadline": seconds|None, "timeout": seconds}
+      -> {"status": "done"|"deadline"|"timeout"|"rejected"|"error",
+          "tokens": <int32 ndarray>, ...}
+    Blocks the connection's handler thread until the request finishes
+    (the engine keeps batching others meanwhile). Backpressure surfaces
+    as status="rejected" — a well-formed reply, not a transport error,
+    so the client's retry loop does not hammer a saturated server. A
+    handler timeout CANCELS the request (slot+pages freed, partial
+    tokens returned) before replying, because the reply is dedup-cached
+    and a still-running request would decode tokens no retry could
+    ever fetch.
+  {"op": "stats"} -> engine.stats()   (queue depth, p50/p99, tokens/s,
+    pool occupancy, preemptions, compile counters)
+  {"op": "ping"}  -> True
+
+In-process use (tests, co-located workers) needs none of this — call
+`Engine.submit` / `Engine.generate` directly.
+"""
+from __future__ import annotations
+
+import socketserver
+import threading
+
+import numpy as np
+
+from ..distributed.fleet.runtime.rpc import (RpcClient, RpcServerState,
+                                             serve_connection)
+from .scheduler import QueueFull
+
+__all__ = ["ServingServer", "ServingClient"]
+
+
+class ServingServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    READ_OPS = frozenset({"stats", "ping"})
+
+    def __init__(self, engine, endpoint: str = "127.0.0.1:0",
+                 secret: str | None = None,
+                 default_timeout: float = 120.0):
+        self.engine = engine
+        self.default_timeout = default_timeout
+        self._rpc = RpcServerState(read_ops=self.READ_OPS, secret=secret)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                serve_connection(self.request, outer._dispatch,
+                                 outer._rpc)
+
+        host, port = endpoint.rsplit(":", 1)
+        super().__init__((host, int(port)), Handler)
+        self.endpoint = f"{host}:{self.server_address[1]}"
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self.engine.start()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="serving-frontend")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.shutdown()
+        self.server_close()
+        self.engine.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _dispatch(self, req: dict):
+        op = req.get("op")
+        if op == "ping":
+            return True
+        if op == "stats":
+            return self.engine.stats()
+        if op == "generate":
+            prompt = np.asarray(req["prompt"], np.int32)
+            try:
+                h = self.engine.submit(
+                    prompt, int(req.get("max_new_tokens", 16)),
+                    deadline=req.get("deadline"))
+            except QueueFull as e:
+                return {"status": "rejected", "error": str(e)}
+            except ValueError as e:
+                return {"status": "error", "error": str(e)}
+            timeout = float(req.get("timeout") or self.default_timeout)
+            if not h.wait(timeout):
+                # the reply gets dedup-cached, so the request must not
+                # keep decoding tokens nobody can ever retrieve: cancel
+                # it (frees slot+pages) and return the partial output.
+                # cancel() can lose the race to completion — fall
+                # through to the finished result in that case.
+                if self.engine.cancel(h):
+                    return {"status": "timeout",
+                            "tokens": np.asarray(h.generated, np.int32),
+                            "error": f"not finished within {timeout}s; "
+                                     "request cancelled"}
+            if h.status == "error":
+                return {"status": "error", "error": h.error or "failed"}
+            return {"status": h.status,
+                    "tokens": np.asarray(h.generated, np.int32),
+                    "prompt_len": int(prompt.size),
+                    "latency_ms": round((h.latency() or 0.0) * 1e3, 3)}
+        raise ValueError(f"unknown op {op!r}")
+
+
+class ServingClient:
+    """Thin client over RpcClient (retry/deadline/dedup semantics)."""
+
+    def __init__(self, endpoint: str, secret: str | None = None,
+                 timeout: float | None = None):
+        self._rpc = RpcClient(endpoint, secret=secret,
+                              timeout=timeout if timeout is not None
+                              else 150.0)
+
+    def ping(self) -> bool:
+        return bool(self._rpc.call({"op": "ping"}))
+
+    def stats(self) -> dict:
+        return self._rpc.call({"op": "stats"})
+
+    def generate(self, prompt, max_new_tokens: int = 16,
+                 deadline: float | None = None,
+                 timeout: float = 120.0) -> dict:
+        return self._rpc.call(
+            {"op": "generate", "prompt": np.asarray(prompt, np.int32),
+             "max_new_tokens": int(max_new_tokens),
+             "deadline": deadline, "timeout": timeout},
+            timeout=timeout + 30.0, deadline=timeout + 60.0)
+
+    def close(self):
+        self._rpc.close()

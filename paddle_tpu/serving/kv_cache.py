@@ -1,0 +1,155 @@
+"""Paged KV-cache management: fixed-size pages in preallocated pools.
+
+Ragged Paged Attention (PAPERS.md) shape: the KV history of every
+in-flight request lives in fixed-size pages of ONE preallocated device
+pool per engine (no per-request HBM allocs, no reshape/realloc as
+sequences grow), addressed through a per-request page table. This module
+is the host-side accountant:
+
+  * `PagePool` — free-list allocator over `num_pages` page slots with
+    capacity-based admission control (`can_admit`) and occupancy stats;
+  * `PageTable` — one request's ordered page list + logical length;
+  * `defrag` — compacts live pages to the low end of the pool (device
+    gather + table rewrite) so a long-running engine can shrink its pool
+    or snapshot a dense prefix.
+
+The device arrays themselves ([L, P, ps, H, d] pools) are built by the
+model adapter (serving/model.py); the pool hands out page INDICES only,
+so the accountant stays synchronous and lock-cheap while all array work
+remains inside the jitted step.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+__all__ = ["PagePool", "PageTable", "pages_needed", "defrag_plan"]
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    return max(1, math.ceil(total_tokens / page_size))
+
+
+class PageTable:
+    """Ordered page-index list for one request; `pages[i]` backs logical
+    positions [i*page_size, (i+1)*page_size)."""
+
+    __slots__ = ("pages", "page_size", "length")
+
+    def __init__(self, page_size: int):
+        self.pages: list[int] = []
+        self.page_size = page_size
+        self.length = 0          # logical tokens written
+
+    def padded(self, max_pages: int, fill: int = 0) -> list[int]:
+        """Fixed-width row for the jitted step (missing entries point at
+        page `fill`; they are masked by ctx_len and never read live)."""
+        if len(self.pages) > max_pages:
+            raise ValueError(
+                f"request uses {len(self.pages)} pages > bucket width "
+                f"{max_pages}")
+        return self.pages + [fill] * (max_pages - len(self.pages))
+
+
+class PagePool:
+    """Free-list page allocator with admission control.
+
+    Thread-safe: the scheduler thread allocates/frees while frontend
+    threads ask `can_admit` for backpressure decisions.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages <= 0 or page_size <= 0:
+            raise ValueError("num_pages and page_size must be positive")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._lock = threading.Lock()
+        self._free = list(range(num_pages - 1, -1, -1))  # pop() -> low idx
+        # stats
+        self.alloc_count = 0
+        self.free_count = 0
+        self.alloc_failures = 0
+
+    # -- capacity ------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - self.free_pages
+
+    @property
+    def occupancy(self) -> float:
+        return self.used_pages / self.num_pages
+
+    def can_admit(self, total_tokens: int) -> bool:
+        """Admission control: admit only when the request's WORST-CASE
+        page demand (prompt + max new tokens) fits in the free list, so
+        an admitted request can never deadlock the pool mid-decode."""
+        return pages_needed(total_tokens, self.page_size) <= self.free_pages
+
+    # -- alloc/free ----------------------------------------------------
+    def alloc(self, n: int) -> list[int] | None:
+        """n pages, or None (and no partial allocation) if unavailable."""
+        with self._lock:
+            if n > len(self._free):
+                self.alloc_failures += 1
+                return None
+            got = [self._free.pop() for _ in range(n)]
+            self.alloc_count += n
+            return got
+
+    def alloc_table(self, total_tokens: int) -> PageTable | None:
+        pages = self.alloc(pages_needed(total_tokens, self.page_size))
+        if pages is None:
+            return None
+        t = PageTable(self.page_size)
+        t.pages = pages
+        return t
+
+    def free(self, table_or_pages) -> None:
+        pages = table_or_pages.pages if isinstance(table_or_pages, PageTable) \
+            else list(table_or_pages)
+        with self._lock:
+            live = set(self._free)
+            for p in pages:
+                if not 0 <= p < self.num_pages:
+                    raise ValueError(f"page {p} outside pool")
+                if p in live:
+                    raise ValueError(f"double free of page {p}")
+            self._free.extend(sorted(pages, reverse=True))
+            self.free_count += len(pages)
+        if isinstance(table_or_pages, PageTable):
+            table_or_pages.pages = []
+
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {"num_pages": self.num_pages, "page_size": self.page_size,
+                "free_pages": free,
+                "used_pages": self.num_pages - free,
+                "occupancy": round(1 - free / self.num_pages, 4),
+                "alloc_count": self.alloc_count,
+                "free_count": self.free_count,
+                "alloc_failures": self.alloc_failures}
+
+
+def defrag_plan(pool: PagePool, tables: list[PageTable]) -> dict[int, int]:
+    """Mapping old_page -> new_page that compacts all live pages into the
+    lowest indices (stable: table order, then page order). The caller
+    applies it to the device pools (serving/model.py
+    `apply_defrag`) and this function rewrites tables + the free list.
+
+    Safe only while the engine step is quiesced (the scheduler calls it
+    between steps)."""
+    live: list[int] = [p for t in tables for p in t.pages]
+    if len(set(live)) != len(live):
+        raise ValueError("page shared by two tables — corrupt state")
+    mapping = {old: new for new, old in enumerate(live)}
+    for t in tables:
+        t.pages = [mapping[p] for p in t.pages]
+    with pool._lock:
+        pool._free = list(range(pool.num_pages - 1, len(live) - 1, -1))
+    return mapping

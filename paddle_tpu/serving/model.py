@@ -1,0 +1,177 @@
+"""Decode-model adapter: GPT functional core over a paged KV cache.
+
+Bridges `models/gpt.py` (stacked-block functional GPT) to the serving
+engine's two jitted entry points:
+
+  prefill(params, cache, tokens [T], true_len, page_row [M])
+      -> (cache', logits [V])
+    Dense causal forward over one padded prompt bucket; per-layer K/V of
+    every bucket position is scattered into the request's pages (padding
+    positions land in the pool's trash page — see below) and the logits
+    of the LAST REAL position come back for the first sampled token.
+
+  decode(params, cache, tokens [S], positions [S], tables [S, M])
+      -> (cache', logits [S, V])
+    One token for every slot of the fixed-shape slot batch: embed at
+    `positions`, per layer append K/V into the position's page, ragged
+    paged attention over each slot's own history
+    (ops/paged_attention.py), final LN + tied-embedding head.
+
+Trash-page convention: the device pools carry ONE extra page at index
+`num_pages` that absorbs every masked write — padded page-table entries
+and inactive slots point at it, so the jitted step never needs a
+data-dependent "skip this write" branch (writes are unconditional,
+garbage lands in the trash page, reads are masked by ctx_len before
+softmax). Page tables handed to these functions must therefore be
+padded with `fill=num_pages`.
+
+Numerical contract: bit-matches `models.gpt.gpt_forward` greedy decode
+when scale factors are exact binary fractions (head_dim a power of two)
+— the end-to-end parity test in tests/test_serving.py pins this.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import (GPTConfig, _causal_attention, _ln,
+                          init_gpt_params)
+from ..ops.paged_attention import paged_attention_decode
+
+__all__ = ["GPTDecodeModel"]
+
+
+class GPTDecodeModel:
+    """Serving adapter around the functional GPT core.
+
+    The engine owns jit/donation/bucketing; everything here is pure."""
+
+    def __init__(self, cfg: GPTConfig, params=None, seed: int = 0,
+                 attn_impl: str | None = None):
+        self.cfg = cfg
+        self.params = params if params is not None \
+            else init_gpt_params(cfg, seed)
+        self.params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.attn_impl = attn_impl  # None = auto (ops/autobench gate)
+        # the engine caps admission at this (positions past wpe would
+        # silently clip under jnp.take)
+        self.max_positions = cfg.max_position_embeddings
+
+    # -- cache ---------------------------------------------------------
+    def init_cache(self, num_pages: int, page_size: int):
+        """[L, num_pages+1, ps, H, d] zero pools (last page = trash)."""
+        cfg = self.cfg
+        dt = jnp.dtype(cfg.amp_dtype) if cfg.amp_dtype else jnp.float32
+        shape = (cfg.num_layers, num_pages + 1, page_size,
+                 cfg.num_heads, self.head_dim)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def apply_defrag(self, cache, mapping: dict[int, int]):
+        """Move live pages per defrag_plan's old->new mapping (host-side
+        plan, one device gather per pool)."""
+        if not mapping:
+            return cache
+        P = cache["k"].shape[1]
+        perm = list(range(P))
+        for old, new in mapping.items():
+            perm[new] = old
+        perm = jnp.asarray(perm, jnp.int32)
+        return {"k": cache["k"][:, perm], "v": cache["v"][:, perm]}
+
+    # -- layer math (mirrors models.gpt.gpt_block_fn) -------------------
+    def _qkv(self, p, h):
+        q = h @ p["wq"] + p["bq"]
+        k = h @ p["wk"] + p["bk"]
+        v = h @ p["wv"] + p["bv"]
+        return q, k, v
+
+    def _ffn(self, p, x, eps):
+        h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
+        u = jax.nn.gelu(h @ p["w_up"] + p["b_up"], approximate=True)
+        return x + u @ p["w_down"] + p["b_down"]
+
+    # -- prefill -------------------------------------------------------
+    def prefill(self, params, cache, tokens, true_len, page_row):
+        """tokens [T] int32 (padded bucket), true_len scalar int32,
+        page_row [M] int32 (fill = trash). Returns (cache, logits [V])."""
+        cfg = self.cfg
+        H, d = cfg.num_heads, self.head_dim
+        T = tokens.shape[0]
+        ps = cache["k"].shape[2]
+        n_pages = T // ps
+        x = jnp.take(params["wte"], tokens, axis=0) \
+            + params["wpe"][:T]                               # [T, D]
+
+        def body(carry, xs):
+            x, ck, cv = carry
+            p, l = xs
+            h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
+            q, k, v = self._qkv(p, h)
+            kp = k.reshape(n_pages, ps, H, d).astype(ck.dtype)
+            vp = v.reshape(n_pages, ps, H, d).astype(cv.dtype)
+            ck = ck.at[l, page_row[:n_pages]].set(kp)
+            cv = cv.at[l, page_row[:n_pages]].set(vp)
+            # ONE source of truth for the dense math: the serving parity
+            # contract (prefill == models.gpt forward, bit-for-bit) holds
+            # by construction, not by a hand-mirrored copy
+            a = _causal_attention(q[None], k[None], v[None], H,
+                                  impl="xla")[0]
+            x = x + (a @ p["wo"] + p["bo"])
+            x = self._ffn(p, x, cfg.layer_norm_eps)
+            return (x, ck, cv), None
+
+        L = cfg.num_layers
+        (x, ck, cv), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], jnp.arange(L)))
+        xlast = jax.lax.dynamic_index_in_dim(x, true_len - 1, 0,
+                                             keepdims=False)
+        xlast = _ln(xlast, params["lnf_s"], params["lnf_b"],
+                    cfg.layer_norm_eps)
+        logits = xlast.astype(jnp.float32) \
+            @ params["wte"].T.astype(jnp.float32)
+        return {"k": ck, "v": cv}, logits
+
+    # -- decode --------------------------------------------------------
+    def decode(self, params, cache, tokens, positions, tables):
+        """tokens/positions [S] int32, tables [S, M] int32 (fill = trash;
+        inactive slots = all-trash rows with position 0). Returns
+        (cache, logits [S, V])."""
+        cfg = self.cfg
+        H, d = cfg.num_heads, self.head_dim
+        S = tokens.shape[0]
+        ps = cache["k"].shape[2]
+        x = jnp.take(params["wte"], tokens, axis=0) \
+            + jnp.take(params["wpe"], positions, axis=0)       # [S, D]
+        page_of = jnp.take_along_axis(
+            tables, (positions // ps)[:, None], axis=1)[:, 0]  # [S]
+        off = positions % ps
+        ctx = positions + 1
+
+        def body(carry, xs):
+            x, ck, cv = carry
+            p, l = xs
+            h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
+            q, k, v = self._qkv(p, h)
+            ck = ck.at[l, page_of, off].set(
+                k.reshape(S, H, d).astype(ck.dtype))
+            cv = cv.at[l, page_of, off].set(
+                v.reshape(S, H, d).astype(cv.dtype))
+            a = paged_attention_decode(
+                q.reshape(S, H, d), ck[l], cv[l], tables, ctx,
+                scale=1.0 / math.sqrt(d), impl=self.attn_impl)
+            x = x + (a.reshape(S, -1) @ p["wo"] + p["bo"])
+            x = self._ffn(p, x, cfg.layer_norm_eps)
+            return (x, ck, cv), None
+
+        L = cfg.num_layers
+        (x, ck, cv), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], jnp.arange(L)))
+        x = _ln(x, params["lnf_s"], params["lnf_b"], cfg.layer_norm_eps)
+        logits = x.astype(jnp.float32) \
+            @ params["wte"].T.astype(jnp.float32)
+        return {"k": ck, "v": cv}, logits

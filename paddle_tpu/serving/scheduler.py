@@ -1,0 +1,248 @@
+"""Continuous batcher: request lifecycle + slot scheduling policy.
+
+The serving engine decodes a FIXED-SHAPE slot batch every step (so there
+is exactly one compiled decode program per (slots, pages) bucket); this
+module is the policy layer that decides, between steps, which requests
+occupy those slots:
+
+  * admission — FIFO from the queue into free slots, gated by the page
+    pool: a request is admitted only when its WORST-CASE page demand
+    (prompt + max_new_tokens) is allocatable, so an admitted request can
+    never run out of pages mid-decode (no mid-flight OOM, no deadlock);
+  * prefill-then-decode — a newly admitted request is prefilled once
+    (its prompt KV written to its pages, first token sampled), then
+    joins the in-flight decode batch;
+  * eviction — EOS or max_new_tokens completes a request; a missed
+    deadline preempts it (partial output returned, ALL its pages freed
+    back to the pool that step);
+  * backpressure — the bounded queue rejects submits past `max_queue`.
+
+Pure host logic over kv_cache.PagePool — no jax imports — so the policy
+is unit-testable without a model (tests/test_serving.py).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from .kv_cache import PagePool
+
+__all__ = ["Request", "Scheduler", "QueueFull"]
+
+
+class QueueFull(RuntimeError):
+    """Backpressure: the engine's admission queue is at capacity."""
+
+
+_req_ids = itertools.count(1)
+
+
+class Request:
+    """One generation request, queued -> running -> finished.
+
+    status: queued | running | done | deadline | error | cancelled.
+    `deadline` is an absolute time.monotonic() stamp (None = no bound).
+    """
+
+    def __init__(self, prompt, max_new_tokens: int, deadline: float | None
+                 = None, eos_id: int | None = None):
+        self.id = next(_req_ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.deadline = deadline
+        self.eos_id = eos_id
+        self.generated: list[int] = []
+        self.status = "queued"
+        self.error: str | None = None
+        self.table = None            # PageTable while admitted
+        self.slot: int | None = None
+        self.submitted_at = time.monotonic()
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+        self._done = threading.Event()
+
+    # -- results -------------------------------------------------------
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        """Generated tokens (possibly partial on deadline preemption).
+        Raises on error status; TimeoutError if not finished in time."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} not finished")
+        if self.status == "error":
+            raise RuntimeError(self.error or "request failed")
+        return np.asarray(self.generated, np.int32)
+
+    @property
+    def total_tokens(self) -> int:
+        return int(self.prompt.size) + self.max_new_tokens
+
+    @property
+    def position(self) -> int:
+        """Position of the LAST generated token (its KV is written by the
+        next decode step)."""
+        return int(self.prompt.size) + len(self.generated) - 1
+
+    def latency(self) -> float | None:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+
+class Scheduler:
+    """Slot table + queue; the engine calls the methods between steps."""
+
+    def __init__(self, pool: PagePool, num_slots: int,
+                 max_seq_len: int, max_queue: int = 256,
+                 now=time.monotonic):
+        self.pool = pool
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.max_queue = max_queue
+        self.now = now
+        self.slots: list[Request | None] = [None] * num_slots
+        self.queue: deque[Request] = deque()
+        self._lock = threading.Lock()
+        # counters (engine /stats)
+        self.admitted = 0
+        self.completed = 0
+        self.preemptions = 0
+        self.rejected = 0
+
+    # -- queue side (frontend threads) ---------------------------------
+    def submit(self, req: Request) -> Request:
+        if req.total_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {req.total_tokens} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        with self._lock:
+            if len(self.queue) >= self.max_queue:
+                self.rejected += 1
+                raise QueueFull(
+                    f"queue at capacity ({self.max_queue}); retry later")
+            self.queue.append(req)
+        return req
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self.queue)
+
+    def active_requests(self) -> list[Request]:
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def idle(self) -> bool:
+        return self.queue_depth == 0 and not self.active_requests()
+
+    # -- step side (scheduler thread) ----------------------------------
+    def expire_deadlines(self) -> list[Request]:
+        """Finish every queued or running request whose deadline passed;
+        running ones are PREEMPTED: their pages all go back to the pool
+        now, their partial output stands."""
+        t = self.now()
+        hit: list[Request] = []
+        with self._lock:
+            kept = deque()
+            for r in self.queue:
+                if r.deadline is not None and t > r.deadline:
+                    hit.append(r)
+                else:
+                    kept.append(r)
+            self.queue = kept
+        for i, r in enumerate(self.slots):
+            if r is not None and r.deadline is not None and t > r.deadline:
+                self.slots[i] = None
+                self.preemptions += 1
+                hit.append(r)
+        for r in hit:
+            self._finish(r, "deadline")
+        return hit
+
+    def admit(self) -> list[Request]:
+        """FIFO-admit queued requests into free slots while the pool can
+        cover their worst case; returns the newly admitted requests (the
+        engine prefills them). Head-of-line blocking is intentional —
+        FIFO fairness over utilization."""
+        out: list[Request] = []
+        for i in range(self.num_slots):
+            if self.slots[i] is not None:
+                continue
+            with self._lock:
+                if not self.queue:
+                    break
+                head = self.queue[0]
+                table = self.pool.alloc_table(head.total_tokens)
+                if table is None:
+                    break            # pool full: wait for evictions
+                self.queue.popleft()
+            head.table = table
+            head.slot = i
+            head.status = "running"
+            head.started_at = self.now()
+            self.slots[i] = head
+            self.admitted += 1
+            out.append(head)
+        return out
+
+    def record_token(self, req: Request, token: int) -> bool:
+        """Append a sampled token; returns True when the request is now
+        finished (EOS or max_new_tokens) and has been evicted."""
+        req.generated.append(int(token))
+        req.table.length = req.position + 1
+        if (req.eos_id is not None and token == req.eos_id) \
+                or len(req.generated) >= req.max_new_tokens:
+            self.evict(req, "done")
+            return True
+        return False
+
+    def cancel(self, req: Request) -> bool:
+        """Abandon a queued or running request (its pages return to the
+        pool; partial output stands). False if already finished. The
+        caller must hold the engine step lock so this never races a
+        decode step."""
+        with self._lock:
+            try:
+                self.queue.remove(req)
+            except ValueError:
+                pass
+        if req.done():
+            return False
+        self.evict(req, "cancelled")
+        return True
+
+    def evict(self, req: Request, status: str):
+        if req.slot is not None and self.slots[req.slot] is req:
+            self.slots[req.slot] = None
+        self._finish(req, status)
+        if status == "done":
+            self.completed += 1
+
+    def _finish(self, req: Request, status: str):
+        if req.table is not None:
+            self.pool.free(req.table)
+            req.table = None
+        req.status = status
+        req.finished_at = self.now()
+        req._done.set()
+
+    def stats(self) -> dict:
+        return {"queue_depth": self.queue_depth,
+                "active_slots": len(self.active_requests()),
+                "num_slots": self.num_slots,
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "preemptions": self.preemptions,
+                "rejected": self.rejected}

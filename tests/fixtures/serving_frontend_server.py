@@ -1,0 +1,34 @@
+"""Subprocess fixture: tiny-GPT serving engine behind the RPC frontend.
+
+Prints "ENDPOINT <host:port>" on stdout once listening, then serves
+until stdin closes (the parent test exiting) or SIGTERM.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.models.gpt import GPTConfig  # noqa: E402
+from paddle_tpu.serving import (Engine, GPTDecodeModel,  # noqa: E402
+                                ServingServer)
+
+
+def main():
+    cfg = GPTConfig.tiny(num_layers=2)
+    model = GPTDecodeModel(cfg, seed=int(os.environ.get("SEED", "0")))
+    engine = Engine(model, num_slots=4,
+                    num_pages=int(os.environ.get("NUM_PAGES", "32")),
+                    page_size=8, max_seq_len=64)
+    srv = ServingServer(engine, "127.0.0.1:0")
+    srv.start()
+    print(f"ENDPOINT {srv.endpoint}", flush=True)
+    sys.stdin.read()        # parent closes the pipe to stop us
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -136,3 +136,32 @@ def test_bert_predictor_bf16(tmp_path):
     assert out.dtype == np.float32
     # bf16 compute: close but not bit-equal
     assert np.mean(np.abs(out - ref)) / (np.mean(np.abs(ref)) + 1e-9) < 0.1
+
+def test_predictor_concurrent_runs_do_not_interleave(tmp_path):
+    """Two threads hammering ONE predictor with different inputs must
+    each get the output of THEIR input — run() (set inputs -> execute
+    -> fetch) is atomic under the predictor's internal lock."""
+    import threading
+
+    d = str(tmp_path / "lenet_mt")
+    img, _ = _save_lenet(d)
+    pred = Predictor(Config(model_dir=d))
+    rng = np.random.RandomState(1)
+    imgs = [img, rng.randn(*img.shape).astype("float32")]
+    refs = [pred.run([im])[0] for im in imgs]
+    errs = []
+
+    def worker(idx, iters=12):
+        try:
+            for _ in range(iters):
+                out, = pred.run([imgs[idx]])
+                np.testing.assert_allclose(out, refs[idx], atol=1e-5)
+        except Exception as e:  # surface assertion failures to the test
+            errs.append((idx, e))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errs, errs

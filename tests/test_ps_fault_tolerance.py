@@ -667,3 +667,126 @@ def test_heter_step_retries_are_exactly_once(monkeypatch):
     assert w.transport_stats["dense"]["retries"] > 0
     w.stop_dense()
     w.close()
+
+
+def test_incremental_snapshot_rewrites_only_dirty_tables(
+        tmp_path, monkeypatch):
+    """Write-through snapshots (SNAPSHOT_EVERY=1) must cost O(touched
+    table) per push, not O(all tables): after the base, each push
+    writes a DELTA npz naming only the table it dirtied, and restart
+    replays base + deltas to the exact full-copy state."""
+    import json as _json
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    srv = PSServer(ep, snapshot_dir=str(tmp_path), snapshot_every=1)
+    srv.serve_in_thread()
+    cl = PSClient([ep])
+    cl.pull("a", 4, [1, 2, 3])
+    cl.pull("b", 4, [7, 8])
+    cl.push("a", 4, [1], np.ones((1, 4)), lr=0.5)     # snap 1: full base
+    assert srv.full_snapshots == 1 and srv.delta_snapshots == 0
+    cl.push("b", 4, [7], np.ones((1, 4)), lr=0.5)     # snap 2: delta {b}
+    cl.push("b", 4, [8], 2 * np.ones((1, 4)), lr=0.5)  # snap 3: delta {b}
+    assert srv.delta_snapshots == 2
+    deltas = sorted(f for f in os.listdir(tmp_path) if ".delta_" in f)
+    assert len(deltas) == 2
+    for f in deltas:
+        with np.load(os.path.join(tmp_path, f),
+                     allow_pickle=False) as blob:
+            meta = _json.loads(bytes(blob["meta"]).decode())
+            assert meta["kind"] == "delta"
+            # only the dirty table's arrays were rewritten
+            assert set(meta["tables"]) == {"b"}
+            assert "k:a" not in blob.files and "k:b" in blob.files
+    ra = cl.pull("a", 4, [1, 2, 3]).copy()
+    rb = cl.pull("b", 4, [7, 8]).copy()
+    cl.close()
+    _stop(srv)
+
+    srv2 = PSServer.restart_from_snapshot(ep, str(tmp_path))
+    srv2.serve_in_thread()
+    try:
+        cl2 = PSClient([ep])
+        np.testing.assert_array_equal(cl2.pull("a", 4, [1, 2, 3]), ra)
+        np.testing.assert_array_equal(cl2.pull("b", 4, [7, 8]), rb)
+        cl2.close()
+    finally:
+        _stop(srv2)
+
+
+def test_snapshot_compaction_collapses_deltas(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    port = _free_port()
+    ep = f"127.0.0.1:{port}"
+    srv = PSServer(ep, snapshot_dir=str(tmp_path), snapshot_every=1)
+    srv.snapshot_compact_every = 3
+    srv.serve_in_thread()
+    cl = PSClient([ep])
+    for i in range(8):
+        cl.push("t", 4, [i], np.ones((1, 4)), lr=0.1)
+    # pushes: 1 base, then deltas with a full compaction every 3rd —
+    # superseded delta files are garbage-collected at each base write
+    assert srv.full_snapshots >= 2
+    leftover = [f for f in os.listdir(tmp_path) if ".delta_" in f]
+    assert len(leftover) <= 3
+    ref = cl.pull("t", 4, list(range(8))).copy()
+    cl.close()
+    _stop(srv)
+    srv2 = PSServer.restart_from_snapshot(ep, str(tmp_path))
+    srv2.serve_in_thread()
+    try:
+        cl2 = PSClient([ep])
+        np.testing.assert_array_equal(
+            cl2.pull("t", 4, list(range(8))), ref)
+        cl2.close()
+    finally:
+        _stop(srv2)
+
+
+def test_failed_delta_write_remerges_dirty_set(tmp_path, monkeypatch):
+    """A failed snapshot write must put the consumed dirty marks back,
+    or every later delta would silently omit those tables until the
+    next full base (code-review finding, PR 2)."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    srv = PSServer("127.0.0.1:0", snapshot_dir=str(tmp_path),
+                   snapshot_every=0)
+    srv.table("t", 4).push(np.array([1]), np.ones((1, 4)), 1.0)
+    srv._mark_dirty("t")
+    srv.snapshot()                         # base
+    srv._mark_dirty("t")
+    orig = srv._write_snapshot
+    srv._write_snapshot = lambda *a: (_ for _ in ()).throw(
+        OSError("disk full"))
+    with pytest.raises(OSError):
+        srv.snapshot()
+    assert "t" in srv._dirty               # marks restored
+    assert srv._snap_pending               # retry hook owes a snapshot
+    srv._write_snapshot = orig
+    srv._after_retry("push")               # dedup-hit retry lands it
+    assert srv.delta_snapshots == 1 and not srv._dirty
+    n = srv.snapshots_taken
+    srv._after_retry("push")               # nothing owed: no churn
+    assert srv.snapshots_taken == n
+    srv.server_close()
+
+
+def test_idle_interval_snapshots_do_not_churn(tmp_path, monkeypatch):
+    """An idle server on a snapshot timer must not write empty deltas
+    (or periodic full bases) forever."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    srv = PSServer("127.0.0.1:0", snapshot_dir=str(tmp_path),
+                   snapshot_every=0)
+    srv.table("t", 4).push(np.array([1]), np.ones((1, 4)), 1.0)
+    srv._mark_dirty("t")
+    srv._after_commit("push")
+    srv.snapshot()
+    taken = srv.snapshots_taken
+    assert taken == 1
+    for _ in range(5):
+        srv.snapshot()                 # timer fires with nothing new
+    assert srv.snapshots_taken == taken
+    srv._mark_dirty("t")               # real change -> snapshots again
+    srv.snapshot()
+    assert srv.snapshots_taken == taken + 1
+    srv.server_close()
