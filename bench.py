@@ -31,7 +31,8 @@ Configs (BENCH_CONFIG=...): bert_base (default, seq 128; also records the
 secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
 | bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | widedeep |
 infer (BERT predictor latency) | flash_attn (pallas-vs-jnp microbench) |
-allreduce.
+allreduce | metrics_overhead (telemetry enabled-vs-disabled decode
+step-time delta, <2% bar).
 """
 from __future__ import annotations
 
@@ -662,6 +663,62 @@ def bench_serving(num_requests=48, num_slots=8, hidden=512, layers=8,
             "pool_pages": st["pool"]["num_pages"]}
 
 
+def bench_metrics_overhead(steps=200, hidden=256, layers=4, heads=4,
+                           slots=4, seed=0):
+    """Telemetry cost guardrail: decode step time with the
+    observability registry+tracer enabled vs disabled on the SAME
+    engine (same compiled programs, same slot occupancy). The
+    acceptance bar is <2% overhead enabled — the counters/spans on the
+    Engine.step hot path are host-side microseconds against a
+    millisecond jitted decode. A/B/A ordering (on, off, on) so cache
+    warmup or clock drift cannot masquerade as telemetry cost."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, GPTDecodeModel
+
+    cfg = GPTConfig(hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=512,
+                    vocab_size=8192)
+    model = GPTDecodeModel(cfg, seed=seed)
+    eng = Engine(model, num_slots=slots, num_pages=128, page_size=16,
+                 max_seq_len=448)
+    rng = np.random.RandomState(seed)
+
+    def timed(n_steps):
+        # keep every slot busy for the whole window (big token budget),
+        # then time pure decode steps
+        reqs = [eng.submit(rng.randint(0, cfg.vocab_size, (16,)),
+                           max_new_tokens=420) for _ in range(slots)]
+        for _ in range(5):
+            eng.step()  # prefills + first decodes
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            eng.step()
+        dt = (time.perf_counter() - t0) / n_steps
+        for r in reqs:
+            eng.cancel(r)
+        return dt
+
+    timed(20)  # compile both programs outside the measurement
+    on1 = timed(steps)
+    obs.set_enabled(False)
+    try:
+        off = timed(steps)
+    finally:
+        obs.set_enabled(True)
+    on2 = timed(steps)
+    on = min(on1, on2)
+    overhead = (on - off) / off * 100 if off > 0 else 0.0
+    return {"metric": "serving_metrics_overhead_pct",
+            "value": round(overhead, 2), "unit": "%",
+            "enabled_step_ms": round(on * 1e3, 4),
+            "disabled_step_ms": round(off * 1e3, 4),
+            "enabled_runs_ms": [round(on1 * 1e3, 4),
+                                round(on2 * 1e3, 4)],
+            "steps": steps, "slots": slots,
+            "model": f"gpt-h{hidden}-l{layers}"}
+
+
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
     predictor parity path): save -> load -> timed ZeroCopyRun.
@@ -784,6 +841,8 @@ def main():
         rec = bench_infer_latency()
     elif which == "serving":
         rec = bench_serving()
+    elif which == "metrics_overhead":
+        rec = bench_metrics_overhead()
     elif which == "gpt_1p3b":
         rec = bench_gpt_1p3b()
     else:

@@ -53,6 +53,7 @@ from . import vision
 from . import text
 from . import jit
 from . import incubate
+from . import observability
 from . import utils
 from . import models
 from . import ops as _pallas_ops  # pallas kernels register themselves

@@ -25,13 +25,27 @@ import json
 import os
 import socketserver
 import threading
+import time
 
 import numpy as np
 
+from ....observability import registry as _obs
 from .rpc import (RpcClient, RpcServerState, TransportStats,
                   serve_connection)
 
 __all__ = ["ParameterServerRuntime", "LargeScaleKV", "PSServer", "PSClient"]
+
+# snapshot-tier telemetry (per-op rpc latency/retries/dedup counters
+# live in rpc.py; these cover the durability path's cost)
+_SNAPSHOTS = _obs.counter(
+    "paddle_tpu_ps_snapshots_total",
+    "snapshot files written, by kind (base|delta)", ["kind"])
+_SNAPSHOT_BYTES = _obs.counter(
+    "paddle_tpu_ps_snapshot_bytes_total",
+    "array payload bytes exported to snapshot files", ["kind"])
+_SNAPSHOT_SECONDS = _obs.histogram(
+    "paddle_tpu_ps_snapshot_write_seconds",
+    "wall time of one snapshot file write", ["kind"])
 
 
 class LargeScaleKV:
@@ -302,7 +316,7 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     # ops that never mutate server state: exempt from dedup caching
     READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
-                          "heartbeat"})
+                          "heartbeat", "metrics"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
 
@@ -531,6 +545,8 @@ class PSServer(socketserver.ThreadingTCPServer):
             self._snap_pending = False
 
     def _write_snapshot_files(self, path, arrays, seq, do_full):
+        kind = "base" if do_full else "delta"
+        t0 = time.perf_counter()
         with self._snap_io_lock:
             if do_full:
                 if seq <= self._snap_written:
@@ -551,6 +567,11 @@ class PSServer(socketserver.ThreadingTCPServer):
                 self._deltas_since_base += 1
                 self.delta_snapshots += 1
             self.snapshots_taken += 1
+        _SNAPSHOT_SECONDS.labels(kind=kind).observe(
+            time.perf_counter() - t0)
+        _SNAPSHOTS.labels(kind=kind).inc()
+        _SNAPSHOT_BYTES.labels(kind=kind).inc(
+            sum(a.nbytes for a in arrays.values()))
 
     def _export_arrays(self, seq: int = 0, names: set | None = None,
                        kind: str = "base") -> dict:
@@ -757,6 +778,11 @@ class PSServer(socketserver.ThreadingTCPServer):
                 req["worker"])
         if op == "ping":
             return "pong"
+        if op == "metrics":
+            # Prometheus exposition over this shard process's registry
+            # (rpc counters, snapshot costs, table sizes are all here) —
+            # the PS scrape point (docs/OBSERVABILITY.md)
+            return _obs.prometheus_text()
         if op == "heartbeat":
             import time
             with self._beats_lock:
@@ -954,6 +980,14 @@ class PSClient:
     def save(self, dirname: str):
         for i in range(len(self.endpoints)):
             self._call(i, {"op": "save", "dirname": dirname})
+
+    def metrics(self, shard: int | None = None):
+        """Prometheus text from one shard (or every shard when None) —
+        scrape helper for the PS `metrics` verb."""
+        if shard is not None:
+            return self._call(shard, {"op": "metrics"})
+        return {ep: self._call(i, {"op": "metrics"})
+                for i, ep in enumerate(self.endpoints)}
 
     # -- DGC sparse-gradient rounds (shard by index hash) ----------------
     def dgc_allreduce(self, name: str, idx, val, worker: int,

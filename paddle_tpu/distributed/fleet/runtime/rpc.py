@@ -48,17 +48,44 @@ import zlib
 
 import numpy as np
 
+from ....observability import registry as _obs, tracing as _tracing
 from .fault_injection import injector
 
 __all__ = [
     "WireError", "PSAuthError", "PSRemoteError", "PSDeadlineError",
     "encode_body", "decode_body", "send_frame", "recv_frame",
     "TransportStats", "RpcClient", "DedupCache", "RpcServerState",
-    "serve_connection", "PROTOCOL_VERSION",
+    "serve_connection", "PROTOCOL_VERSION", "TRACE_KEY",
 ]
 
 PROTOCOL_VERSION = 1
 _MAGIC = 0x7053                      # "Sp" — PS rpc
+
+# transport telemetry on the process-wide registry. The skeleton may
+# carry a `_trace_id` field (injected by RpcClient.call, stripped by
+# serve_connection before dispatch) so one request is followable
+# worker -> PS server and frontend -> engine across processes.
+TRACE_KEY = "_trace_id"
+_CLIENT_EVENTS = _obs.counter(
+    "paddle_tpu_rpc_client_events_total",
+    "client transport events (requests/retries/timeouts/...)",
+    ["event"])
+_CLIENT_BYTES = _obs.counter(
+    "paddle_tpu_rpc_client_bytes_total",
+    "client wire bytes by direction", ["direction"])
+_CLIENT_LATENCY = _obs.histogram(
+    "paddle_tpu_rpc_client_latency_seconds",
+    "successful call() round-trip latency incl. retries", ["op"])
+_SERVER_REQS = _obs.counter(
+    "paddle_tpu_rpc_server_requests_total",
+    "requests received by serve_connection", ["op"])
+_SERVER_ERRORS = _obs.counter(
+    "paddle_tpu_rpc_server_errors_total",
+    "dispatch failures answered with an error frame", ["op"])
+_SERVER_DEDUP_HITS = _obs.counter(
+    "paddle_tpu_rpc_server_dedup_hits_total",
+    "mutating requests answered from the dedup cache (client retries)",
+    ["op"])
 _HDR = struct.Struct("<HBBQIQ")      # magic, ver, flags, req_id, crc, len
 HEADER_SIZE = _HDR.size
 F_ERROR = 1
@@ -305,11 +332,16 @@ class TransportStats:
     def add(self, field: str, n: int = 1):
         with self._lock:
             setattr(self, field, getattr(self, field) + n)
+        # mirror into the process-wide registry (PSClient.stats keeps
+        # its exact per-client surface; /metrics shows the aggregate)
+        _CLIENT_EVENTS.labels(event=field).inc(n)
 
     def add_bytes(self, n_out: int, n_in: int):
         with self._lock:
             self.bytes_out += n_out
             self.bytes_in += n_in
+        _CLIENT_BYTES.labels(direction="out").inc(n_out)
+        _CLIENT_BYTES.labels(direction="in").inc(n_in)
 
     def as_dict(self) -> dict:
         with self._lock:
@@ -388,7 +420,21 @@ class RpcClient:
     def call(self, req, timeout: float | None = None,
              deadline: float | None = None):
         """One request/reply round-trip; retried with the same request
-        id until success, the deadline, or the retry bound."""
+        id until success, the deadline, or the retry bound. The span's
+        trace id rides in the skeleton (TRACE_KEY) so the server side
+        of this call joins the same trace."""
+        op = req.get("op") if isinstance(req, dict) else None
+        with _tracing.span("rpc.client", op=op or "?",
+                           endpoint=self.endpoint) as sp:
+            if isinstance(req, dict) and TRACE_KEY not in req:
+                req = {**req, TRACE_KEY: sp.trace_id}
+            t_call = time.monotonic()
+            rep = self._call_locked(req, timeout, deadline)
+            _CLIENT_LATENCY.labels(op=op or "?").observe(
+                time.monotonic() - t_call)
+            return rep
+
+    def _call_locked(self, req, timeout, deadline):
         per_attempt = timeout if timeout is not None else self.timeout
         deadline_ts = time.monotonic() + (
             deadline if deadline is not None else self.deadline)
@@ -617,10 +663,17 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
             if inj.active:
                 inj.maybe_kill("recv", armed)
             op = req.get("op") if isinstance(req, dict) else None
+            # wire-carried trace id (TRACE_KEY in the skeleton):
+            # stripped before dispatch, re-rooted as this side's span
+            # context so handler-side spans join the caller's trace
+            wire_tid = req.pop(TRACE_KEY, None) \
+                if isinstance(req, dict) else None
+            _SERVER_REQS.labels(op=op or "?").inc()
             mutating = op not in state.read_ops
             if mutating and req_id:
                 cached = state.dedup.begin(req_id)
                 if cached is not _FRESH:
+                    _SERVER_DEDUP_HITS.labels(op=op or "?").inc()
                     if state.after_retry is not None:
                         state.after_retry(op)
                     if inj.active:
@@ -633,7 +686,10 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
             err = None
             with scope if scope is not None else _NULL_SCOPE:
                 try:
-                    rep = dispatch(req)
+                    with _tracing.span(f"rpc.server.{op or 'raw'}",
+                                       trace_id=wire_tid,
+                                       op=op or "?"):
+                        rep = dispatch(req)
                 except Exception as e:
                     # application/dispatch failure (including barrier
                     # timeouts): report as an error frame instead of
@@ -646,6 +702,7 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
                     if mutating and req_id:
                         state.dedup.commit(req_id, rep)
             if err is not None:
+                _SERVER_ERRORS.labels(op=op or "?").inc()
                 send_frame(sock, err, req_id=req_id, flags=F_ERROR,
                            side="server")
                 continue

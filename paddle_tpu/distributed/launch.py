@@ -69,6 +69,13 @@ def _parse(argv):
                         "durability for throughput — a crash can "
                         "silently drop up to N-1 acked pushes on "
                         "respawn (see docs/PS_WIRE_PROTOCOL.md)")
+    p.add_argument("--metrics_dir", type=str, default=None,
+                   help="telemetry: set PADDLE_TPU_METRICS_DIR for "
+                        "every child so each process dumps its metric "
+                        "registry to <dir>/metrics_<host>_<pid>.json "
+                        "at exit; aggregate the job with `python -m "
+                        "paddle_tpu.observability.registry <dir>` "
+                        "(docs/OBSERVABILITY.md)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -250,6 +257,10 @@ def launch(argv=None):
             rank = base + i
             specs.append((f"trainer.{rank}",
                           get_cluster_env(rank, endpoints), script))
+    if args.metrics_dir:
+        os.makedirs(args.metrics_dir, exist_ok=True)
+        for _name, env, _argv in specs:
+            env["PADDLE_TPU_METRICS_DIR"] = args.metrics_dir
     from .elastic import ElasticManager
     hb_dir = None
     if args.max_restarts > 0:

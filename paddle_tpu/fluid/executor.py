@@ -22,11 +22,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import registry as _obs
 from . import core, registry
 from .framework import Block, Program, Variable, default_main_program
 from .scope import Scope, global_scope
 
 logger = logging.getLogger(__name__)
+
+# executor telemetry: the compile cache is the recompile-storm tripwire
+# — a rising miss rate with a flat run rate means feed shapes/structure
+# keys churn (Operator Fusion in XLA, PAPERS.md) and every miss pays a
+# full XLA compile
+_EXEC_RUNS = _obs.counter(
+    "paddle_tpu_executor_runs_total",
+    "Executor.run invocations (one fused XLA step each)")
+_EXEC_CACHE_HITS = _obs.counter(
+    "paddle_tpu_executor_cache_hits_total",
+    "run() served by an already-compiled program signature")
+_EXEC_COMPILES = _obs.counter(
+    "paddle_tpu_executor_compiles_total",
+    "new program signatures traced+jitted (cache misses)")
+_EXEC_RUN_SECONDS = _obs.histogram(
+    "paddle_tpu_executor_run_seconds",
+    "wall time of Executor.run (incl. compile on a miss)")
 
 __all__ = ["Executor", "ExecContext", "global_scope", "scope_guard"]
 
@@ -157,6 +175,18 @@ class Executor:
             fetch_list: Sequence | None = None, scope: Scope | None = None,
             return_numpy: bool = True, use_program_cache: bool = True,
             use_prune: bool = False):
+        import time as _time
+        _EXEC_RUNS.inc()
+        t0 = _time.perf_counter()
+        try:
+            return self._run_impl(program, feed, fetch_list, scope,
+                                  return_numpy, use_program_cache,
+                                  use_prune)
+        finally:
+            _EXEC_RUN_SECONDS.observe(_time.perf_counter() - t0)
+
+    def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
+                  use_program_cache, use_prune):
         program = program if program is not None else default_main_program()
         # CompiledProgram.with_data_parallel → batch-axis sharding over the
         # mesh (replaces reference ParallelExecutor, parallel_executor.cc:443)
@@ -371,7 +401,9 @@ class Executor:
         fn = self._cache.get(sig)
         if fn is not None:
             self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
+            _EXEC_CACHE_HITS.inc()
             return fn
+        _EXEC_COMPILES.inc()
 
         is_test = program._is_test
         gb = program.global_block()

@@ -15,23 +15,71 @@ trace/first-call time from op kernels — Python side effects during a
 jax trace run exactly once per compilation, so the measurement cost is
 paid once per shape bucket, never per step.
 
+Every decision is also recorded as structured telemetry
+(paddle_tpu_autobench_* gauges on the process registry: candidate
+timings + a winner flag per shape key) and logged through the
+`paddle_tpu.autobench` logger — /metrics shows which kernel holds each
+hot path without scraping stderr.
+
 Env knobs:
   PADDLE_TPU_AUTOBENCH=0          disable measuring; `default` wins
   PADDLE_TPU_AUTOBENCH_FORCE=name force a candidate (debug/A-B runs)
-  PADDLE_TPU_AUTOBENCH_VERBOSE=1  print each decision to stderr
+  PADDLE_TPU_AUTOBENCH_VERBOSE=1  log-level switch: raises the
+                                  `paddle_tpu.autobench` logger to INFO
+                                  (with a stderr handler if the app
+                                  configured none)
 """
 from __future__ import annotations
 
+import logging
 import os
-import sys
 import threading
 import time
 from typing import Callable
+
+from ..observability import registry as _obs
 
 __all__ = ["prefer", "decisions", "clear"]
 
 _CACHE: dict = {}
 _LOCK = threading.Lock()
+
+logger = logging.getLogger("paddle_tpu.autobench")
+
+_CANDIDATE_MS = _obs.gauge(
+    "paddle_tpu_autobench_candidate_ms",
+    "measured median wall time per candidate per shape key",
+    ["key", "candidate"])
+_WINNER = _obs.gauge(
+    "paddle_tpu_autobench_winner",
+    "1 for the candidate holding the hot path of a shape key, else 0",
+    ["key", "candidate"])
+
+
+def _verbose_logging():
+    """PADDLE_TPU_AUTOBENCH_VERBOSE kept as a LOG-LEVEL switch: it used
+    to print to stderr; now it raises the module logger to INFO (adding
+    a stderr handler only when logging is unconfigured)."""
+    if not os.environ.get("PADDLE_TPU_AUTOBENCH_VERBOSE"):
+        return
+    if logger.getEffectiveLevel() > logging.INFO:
+        logger.setLevel(logging.INFO)
+    if not logger.handlers and not logging.getLogger().handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("[autobench] %(message)s"))
+        logger.addHandler(h)
+
+
+def _record_decision(key, winner: str, timings: dict[str, float]):
+    skey = str(key)
+    for name, t in timings.items():
+        _CANDIDATE_MS.labels(key=skey, candidate=name).set(
+            round(t * 1e3, 4) if t < float("inf") else float("inf"))
+        _WINNER.labels(key=skey, candidate=name).set(
+            1.0 if name == winner else 0.0)
+    _verbose_logging()
+    ms = {k: round(v * 1e3, 3) for k, v in timings.items()}
+    logger.info("%s -> %s %s", skey, winner, ms)
 
 
 def _measure(fn: Callable, make_args: Callable, reps: int) -> float:
@@ -87,9 +135,7 @@ def prefer(key, candidates: dict[str, Callable], make_args: Callable,
         # a racing thread may have decided already; first one wins so the
         # process is consistent
         winner = _CACHE.setdefault(key, winner)
-    if os.environ.get("PADDLE_TPU_AUTOBENCH_VERBOSE"):
-        ms = {k: round(v * 1e3, 3) for k, v in timings.items()}
-        print(f"[autobench] {key} -> {winner} {ms}", file=sys.stderr)
+    _record_decision(key, winner, timings)
     return winner
 
 

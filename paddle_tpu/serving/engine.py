@@ -25,17 +25,64 @@ engine's own scheduler thread (`start()`).
 """
 from __future__ import annotations
 
+import itertools
 import math
 import threading
 import time
+import weakref
 from collections import defaultdict, deque
 
 import numpy as np
 
+from ..observability import registry as _obs, tracing as _tracing
 from .kv_cache import PagePool, defrag_plan
 from .scheduler import QueueFull, Request, Scheduler
 
 __all__ = ["Engine", "QueueFull"]
+
+# engine telemetry (labeled per engine instance; the scheduler/pool
+# series share the same label value). Hot-path writes are counter incs
+# and histogram observes around the jitted calls — host-side
+# microseconds against millisecond steps (<2% bar held by the
+# metrics_overhead microbench).
+_REQS = _obs.counter(
+    "paddle_tpu_serving_requests_total",
+    "requests submitted to the engine", ["engine"])
+_TOKENS = _obs.counter(
+    "paddle_tpu_serving_tokens_total",
+    "tokens generated (prefill first tokens + decode)", ["engine"],
+    always=True)  # backs stats()["tokens_generated"]
+_STEPS = _obs.counter(
+    "paddle_tpu_serving_steps_total",
+    "decode scheduler iterations that ran the slot batch", ["engine"],
+    always=True)  # backs stats()["steps"]
+_COMPILES = _obs.counter(
+    "paddle_tpu_serving_compiles_total",
+    "XLA trace events per program bucket (trace-time side effect)",
+    ["engine", "bucket"])
+_DECODE_H = _obs.histogram(
+    "paddle_tpu_serving_decode_step_seconds",
+    "wall time of one jitted decode over the slot batch", ["engine"])
+_PREFILL_H = _obs.histogram(
+    "paddle_tpu_serving_prefill_seconds",
+    "wall time of one jitted prefill (admission)", ["engine"])
+_LATENCY_H = _obs.histogram(
+    "paddle_tpu_serving_request_latency_seconds",
+    "submit-to-finish latency per request", ["engine"])
+_QUEUE_DEPTH = _obs.gauge(
+    "paddle_tpu_serving_queue_depth",
+    "requests waiting for admission (live)", ["engine"])
+_OCCUPANCY = _obs.gauge(
+    "paddle_tpu_serving_page_occupancy",
+    "fraction of KV pages in use (live)", ["engine"])
+
+_engine_ids = itertools.count()
+
+
+def _drop_engine_series(eid: str):
+    for m in (_REQS, _TOKENS, _STEPS, _COMPILES, _DECODE_H, _PREFILL_H,
+              _LATENCY_H, _QUEUE_DEPTH, _OCCUPANCY):
+        m.remove_matching(engine=eid)
 
 
 def _bucket_len(n: int, page_size: int) -> int:
@@ -72,17 +119,36 @@ class Engine:
         self.max_pages_per_req = max(
             1, min(num_pages, self.max_seq_len // page_size))
         self.num_slots = num_slots
-        self.pool = PagePool(num_pages, page_size)
+        self.engine_id = f"e{next(_engine_ids)}"
+        self.pool = PagePool(num_pages, page_size, inst=self.engine_id)
         self.scheduler = Scheduler(self.pool, num_slots, self.max_seq_len,
-                                   max_queue=max_queue)
+                                   max_queue=max_queue,
+                                   inst=self.engine_id)
         self.trash_page = num_pages      # model pools carry P+1 pages
         self.cache = model.init_cache(num_pages, page_size)
 
         self._compiles: dict[str, int] = defaultdict(int)
         self._latencies: deque[float] = deque(maxlen=4096)
         self._tok_window: deque[tuple[float, int]] = deque(maxlen=512)
-        self._tokens_total = 0
-        self._steps = 0
+        # registry series for this engine (stats() reads these back)
+        eid = self.engine_id
+        self._m_reqs = _REQS.labels(engine=eid)
+        self._m_tokens = _TOKENS.labels(engine=eid)
+        self._m_steps = _STEPS.labels(engine=eid)
+        self._m_decode_h = _DECODE_H.labels(engine=eid)
+        self._m_prefill_h = _PREFILL_H.labels(engine=eid)
+        self._m_latency_h = _LATENCY_H.labels(engine=eid)
+        # live gauges read through a weakref so the registry never pins
+        # a dead engine (tests build hundreds per process)
+        wr = weakref.ref(self)
+        _QUEUE_DEPTH.labels(engine=eid).set_function(
+            lambda: (lambda e: e.scheduler.queue_depth if e else 0.0)(
+                wr()))
+        _OCCUPANCY.labels(engine=eid).set_function(
+            lambda: (lambda e: e.pool.occupancy if e else 0.0)(wr()))
+        # a dead engine's series (incl. the weakref gauges, which would
+        # otherwise report 0.0 forever) leave the exposition
+        weakref.finalize(self, _drop_engine_series, eid)
         self._lock = threading.Lock()    # step loop exclusivity
         self._stats_lock = threading.Lock()  # deque append vs snapshot
         self._wake = threading.Event()
@@ -95,15 +161,21 @@ class Engine:
         S, M = num_slots, self.max_pages_per_req
         compiles = self._compiles
 
+        def note_compile(bucket: str):
+            # Python side effect inside the traced fn: runs once per
+            # actual XLA trace, so this counts COMPILES, not steps
+            compiles[bucket] += 1
+            _COMPILES.labels(engine=eid, bucket=bucket).inc()
+
         def prefill(params, cache, tokens, true_len, page_row):
-            compiles[f"prefill[{tokens.shape[0]}]"] += 1  # trace-time
+            note_compile(f"prefill[{tokens.shape[0]}]")  # trace-time
             cache, logits = model.prefill(params, cache, tokens,
                                           true_len, page_row)
             import jax.numpy as jnp
             return cache, jnp.argmax(logits, -1).astype(jnp.int32)
 
         def decode(params, cache, tokens, positions, tables):
-            compiles[f"decode[slots={S},pages={M}]"] += 1  # trace-time
+            note_compile(f"decode[slots={S},pages={M}]")  # trace-time
             cache, logits = model.decode(params, cache, tokens,
                                          positions, tables)
             import jax.numpy as jnp
@@ -123,7 +195,12 @@ class Engine:
                       deadline=None if deadline is None
                       else time.monotonic() + deadline,
                       eos_id=eos_id if eos_id is not None else self.eos_id)
+        # carry the caller's trace context (e.g. the frontend handler's
+        # wire trace id) onto the request so engine-side spans for it
+        # correlate across threads
+        req.trace_id = _tracing.TRACER.current_trace_id()
         self.scheduler.submit(req)
+        self._m_reqs.inc()
         self._wake.set()
         return req
 
@@ -148,12 +225,18 @@ class Engine:
         T = min(T, self.max_pages_per_req * self.page_size)
         toks = np.zeros((T,), np.int32)
         toks[:req.prompt.size] = req.prompt
-        self.cache, tok = self._prefill(
-            self.model.params, self.cache, jnp.asarray(toks),
-            np.int32(req.prompt.size), jnp.asarray(self._row(req),
-                                                   dtype=jnp.int32))
+        t0 = time.perf_counter()
+        with _tracing.span("engine.prefill", trace_id=req.trace_id,
+                           engine=self.engine_id, request=req.id,
+                           prompt_len=int(req.prompt.size), bucket=T):
+            self.cache, tok = self._prefill(
+                self.model.params, self.cache, jnp.asarray(toks),
+                np.int32(req.prompt.size), jnp.asarray(self._row(req),
+                                                       dtype=jnp.int32))
+            tok = int(tok)
+        self._m_prefill_h.observe(time.perf_counter() - t0)
         self._note_tokens(1)
-        if self.scheduler.record_token(req, int(tok)):
+        if self.scheduler.record_token(req, tok):
             self._note_done(req)
 
     def step(self) -> bool:
@@ -186,10 +269,16 @@ class Engine:
                 positions[i] = r.position
                 tables[i] = self._row(r)
             try:
-                self.cache, next_toks = self._decode(
-                    self.model.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(positions), jnp.asarray(tables))
-                next_toks = np.asarray(next_toks)
+                t0 = time.perf_counter()
+                with _tracing.span("engine.decode",
+                                   engine=self.engine_id,
+                                   active=len(active)):
+                    self.cache, next_toks = self._decode(
+                        self.model.params, self.cache,
+                        jnp.asarray(tokens), jnp.asarray(positions),
+                        jnp.asarray(tables))
+                    next_toks = np.asarray(next_toks)
+                self._m_decode_h.observe(time.perf_counter() - t0)
             except Exception as e:
                 # a decode-step failure poisons the whole slot batch (the
                 # cache buffer may be donated/invalid): fail the in-flight
@@ -201,7 +290,7 @@ class Engine:
                 self._recover_cache("failed decode")
                 raise
             self._note_tokens(len(active))
-            self._steps += 1
+            self._m_steps.inc()
             for i, r in active:
                 if self.scheduler.record_token(r, int(next_toks[i])):
                     self._note_done(r)
@@ -284,13 +373,14 @@ class Engine:
 
     # -- stats ---------------------------------------------------------
     def _note_tokens(self, n: int):
+        self._m_tokens.inc(n)
         with self._stats_lock:
-            self._tokens_total += n
             self._tok_window.append((time.monotonic(), n))
 
     def _note_done(self, req: Request):
         lat = req.latency()
         if lat is not None:
+            self._m_latency_h.observe(lat)
             with self._stats_lock:
                 self._latencies.append(lat)
 
@@ -300,7 +390,7 @@ class Engine:
         with self._stats_lock:  # the step thread appends concurrently
             lats = sorted(self._latencies)
             w = list(self._tok_window)
-            total = self._tokens_total
+        total = int(self._m_tokens.value)
 
         def pct(p):
             if not lats:
@@ -313,7 +403,7 @@ class Engine:
             tps = sum(n for _, n in w[1:]) / (w[-1][0] - w[0][0])
         return {**self.scheduler.stats(),
                 "pool": self.pool.stats(),
-                "steps": self._steps,
+                "steps": int(self._m_steps.value),
                 "tokens_generated": total,
                 "tokens_per_sec": round(tps, 2),
                 "latency_ms_p50": pct(50), "latency_ms_p99": pct(99),

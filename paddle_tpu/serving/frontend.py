@@ -21,6 +21,8 @@ Ops:
     ever fetch.
   {"op": "stats"} -> engine.stats()   (queue depth, p50/p99, tokens/s,
     pool occupancy, preemptions, compile counters)
+  {"op": "metrics"} -> Prometheus text over the process-wide telemetry
+    registry (docs/OBSERVABILITY.md) — the serving scrape point
   {"op": "ping"}  -> True
 
 In-process use (tests, co-located workers) needs none of this — call
@@ -35,6 +37,7 @@ import numpy as np
 
 from ..distributed.fleet.runtime.rpc import (RpcClient, RpcServerState,
                                              serve_connection)
+from ..observability import registry as _obs, tracing as _tracing
 from .scheduler import QueueFull
 
 __all__ = ["ServingServer", "ServingClient"]
@@ -44,7 +47,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    READ_OPS = frozenset({"stats", "ping"})
+    READ_OPS = frozenset({"stats", "ping", "metrics"})
 
     def __init__(self, engine, endpoint: str = "127.0.0.1:0",
                  secret: str | None = None,
@@ -92,35 +95,52 @@ class ServingServer(socketserver.ThreadingTCPServer):
             return True
         if op == "stats":
             return self.engine.stats()
+        if op == "metrics":
+            # Prometheus exposition over the whole process registry —
+            # scrape point for the serving tier (docs/OBSERVABILITY.md)
+            return _obs.prometheus_text()
         if op == "generate":
             prompt = np.asarray(req["prompt"], np.int32)
-            try:
-                h = self.engine.submit(
-                    prompt, int(req.get("max_new_tokens", 16)),
-                    deadline=req.get("deadline"))
-            except QueueFull as e:
-                return {"status": "rejected", "error": str(e)}
-            except ValueError as e:
-                return {"status": "error", "error": str(e)}
-            timeout = float(req.get("timeout") or self.default_timeout)
-            if not h.wait(timeout):
-                # the reply gets dedup-cached, so the request must not
-                # keep decoding tokens nobody can ever retrieve: cancel
-                # it (frees slot+pages) and return the partial output.
-                # cancel() can lose the race to completion — fall
-                # through to the finished result in that case.
-                if self.engine.cancel(h):
-                    return {"status": "timeout",
-                            "tokens": np.asarray(h.generated, np.int32),
-                            "error": f"not finished within {timeout}s; "
-                                     "request cancelled"}
-            if h.status == "error":
-                return {"status": "error", "error": h.error or "failed"}
-            return {"status": h.status,
-                    "tokens": np.asarray(h.generated, np.int32),
-                    "prompt_len": int(prompt.size),
-                    "latency_ms": round((h.latency() or 0.0) * 1e3, 3)}
+            # serve_connection already opened a span rooted at the wire
+            # trace id; this child span marks the frontend tier and the
+            # engine.submit inside it stamps the id onto the request
+            with _tracing.span("frontend.generate",
+                               prompt_len=int(prompt.size)) as sp:
+                try:
+                    h = self.engine.submit(
+                        prompt, int(req.get("max_new_tokens", 16)),
+                        deadline=req.get("deadline"))
+                except QueueFull as e:
+                    sp.attrs["status"] = "rejected"
+                    return {"status": "rejected", "error": str(e)}
+                except ValueError as e:
+                    sp.attrs["status"] = "error"
+                    return {"status": "error", "error": str(e)}
+                out = self._await_result(req, h)
+                sp.attrs["status"] = out.get("status")
+                return out
+
         raise ValueError(f"unknown op {op!r}")
+
+    def _await_result(self, req: dict, h):
+        timeout = float(req.get("timeout") or self.default_timeout)
+        if not h.wait(timeout):
+            # the reply gets dedup-cached, so the request must not
+            # keep decoding tokens nobody can ever retrieve: cancel
+            # it (frees slot+pages) and return the partial output.
+            # cancel() can lose the race to completion — fall
+            # through to the finished result in that case.
+            if self.engine.cancel(h):
+                return {"status": "timeout",
+                        "tokens": np.asarray(h.generated, np.int32),
+                        "error": f"not finished within {timeout}s; "
+                                 "request cancelled"}
+        if h.status == "error":
+            return {"status": "error", "error": h.error or "failed"}
+        return {"status": h.status,
+                "tokens": np.asarray(h.generated, np.int32),
+                "prompt_len": int(h.prompt.size),
+                "latency_ms": round((h.latency() or 0.0) * 1e3, 3)}
 
 
 class ServingClient:
@@ -137,6 +157,10 @@ class ServingClient:
 
     def stats(self) -> dict:
         return self._rpc.call({"op": "stats"})
+
+    def metrics(self) -> str:
+        """Prometheus text from the serving process's registry."""
+        return self._rpc.call({"op": "metrics"})
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,

@@ -20,10 +20,36 @@ remains inside the jitted step.
 """
 from __future__ import annotations
 
+import itertools
 import math
 import threading
+import weakref
+
+from ..observability import registry as _obs
 
 __all__ = ["PagePool", "PageTable", "pages_needed", "defrag_plan"]
+
+# page accounting on the process-wide registry (labeled per pool
+# instance); PagePool.stats() keys are unchanged — they now READ these
+# (always=True: the legacy counters must keep counting even when the
+# telemetry kill switch is on)
+_PAGE_ALLOCS = _obs.counter(
+    "paddle_tpu_serving_pages_alloc_total",
+    "pages handed out by the pool", ["pool"], always=True)
+_PAGE_FREES = _obs.counter(
+    "paddle_tpu_serving_pages_freed_total",
+    "pages returned to the pool", ["pool"], always=True)
+_PAGE_ALLOC_FAILURES = _obs.counter(
+    "paddle_tpu_serving_page_alloc_failures_total",
+    "allocations refused for lack of free pages", ["pool"],
+    always=True)
+
+_pool_ids = itertools.count()
+
+
+def _drop_pool_series(inst: str):
+    for m in (_PAGE_ALLOCS, _PAGE_FREES, _PAGE_ALLOC_FAILURES):
+        m.remove_matching(pool=inst)
 
 
 def pages_needed(total_tokens: int, page_size: int) -> int:
@@ -58,17 +84,38 @@ class PagePool:
     threads ask `can_admit` for backpressure decisions.
     """
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 inst: str | None = None):
         if num_pages <= 0 or page_size <= 0:
             raise ValueError("num_pages and page_size must be positive")
         self.num_pages = num_pages
         self.page_size = page_size
         self._lock = threading.Lock()
         self._free = list(range(num_pages - 1, -1, -1))  # pop() -> low idx
-        # stats
-        self.alloc_count = 0
-        self.free_count = 0
-        self.alloc_failures = 0
+        # stats — registry-backed series labeled per pool instance
+        # (`inst` lets an Engine align the pool's label with its own)
+        self.inst = inst if inst is not None else f"p{next(_pool_ids)}"
+        self._m_allocs = _PAGE_ALLOCS.labels(pool=self.inst)
+        self._m_frees = _PAGE_FREES.labels(pool=self.inst)
+        self._m_alloc_failures = _PAGE_ALLOC_FAILURES.labels(
+            pool=self.inst)
+        # a dead pool's series leave the exposition (else a process
+        # that churns pools grows its /metrics forever)
+        weakref.finalize(self, _drop_pool_series, self.inst)
+
+    # legacy counter attributes (PR-2 stats surface) now read the
+    # registry series
+    @property
+    def alloc_count(self) -> int:
+        return int(self._m_allocs.value)
+
+    @property
+    def free_count(self) -> int:
+        return int(self._m_frees.value)
+
+    @property
+    def alloc_failures(self) -> int:
+        return int(self._m_alloc_failures.value)
 
     # -- capacity ------------------------------------------------------
     @property
@@ -95,11 +142,11 @@ class PagePool:
         """n pages, or None (and no partial allocation) if unavailable."""
         with self._lock:
             if n > len(self._free):
-                self.alloc_failures += 1
+                self._m_alloc_failures.inc()
                 return None
             got = [self._free.pop() for _ in range(n)]
-            self.alloc_count += n
-            return got
+        self._m_allocs.inc(n)
+        return got
 
     def alloc_table(self, total_tokens: int) -> PageTable | None:
         pages = self.alloc(pages_needed(total_tokens, self.page_size))
@@ -120,7 +167,7 @@ class PagePool:
                 if p in live:
                     raise ValueError(f"double free of page {p}")
             self._free.extend(sorted(pages, reverse=True))
-            self.free_count += len(pages)
+        self._m_frees.inc(len(pages))
         if isinstance(table_or_pages, PageTable):
             table_or_pages.pages = []
 

@@ -25,13 +25,43 @@ from __future__ import annotations
 import itertools
 import threading
 import time
+import weakref
 from collections import deque
 
 import numpy as np
 
+from ..observability import registry as _obs
 from .kv_cache import PagePool
 
 __all__ = ["Request", "Scheduler", "QueueFull"]
+
+# lifecycle counters on the process-wide registry, labeled per scheduler
+# instance; Scheduler.stats() keys are unchanged — they now READ these
+# (always=True: legacy surface must keep counting under the telemetry
+# kill switch)
+_ADMITTED = _obs.counter(
+    "paddle_tpu_serving_admitted_total",
+    "requests admitted into a slot", ["inst"], always=True)
+_COMPLETED = _obs.counter(
+    "paddle_tpu_serving_completed_total",
+    "requests finished with status done", ["inst"], always=True)
+_PREEMPTED = _obs.counter(
+    "paddle_tpu_serving_preempted_total",
+    "running requests preempted by a deadline", ["inst"], always=True)
+_REJECTED = _obs.counter(
+    "paddle_tpu_serving_rejected_total",
+    "submits rejected by queue backpressure", ["inst"], always=True)
+_EVICTIONS = _obs.counter(
+    "paddle_tpu_serving_evictions_total",
+    "requests leaving the slot table / queue, by reason",
+    ["inst", "reason"])
+
+_sched_ids = itertools.count()
+
+
+def _drop_sched_series(inst: str):
+    for m in (_ADMITTED, _COMPLETED, _PREEMPTED, _REJECTED, _EVICTIONS):
+        m.remove_matching(inst=inst)
 
 
 class QueueFull(RuntimeError):
@@ -59,6 +89,7 @@ class Request:
             raise ValueError("max_new_tokens must be >= 1")
         self.deadline = deadline
         self.eos_id = eos_id
+        self.trace_id: str | None = None  # set by Engine.submit
         self.generated: list[int] = []
         self.status = "queued"
         self.error: str | None = None
@@ -106,7 +137,7 @@ class Scheduler:
 
     def __init__(self, pool: PagePool, num_slots: int,
                  max_seq_len: int, max_queue: int = 256,
-                 now=time.monotonic):
+                 now=time.monotonic, inst: str | None = None):
         self.pool = pool
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
@@ -115,11 +146,33 @@ class Scheduler:
         self.slots: list[Request | None] = [None] * num_slots
         self.queue: deque[Request] = deque()
         self._lock = threading.Lock()
-        # counters (engine /stats)
-        self.admitted = 0
-        self.completed = 0
-        self.preemptions = 0
-        self.rejected = 0
+        # counters (engine /stats) — registry-backed, labeled per
+        # instance (`inst` lets the Engine align the label with its own)
+        self.inst = inst if inst is not None else f"s{next(_sched_ids)}"
+        self._m_admitted = _ADMITTED.labels(inst=self.inst)
+        self._m_completed = _COMPLETED.labels(inst=self.inst)
+        self._m_preempted = _PREEMPTED.labels(inst=self.inst)
+        self._m_rejected = _REJECTED.labels(inst=self.inst)
+        # a dead scheduler's series leave the exposition
+        weakref.finalize(self, _drop_sched_series, self.inst)
+
+    # legacy counter attributes (PR-2 stats surface) now read the
+    # registry series
+    @property
+    def admitted(self) -> int:
+        return int(self._m_admitted.value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._m_completed.value)
+
+    @property
+    def preemptions(self) -> int:
+        return int(self._m_preempted.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._m_rejected.value)
 
     # -- queue side (frontend threads) ---------------------------------
     def submit(self, req: Request) -> Request:
@@ -129,7 +182,7 @@ class Scheduler:
                 f"max_seq_len {self.max_seq_len}")
         with self._lock:
             if len(self.queue) >= self.max_queue:
-                self.rejected += 1
+                self._m_rejected.inc()
                 raise QueueFull(
                     f"queue at capacity ({self.max_queue}); retry later")
             self.queue.append(req)
@@ -165,7 +218,7 @@ class Scheduler:
         for i, r in enumerate(self.slots):
             if r is not None and r.deadline is not None and t > r.deadline:
                 self.slots[i] = None
-                self.preemptions += 1
+                self._m_preempted.inc()
                 hit.append(r)
         for r in hit:
             self._finish(r, "deadline")
@@ -193,7 +246,7 @@ class Scheduler:
             head.status = "running"
             head.started_at = self.now()
             self.slots[i] = head
-            self.admitted += 1
+            self._m_admitted.inc()
             out.append(head)
         return out
 
@@ -228,7 +281,7 @@ class Scheduler:
             self.slots[req.slot] = None
         self._finish(req, status)
         if status == "done":
-            self.completed += 1
+            self._m_completed.inc()
 
     def _finish(self, req: Request, status: str):
         if req.table is not None:
@@ -236,6 +289,7 @@ class Scheduler:
             req.table = None
         req.status = status
         req.finished_at = self.now()
+        _EVICTIONS.labels(inst=self.inst, reason=status).inc()
         req._done.set()
 
     def stats(self) -> dict:

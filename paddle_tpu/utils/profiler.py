@@ -3,6 +3,15 @@
 TPU-native: jax.profiler (XPlane) traces device + host; op-phase markers come
 from the executor's jax.named_scope per op (replacing RecordEvent RAII at
 framework/operator.cc:984). View with TensorBoard or Perfetto.
+
+Version tolerance: older jax builds ship a ``jax.profiler`` missing
+``start_trace``/``stop_trace``/``TraceAnnotation`` (or no ``profiler``
+attr at all). Every wrapper here degrades to a graceful no-op in that
+case — the per-op host report still works, only the XPlane trace is
+skipped. ``RecordEvent`` now also records a host span into
+``paddle_tpu.observability.tracing`` (same bounded ring the serving/PS
+tiers write), so marker events land in the Chrome trace export next to
+the engine/rpc spans.
 """
 from __future__ import annotations
 
@@ -12,10 +21,19 @@ import time
 
 import jax
 
+from ..observability import tracing as _tracing
+
 __all__ = ["Profiler", "profiler", "start_profiler", "stop_profiler",
            "RecordEvent", "op_profile_report"]
 
 _trace_dir = None
+_trace_started = False
+
+
+def _prof_attr(name: str):
+    """jax.profiler.<name>, or None when jax/profiler lacks it (older
+    jax) — callers no-op instead of raising AttributeError."""
+    return getattr(getattr(jax, "profiler", None), name, None)
 
 
 # ---------------------------------------------------------------------------
@@ -76,17 +94,23 @@ def op_profile_report(sorted_key="total") -> str:
 
 def start_profiler(state="All", tracer_option="Default",
                    trace_dir="/tmp/paddle_tpu_trace"):
-    global _trace_dir
+    global _trace_dir, _trace_started
     _op_stats.clear()
     _hook_tracer()
     _trace_dir = trace_dir
     os.makedirs(trace_dir, exist_ok=True)
-    jax.profiler.start_trace(trace_dir)
+    start = _prof_attr("start_trace")
+    if start is not None:  # older jax: host-side report only
+        start(trace_dir)
+        _trace_started = True
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    global _trace_dir
-    jax.profiler.stop_trace()
+    global _trace_dir, _trace_started
+    stop = _prof_attr("stop_trace")
+    if stop is not None and _trace_started:
+        stop()
+    _trace_started = False
     out = _trace_dir
     _trace_dir = None
     if _op_stats:
@@ -113,19 +137,28 @@ def profiler(state="All", sorted_key=None, profile_path=None,
 
 
 class RecordEvent:
-    """Host event marker (reference platform/profiler.h:126)."""
+    """Host event marker (reference platform/profiler.h:126).
+
+    Backed by observability.tracing: records a host span (Chrome trace
+    export) AND enters jax.profiler.TraceAnnotation when this jax has
+    it, so the marker shows up in the XPlane device trace too. On older
+    jax without TraceAnnotation the span alone is recorded — no-op
+    degradation instead of AttributeError."""
 
     def __init__(self, name: str):
         self.name = name
         self._cm = None
 
     def __enter__(self):
-        self._cm = jax.profiler.TraceAnnotation(self.name)
+        self._cm = _tracing.span(self.name)
         self._cm.__enter__()
         return self
 
     def __exit__(self, *exc):
-        return self._cm.__exit__(*exc)
+        if self._cm is None:
+            return False
+        cm, self._cm = self._cm, None
+        return cm.__exit__(*(exc or (None, None, None)))
 
     begin = __enter__
 

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static check: every metric registered under paddle_tpu/ has a
+well-formed name and exactly one registration site.
+
+The telemetry registry (paddle_tpu/observability/registry.py) enforces
+naming at runtime, but only for code paths a test actually imports; a
+misnamed metric in a rarely-exercised tier would ship silently. This
+AST pass finds every ``counter("…")`` / ``gauge("…")`` /
+``histogram("…")`` call (bare name, attribute form like
+``_obs.counter`` / ``REGISTRY.gauge``, any alias) whose first argument
+is a string literal and enforces:
+
+  * names are snake_case with a ``paddle_tpu_`` prefix
+    (``^paddle_tpu_[a-z][a-z0-9_]*$``);
+  * no duplicate registrations — a metric name is declared at exactly
+    ONE site in the tree, so two modules can never fight over the same
+    series with different help strings/labels (the runtime registry
+    would raise only if the kinds/labels conflict; the static rule is
+    stricter on purpose).
+
+Usage: check_metric_names.py [root_dir]   (default:
+<repo>/paddle_tpu). Exits 1 listing offending file:line sites. Run by
+the test suite (tests/test_observability.py), like
+check_no_wire_pickle.py.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REGISTER_FUNCS = {"counter", "gauge", "histogram"}
+NAME_RE = re.compile(r"^paddle_tpu_[a-z][a-z0-9_]*$")
+# the registry's own implementation/docs mention registration calls in
+# prose/examples; skip only files that themselves DEFINE the helpers
+SKIP_FILES = {os.path.join("observability", "registry.py"),
+              os.path.join("observability", "__init__.py")}
+
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def check_file(path: str) -> tuple[list[tuple[int, str]],
+                                   list[tuple[str, int]]]:
+    """(violations, registrations): violations are (line, message);
+    registrations are (metric_name, line) for the duplicate pass."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"unparseable: {e.msg}")], []
+    bad: list[tuple[int, str]] = []
+    regs: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node) not in REGISTER_FUNCS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)):
+            continue
+        name = first.value
+        if not NAME_RE.match(name):
+            bad.append((node.lineno,
+                        f"metric name {name!r} must match "
+                        f"{NAME_RE.pattern}"))
+        else:
+            regs.append((name, node.lineno))
+    return bad, regs
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) > 1:
+        root = argv[1]
+    else:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        root = os.path.join(repo, "paddle_tpu")
+    violations: list[str] = []
+    sites: dict[str, list[str]] = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            if rel in SKIP_FILES:
+                continue
+            bad, regs = check_file(path)
+            for lineno, what in bad:
+                violations.append(f"{path}:{lineno}: {what}")
+            for name, lineno in regs:
+                sites.setdefault(name, []).append(f"{path}:{lineno}")
+    for name, where in sorted(sites.items()):
+        if len(where) > 1:
+            violations.append(
+                f"duplicate registration of {name!r} at "
+                + ", ".join(where))
+    if violations:
+        print(f"metric naming violations under {root} "
+              "(see docs/OBSERVABILITY.md naming scheme):")
+        print("\n".join(violations))
+        return 1
+    print(f"OK: {sum(len(w) for w in sites.values())} metric "
+          f"registrations under {root} are well-named and unique")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

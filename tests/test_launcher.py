@@ -117,3 +117,33 @@ def test_fleetrun_ps_mode_env(tmp_path):
     workers = [v for k, v in logs.items() if k.startswith("worker.")]
     assert len(workers) == 2
     assert all("TRAINER" in w for w in workers)
+
+
+def test_launch_metrics_dir_collects_per_process_dumps(tmp_path):
+    """--metrics_dir: every child dumps its registry at exit and the
+    aggregator merges them (counters sum across processes)."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "from paddle_tpu import observability as obs\n"
+        "c = obs.counter('paddle_tpu_launchtest_units_total', 'u')\n"
+        "c.inc(2)\n"
+        "print('worker done', flush=True)\n")
+    mdir = tmp_path / "metrics"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--started_port={_free_port()}",
+         "--metrics_dir", str(mdir),
+         "--log_dir", str(tmp_path / "logs"), str(worker)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr
+    dumps = [f for f in os.listdir(mdir)
+             if f.startswith("metrics_") and f.endswith(".json")]
+    assert len(dumps) == 2, dumps
+    from paddle_tpu.observability import aggregate_dir
+    agg = aggregate_dir(str(mdir))
+    by_name = {m["name"]: m for m in agg["metrics"]}
+    rec = by_name["paddle_tpu_launchtest_units_total"]
+    assert rec["samples"][0]["value"] == 4  # 2 processes x inc(2)
