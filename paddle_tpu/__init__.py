@@ -54,6 +54,7 @@ from . import text
 from . import jit
 from . import incubate
 from . import observability
+from . import checkpoint
 from . import utils
 from . import models
 from . import ops as _pallas_ops  # pallas kernels register themselves

@@ -1,0 +1,35 @@
+"""paddle_tpu.checkpoint — crash-consistent checkpoint substrate.
+
+One store for every state owner in the framework (the Orbax/
+TensorStore role for this stack): content-addressed chunks + CRC'd
+JSON manifests committed by atomic rename (store.py / chunks.py /
+manifest.py), async save that never blocks the step, row-level WAL
+journaling for the PS tier (wal.py), and resharding-aware restore.
+Consumers: fluid/io.py save/load_persistables and static save/load
+(behind ``PADDLE_TPU_CKPT``), hapi.Model.save/load, the serving
+engine's manifest warm-start, and PSServer's ``PADDLE_PS_WAL`` tier.
+
+Format and threat model: docs/CHECKPOINT.md. No pickle on any restore
+path (enforced by scripts/check_no_wire_pickle.py).
+"""
+from .chunks import ChunkError, ChunkStore
+from .manifest import (ManifestError, commit_manifest, list_manifests,
+                       load_latest, load_manifest)
+from .store import DEFAULT_CHUNK_BYTES, CheckpointStore, ShardedArray
+from .wal import RowJournal, committed_length, replay_file
+
+__all__ = [
+    "CheckpointStore", "ShardedArray", "ChunkStore", "ChunkError",
+    "RowJournal", "replay_file", "committed_length", "ManifestError",
+    "commit_manifest", "load_manifest", "load_latest",
+    "list_manifests", "DEFAULT_CHUNK_BYTES", "enabled",
+]
+
+
+def enabled() -> bool:
+    """Is the store-format routing for fluid/hapi save paths on
+    (``PADDLE_TPU_CKPT``)? Load paths auto-detect the format instead
+    of consulting this, so legacy files stay readable either way."""
+    import os
+    return os.environ.get("PADDLE_TPU_CKPT", "") not in ("", "0",
+                                                         "false")

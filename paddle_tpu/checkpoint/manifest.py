@@ -1,0 +1,124 @@
+"""CRC'd JSON manifests with atomic rename commit.
+
+A manifest is the unit of checkpoint visibility: it names every array
+(dtype, shape) and the content-addressed chunks that reassemble it.
+The COMMIT POINT of a save is the ``os.replace`` that puts
+``manifest-<step>.json`` at its final name — chunks land first, the
+manifest rename is last, so a crash at ANY byte of the save leaves the
+previous committed manifest fully intact (the kill-mid-save test pins
+this bit-for-bit).
+
+File layout (pure JSON, no pickle — the restore path is scanned by
+scripts/check_no_wire_pickle.py):
+
+    {"format": "paddle-tpu-ckpt-v1", "crc32": <crc of canonical
+     payload JSON>, "payload": {"step": N, "meta": {...},
+     "arrays": {name: {"dtype", "shape", "nbytes",
+                       "chunks": [{"h", "o", "n"}, ...]}}}}
+
+``load_latest`` scans newest-first and skips unreadable / CRC-bad
+files, so a torn manifest (crash mid-fsync on a weird filesystem, or
+plain disk corruption) degrades to the previous committed step instead
+of a failed restore.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+from ..observability import registry as _obs
+
+__all__ = ["ManifestError", "commit_manifest", "load_manifest",
+           "list_manifests", "load_latest", "manifest_path"]
+
+FORMAT = "paddle-tpu-ckpt-v1"
+_PREFIX, _SUFFIX = "manifest-", ".json"
+
+_COMMITS = _obs.counter(
+    "paddle_tpu_ckpt_manifests_committed_total",
+    "checkpoint manifests atomically committed")
+
+
+class ManifestError(RuntimeError):
+    """No committed manifest, or the named one is unreadable."""
+
+
+def _canonical(payload: dict) -> bytes:
+    return json.dumps(payload, sort_keys=True,
+                      separators=(",", ":")).encode("utf-8")
+
+
+def manifest_path(root: str, step: int) -> str:
+    return os.path.join(root, f"{_PREFIX}{step:010d}{_SUFFIX}")
+
+
+def commit_manifest(root: str, payload: dict) -> str:
+    """Atomically commit ``payload`` as step ``payload['step']``.
+    Write tmp → fsync → rename; the rename IS the commit."""
+    step = int(payload["step"])
+    path = manifest_path(root, step)
+    body = _canonical(payload)
+    doc = json.dumps({"format": FORMAT,
+                      "crc32": zlib.crc32(body) & 0xFFFFFFFF,
+                      "payload": payload}).encode("utf-8")
+    os.makedirs(root, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(doc)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    from .chunks import _BYTES_WRITTEN
+    _BYTES_WRITTEN.labels(tier="manifest").inc(len(doc))
+    _COMMITS.inc()
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    """Parse + CRC-validate one manifest file; returns the payload."""
+    with open(path, "rb") as f:
+        doc = json.loads(f.read().decode("utf-8"))
+    if doc.get("format") != FORMAT:
+        raise ManifestError(f"{path}: not a {FORMAT} manifest")
+    payload = doc["payload"]
+    crc = zlib.crc32(_canonical(payload)) & 0xFFFFFFFF
+    if crc != int(doc.get("crc32", -1)):
+        raise ManifestError(f"{path}: CRC mismatch "
+                            f"(stored {doc.get('crc32')}, computed {crc})")
+    return payload
+
+
+def list_manifests(root: str) -> list[tuple[int, str]]:
+    """(step, path) of every committed manifest, ascending by step."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except FileNotFoundError:
+        return []
+    for fn in names:
+        if fn.startswith(_PREFIX) and fn.endswith(_SUFFIX):
+            try:
+                out.append((int(fn[len(_PREFIX):-len(_SUFFIX)]),
+                            os.path.join(root, fn)))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def load_latest(root: str, step: int | None = None) -> dict:
+    """Newest valid manifest (or the exact ``step``). Unreadable or
+    CRC-bad files are skipped — restore degrades to the last committed
+    step rather than failing on a corrupt newest file."""
+    if step is not None:
+        return load_manifest(manifest_path(root, step))
+    errors = []
+    for s, path in reversed(list_manifests(root)):
+        try:
+            return load_manifest(path)
+        except (ManifestError, OSError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+    raise ManifestError(
+        f"no committed checkpoint manifest under {root}"
+        + (" (skipped corrupt: " + "; ".join(errors) + ")"
+           if errors else ""))

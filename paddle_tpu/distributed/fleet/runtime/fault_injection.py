@@ -16,6 +16,9 @@ frame when nothing is configured):
                                 would model a hung peer, not a bad one)
   PADDLE_PS_FAULT_KILL_AFTER=N  server: os._exit after N handled
                                 requests
+  PADDLE_PS_FAULT_KILL_AFTER_BYTES=N  checkpoint writer: os._exit once
+                                N payload bytes have been written
+                                (kill-mid-save crash tests)
   PADDLE_PS_FAULT_KILL_POINT=recv|reply   kill before dispatch (request
                                 lost) or after commit-before-reply (the
                                 hard exactly-once case); default reply
@@ -45,6 +48,7 @@ class FaultInjector:
     def __init__(self, drop: float = 0.0, delay: float = 0.0,
                  truncate: float = 0.0, corrupt: float = 0.0,
                  kill_after: int = 0, kill_point: str = "reply",
+                 kill_after_bytes: int = 0,
                  side: str = "both", seed: int = 0):
         self.drop = drop
         self.delay = delay
@@ -52,12 +56,14 @@ class FaultInjector:
         self.corrupt = corrupt
         self.kill_after = kill_after
         self.kill_point = kill_point
+        self.kill_after_bytes = kill_after_bytes
         self.side = side
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._requests = 0
+        self._bytes = 0
         self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
-                         "corrupted": 0, "requests": 0}
+                         "corrupted": 0, "requests": 0, "bytes": 0}
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -69,13 +75,16 @@ class FaultInjector:
             corrupt=float(e("PADDLE_PS_FAULT_CORRUPT", "0") or 0),
             kill_after=int(e("PADDLE_PS_FAULT_KILL_AFTER", "0") or 0),
             kill_point=e("PADDLE_PS_FAULT_KILL_POINT", "reply"),
+            kill_after_bytes=int(
+                e("PADDLE_PS_FAULT_KILL_AFTER_BYTES", "0") or 0),
             side=e("PADDLE_PS_FAULT_SIDE", "both"),
             seed=int(e("PADDLE_PS_FAULT_SEED", "0") or 0))
 
     @property
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.truncate
-                    or self.corrupt or self.kill_after)
+                    or self.corrupt or self.kill_after
+                    or self.kill_after_bytes)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
@@ -123,6 +132,19 @@ class FaultInjector:
 
     def maybe_kill(self, point: str, armed: bool):
         if armed and self.kill_point == point:
+            os._exit(KILL_EXIT_CODE)
+
+    # -- writer kill switch (checkpoint crash tests) ---------------------
+    def maybe_kill_bytes(self, n: int):
+        """One call per payload write of n bytes; dies mid-save once the
+        byte threshold is crossed (BEFORE the write's rename publishes
+        it, so the crash leaves a torn, uncommitted tail)."""
+        with self._lock:
+            self._bytes += n
+            self.counters["bytes"] = self._bytes
+            armed = bool(self.kill_after_bytes
+                         and self._bytes >= self.kill_after_bytes)
+        if armed:
             os._exit(KILL_EXIT_CODE)
 
 

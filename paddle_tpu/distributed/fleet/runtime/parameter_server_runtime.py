@@ -120,6 +120,53 @@ class LargeScaleKV:
                 return self._native.size()
             return len(self._index)
 
+    def rows_for(self, keys: np.ndarray) -> np.ndarray:
+        """Current values of EXISTING rows (post-apply read for the WAL
+        journal — O(len(keys)·dim), never O(table))."""
+        with self._lock:
+            ks = np.asarray(keys, np.int64).ravel()
+            if self._native is not None:
+                return self._native.pull(ks)
+            slots = np.fromiter((self._index[int(k)]
+                                 for k in ks.tolist()), np.int64,
+                                len(ks))
+            return self._data[slots].copy()
+
+    def missing_keys(self, keys) -> np.ndarray | None:
+        """Keys with no row yet, first-occurrence order (the exact set
+        a pull would lazily init) — or None when unknown (native core
+        has no membership probe), meaning callers must assume all."""
+        with self._lock:
+            if self._native is not None:
+                return None
+            idx = self._index
+            return np.fromiter(
+                dict.fromkeys(k for k in np.asarray(keys, np.int64)
+                              .ravel().tolist() if k not in idx),
+                np.int64)
+
+    def apply_rows(self, keys: np.ndarray, rows: np.ndarray):
+        """WAL replay: ensure the rows exist — consuming the init RNG
+        stream exactly as the original apply did for then-missing keys
+        — then assign the journaled post-values. Idempotent; replayed
+        in append order from the same base it reproduces data, key→slot
+        index, and RNG stream bit-for-bit. (Native path: the pull
+        creates missing rows through the native RNG so its stream
+        position advances identically too; note base snapshots do not
+        capture the native RNG position — a from-scratch or
+        journal-only replay is stream-exact, a native base restore is
+        value-exact only.)"""
+        with self._lock:
+            ks = np.asarray(keys, np.int64).ravel()
+            vals = np.asarray(rows, np.float32).reshape(len(ks),
+                                                        self.dim)
+            if self._native is not None:
+                self._native.pull(ks)  # create via RNG, then overwrite
+                self._native.import_(ks, vals)
+                return
+            slots = self._ensure(ks)
+            self._data[slots] = vals
+
     def export_state(self) -> dict:
         """Snapshot-ready state: keys/rows plus (numpy path) the RNG
         stream, so rows initialised AFTER a restore reproduce the
@@ -325,7 +372,8 @@ class PSServer(socketserver.ThreadingTCPServer):
                  snapshot_every: int | None = None,
                  snapshot_interval: float | None = None,
                  secret: str | None = None, fs=None,
-                 auto_restore: bool = True):
+                 auto_restore: bool = True,
+                 wal: bool | None = None):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
@@ -351,6 +399,23 @@ class PSServer(socketserver.ThreadingTCPServer):
             else float(env("PADDLE_PS_SNAPSHOT_INTERVAL", "0") or 0)
         self.snapshot_compact_every = int(
             env("PADDLE_PS_SNAPSHOT_COMPACT_EVERY", "64") or 0)
+        # row-level WAL tier (ROADMAP: "a delta still rewrites the
+        # whole dirty table"): with wal on, a push journals only its
+        # touched ROWS (paddle_tpu.checkpoint.wal) and durability is
+        # write-through by construction; full base snapshots happen
+        # only at the compaction threshold. Restore = base + journal
+        # replay. Opt-in (PADDLE_PS_WAL / wal=True) — the delta-npz
+        # tier stays the default.
+        self.wal_enabled = wal if wal is not None \
+            else env("PADDLE_PS_WAL", "") not in ("", "0")
+        self.wal_compact_bytes = int(
+            env("PADDLE_PS_WAL_COMPACT_BYTES", str(64 << 20)) or 0)
+        if self.wal_enabled and not self.snapshot_dir:
+            raise ValueError(
+                "PADDLE_PS_WAL needs a snapshot dir "
+                "(PADDLE_PS_SNAPSHOT_DIR) for its base snapshots")
+        self._wal = None
+        self._wal_pending = False
         if fs is None:
             from ....distributed.fs import LocalFS
             fs = LocalFS()
@@ -397,6 +462,13 @@ class PSServer(socketserver.ThreadingTCPServer):
                 and self._fs.is_file(self.snapshot_path):
             self.load_snapshot()
             self._base_written = True
+        if self.wal_enabled:
+            # replay runs even with NO base on disk: before the first
+            # compaction the journal alone holds the whole history
+            if auto_restore:
+                self._replay_wal()
+            self._open_wal()
+            self._rpc.journal = self._journal
         self._snap_stop = threading.Event()
         if self.snapshot_dir and self.snapshot_interval > 0:
             threading.Thread(target=self._snapshot_loop,
@@ -423,10 +495,26 @@ class PSServer(socketserver.ThreadingTCPServer):
             return
         with self._snap_lock:
             self._mutations += 1
-            due = bool(self.snapshot_dir and self.snapshot_every
-                       and self._mutations % self.snapshot_every == 0)
+            if self._wal is not None:
+                # WAL mode: durability already happened (the journal
+                # hook ran inside the commit scope); the only disk work
+                # owed here is threshold compaction into a fresh base
+                due = bool(self._wal_pending
+                           or (self.wal_compact_bytes
+                               and self._wal.bytes_written
+                               >= self.wal_compact_bytes))
+                full = True
+            else:
+                due = bool(self.snapshot_dir and self.snapshot_every
+                           and self._mutations % self.snapshot_every
+                           == 0)
+                full = None
         if due:
-            self.snapshot()
+            # _wal_pending is cleared inside snapshot() at rotation
+            # time (under the apply lock) — clearing HERE would erase a
+            # flag set concurrently by another push's journal failure
+            # after our export captured state
+            self.snapshot(full=full)
 
     def _after_retry(self, op: str):
         """Dedup-hit retry of a mutating op: the original after_commit
@@ -440,13 +528,156 @@ class PSServer(socketserver.ThreadingTCPServer):
         if op not in self._SNAPSHOT_OPS or not self.snapshot_dir:
             return
         with self._snap_lock:
-            pending = self._snap_pending
+            pending = self._snap_pending or self._wal_pending
         if pending:
-            self.snapshot()
+            # WAL mode: a failed journal append leaves rows whose exact
+            # apply ORDER is unrecoverable — a full base (which rotates
+            # the journal and clears _wal_pending under the apply lock)
+            # recaptures everything including RNG streams
+            self.snapshot(full=True if self._wal is not None else None)
 
     def _snapshot_loop(self):
         while not self._snap_stop.wait(self.snapshot_interval):
             self.snapshot()
+
+    # -- row-level WAL tier (paddle_tpu.checkpoint.wal) ------------------
+    def _wal_path(self, stamp: int) -> str:
+        tag = self.endpoint.replace(":", "_")
+        return os.path.join(self.snapshot_dir,
+                            f"ps_{tag}.wal_{stamp:010d}")
+
+    def _wal_files(self) -> list[tuple[int, str]]:
+        """(stamp, path) of every journal on LOCAL disk, by stamp. The
+        WAL is a local-disk tier (os.open append path) — remote-fs
+        deployments keep bases remote and journals beside the shard."""
+        tag = self.endpoint.replace(":", "_")
+        prefix = f"ps_{tag}.wal_"
+        out = []
+        try:
+            names = os.listdir(self.snapshot_dir)
+        except FileNotFoundError:
+            return []
+        for f in names:
+            if f.startswith(prefix):
+                try:
+                    out.append((int(f[len(prefix):]),
+                                os.path.join(self.snapshot_dir, f)))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _open_wal(self):
+        from ....checkpoint.wal import RowJournal
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        files = self._wal_files()
+        stamp = max(files[-1][0] if files else 0, self._snap_written)
+        # recover=True: truncate any torn tail left by the previous
+        # incarnation BEFORE appending — records written after garbage
+        # would sit beyond every future replay's stop point
+        self._wal = RowJournal(self._wal_path(stamp), recover=True)
+
+    def _rotate_wal(self, seq: int):
+        """Start journal wal_<seq> (records from now on replay on top
+        of base seq). Called under the apply lock at base-export time;
+        the superseded journals are deleted only once that base COMMITS
+        (_write_snapshot_files), so a failed base write loses nothing."""
+        from ....checkpoint.wal import RowJournal
+        old, self._wal = self._wal, RowJournal(self._wal_path(seq))
+        if old is not None:
+            old.close()
+        RowJournal.note_compaction()
+
+    def _replay_wal(self):
+        """Rebuild state journaled after the restored base: apply each
+        committed rows-record (ensure+assign — idempotent for rows the
+        base already holds) and re-arm the dedup cache from journaled
+        request ids, so a client retrying across the crash still gets
+        exactly-once. Stops cleanly at a torn tail (the crash point)."""
+        from ....checkpoint.wal import replay_file
+        from .rpc import decode_body
+        replayed = 0
+        for _stamp, path in self._wal_files():
+            for rec in replay_file(path):
+                if rec["kind"] == "rows":
+                    t = self.table(rec["table"], int(rec["dim"]),
+                                   float(rec.get("init_std", 0.01)))
+                    t.apply_rows(rec["idx"], rec["values"])
+                rid = int(rec.get("req_id", 0))
+                if rid:
+                    reply = decode_body(rec["extra"]) \
+                        if rec["extra"] else True
+                    self._rpc.dedup.commit(rid, reply)
+                    with self._snap_lock:
+                        self._mutations += 1
+                replayed += 1
+        return replayed
+
+    def _wal_guard(self, append):
+        """Run one journal append under the owed-durability contract:
+        on failure the mutation is applied (and possibly dedup'd) but
+        NOT on disk — flag it so the retry/after_commit hooks recover
+        with a full base snapshot (which re-captures the un-journaled
+        rows/RNG and rotates the journal), and re-raise so the client
+        sees the failure."""
+        try:
+            append()
+        except BaseException:
+            with self._snap_lock:
+                self._wal_pending = True
+            raise
+
+    def _wal_pull(self, req: dict):
+        """WAL-mode pull. Hot path (every key already has a row): only
+        the per-table lock, same as non-WAL mode. A pull that must
+        lazily init rows consumes the table RNG, so the created rows
+        are journaled — under the apply lock, because the
+        create+journal pair must serialize against pushes or replay
+        order could diverge from allocation order."""
+        t = self.table(req["table"], req["dim"],
+                       req.get("init_std", 0.01))
+        probe = t.missing_keys(req["keys"])
+        if probe is not None and len(probe) == 0:
+            return t.pull(req["keys"])
+        with self._apply_lock:
+            missing = t.missing_keys(req["keys"])  # re-check under lock
+            n0 = t.size()
+            out = t.pull(req["keys"])
+            if missing is not None:
+                created = missing
+            elif t.size() != n0:  # native: no membership probe —
+                created = np.asarray(req["keys"],  # journal full set
+                                     np.int64).ravel()
+            else:
+                created = np.empty(0, np.int64)
+            if len(created):
+                # journal ONLY the created rows (O(created), not
+                # O(pulled)); replay's ensure+assign re-draws the init
+                # stream at the same point
+                self._mark_dirty(req["table"])
+                self._wal_guard(lambda: self._wal.append_rows(
+                    req["table"], created, t.rows_for(created),
+                    dim=t.dim, init_std=t.init_std, seed=t.seed))
+        return out
+
+    def _journal(self, op: str, req: dict, req_id: int, reply):
+        """RpcServerState.journal hook — runs INSIDE the commit scope,
+        right after the dedup commit. A push journals its touched rows'
+        post-values; every other mutating op journals a dedup mark (its
+        state effects are either volatile round state or journaled by
+        the barrier apply itself)."""
+        if self._wal is None:
+            return
+        from .rpc import encode_body
+        if op == "push":
+            t = self.tables[req["table"]]
+            keys = np.asarray(req["keys"], np.int64).ravel()
+            self._wal_guard(lambda: self._wal.append_rows(
+                req["table"], keys, t.rows_for(keys), dim=t.dim,
+                init_std=t.init_std, seed=t.seed, req_id=req_id,
+                extra=encode_body(reply)))
+        else:
+            self._wal_guard(lambda: self._wal.append_mark(
+                req_id, extra=encode_body(reply)))
 
     def _delta_path(self, seq: int) -> str:
         tag = self.endpoint.replace(":", "_")
@@ -516,11 +747,27 @@ class PSServer(socketserver.ThreadingTCPServer):
             self._snap_seq += 1
             seq = self._snap_seq
             try:
-                do_full = full if full is not None else (
-                    not self._base_written
-                    or (self.snapshot_compact_every
-                        and self._deltas_since_base
-                        >= self.snapshot_compact_every))
+                if self._wal is not None:
+                    # WAL mode has no deltas: every snapshot is a full
+                    # base that compacts the journal. Rotate FIRST
+                    # (still under the apply lock): rows applied after
+                    # this instant land in wal_<seq>, which is exactly
+                    # what replays on top of base seq. Any owed
+                    # persistence (_wal_pending) is satisfied by this
+                    # export — clearing it under the apply lock means a
+                    # journal failure racing us either happened before
+                    # (rows captured by this export) or will set the
+                    # flag after we clear it (kept for the next base)
+                    do_full = True
+                    self._rotate_wal(seq)
+                    with self._snap_lock:
+                        self._wal_pending = False
+                else:
+                    do_full = full if full is not None else (
+                        not self._base_written
+                        or (self.snapshot_compact_every
+                            and self._deltas_since_base
+                            >= self.snapshot_compact_every))
                 arrays = self._export_arrays(
                     seq, names=None if do_full else dirty,
                     kind="base" if do_full else "delta")
@@ -562,6 +809,15 @@ class PSServer(socketserver.ThreadingTCPServer):
                     if dseq <= seq:
                         self._fs.delete(
                             os.path.join(self.snapshot_dir, fname))
+                if self._wal is not None:
+                    # journals superseded by this base (their rows are
+                    # all ≤ the base's export instant)
+                    for wseq, wpath in self._wal_files():
+                        if wseq < seq:
+                            try:
+                                os.unlink(wpath)
+                            except OSError:
+                                pass
             else:
                 self._write_snapshot(self._delta_path(seq), arrays)
                 self._deltas_since_base += 1
@@ -712,6 +968,8 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def server_close(self):
         self._snap_stop.set()
+        if self._wal is not None:
+            self._wal.close()
         super().server_close()
 
     def table(self, name: str, dim: int,
@@ -728,6 +986,8 @@ class PSServer(socketserver.ThreadingTCPServer):
     def _dispatch(self, req: dict):
         op = req["op"]
         if op == "pull":
+            if self._wal is not None:
+                return self._wal_pull(req)
             t = self.table(req["table"], req["dim"],
                            req.get("init_std", 0.01))
             n0 = t.size()
@@ -766,11 +1026,26 @@ class PSServer(socketserver.ThreadingTCPServer):
                     # mean over trainers: matches the single-process
                     # full-batch step when each trainer computes the mean
                     # loss of its batch shard
-                    self.table(table, dim).push(keys, grads, lr / n)
+                    t = self.table(table, dim)
+                    t.push(keys, grads, lr / n)
                     if self.snapshot_dir:
                         # sync-mode mutation: the post-barrier delta
                         # snapshot must carry these tables too
                         self._mark_dirty(table)
+                    if self._wal is not None:
+                        # rows-only record; the barrier's own journal
+                        # mark (separate record) only preserves its
+                        # reply. A crash between the two is still
+                        # exactly-once: a retried barrier re-applies
+                        # the VOLATILE pending buffer, which is empty
+                        # after a restart because every acked
+                        # push_sync dedups via its own mark (and an
+                        # unacked one re-buffers exactly once).
+                        ks = np.asarray(keys, np.int64).ravel()
+                        self._wal_guard(
+                            lambda ks=ks, t=t: self._wal.append_rows(
+                                table, ks, t.rows_for(ks), dim=t.dim,
+                                init_std=t.init_std, seed=t.seed))
             return self._sync_state(req["trainers"]).send_barrier(
                 req["worker"], apply_fn)
         if op == "fetch_barrier":

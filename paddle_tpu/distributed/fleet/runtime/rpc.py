@@ -648,6 +648,12 @@ class RpcServerState:
         # cannot block should return a scope — a barrier op waiting on
         # straggler trainers inside a shared lock would stall the shard
         self.commit_scope = commit_scope
+        # optional (op, req, req_id, reply) hook called INSIDE the
+        # commit scope right after dedup.commit — the WAL tier journals
+        # the mutation (touched rows + request id) here, so a record is
+        # on disk before the reply leaves and replay order matches
+        # apply order
+        self.journal = None
 
 
 def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
@@ -701,6 +707,12 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
                 else:
                     if mutating and req_id:
                         state.dedup.commit(req_id, rep)
+                        if state.journal is not None:
+                            # WAL write-ahead: rows + request id land
+                            # on disk inside the commit scope, so a
+                            # crash-restore replays this mutation AND
+                            # dedups its retry (exactly-once survives)
+                            state.journal(op, req, req_id, rep)
             if err is not None:
                 _SERVER_ERRORS.labels(op=op or "?").inc()
                 send_frame(sock, err, req_id=req_id, flags=F_ERROR,

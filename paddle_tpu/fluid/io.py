@@ -5,6 +5,13 @@ load_persistables, save_inference_model, load_inference_model) and
 paddle.static.save/load (io.py:1669,1730). Storage format: one `.pdparams`
 npz-style archive for tensors + a serialised Program (paddle_tpu proto) for
 inference models.
+
+Checkpoint-store routing: with ``PADDLE_TPU_CKPT`` set, save paths write
+through ``paddle_tpu.checkpoint`` (content-addressed chunks + CRC'd
+manifest, atomic commit, incremental dedup across steps, no pickle on
+restore — docs/CHECKPOINT.md) into a ``<name>.ckpt`` directory beside
+where the legacy file would sit. Load paths AUTO-DETECT the format, so
+legacy archives stay readable regardless of the env knob.
 """
 from __future__ import annotations
 
@@ -29,6 +36,53 @@ def _collect(program, predicate):
     return [v for v in program.list_vars() if predicate(v)]
 
 
+def _ckpt_root(path: str) -> str:
+    """Store-format sibling of a legacy archive path."""
+    return path + ".ckpt"
+
+
+def _save_blob(blob: dict, path: str):
+    """One name->ndarray blob to disk: checkpoint store when
+    PADDLE_TPU_CKPT is on, legacy pickle archive otherwise."""
+    from .. import checkpoint as ckpt
+    if ckpt.enabled():
+        ckpt.CheckpointStore(_ckpt_root(path)).save(blob)
+        return
+    with open(path, "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+
+
+def _prefer_store(root: str, legacy_path: str) -> bool:
+    """Format auto-detection. When BOTH a committed store and a legacy
+    archive exist (a job toggled PADDLE_TPU_CKPT between saves), the
+    NEWER save wins — silently loading stale parameters from the older
+    format is the one wrong answer."""
+    from .. import checkpoint as ckpt
+    manifests = ckpt.list_manifests(root)
+    if not manifests:
+        return False
+    if not os.path.exists(legacy_path):
+        return True
+    store_mtime = max(os.path.getmtime(p) for _s, p in manifests)
+    return store_mtime >= os.path.getmtime(legacy_path)
+
+
+def _load_blob(path: str) -> dict:
+    """Auto-detecting load: the newest of {committed store dir, legacy
+    archive}; else a clear FileNotFoundError (not a bare KeyError)."""
+    from .. import checkpoint as ckpt
+    root = _ckpt_root(path)
+    if _prefer_store(root, path):
+        blob, _meta = ckpt.CheckpointStore(root).restore()
+        return blob
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no parameter archive at {path} (and no checkpoint store "
+            f"at {root})")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
 def _is_persistable(v):
     return v.persistable and not v.is_data
 
@@ -46,8 +100,8 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         [(v.name, val) for v in vars
          if (val := scope.find_var(v.name)) is not None])
     path = os.path.join(dirname, filename or "__all__.pdparams")
-    with open(path, "wb") as f:
-        pickle.dump(blob, f, protocol=4)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _save_blob(blob, path)
     return path
 
 
@@ -65,8 +119,7 @@ def load_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     import jax.numpy as jnp
     path = os.path.join(dirname, filename or "__all__.pdparams")
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
+    blob = _load_blob(path)
     scope = global_scope()
     program = main_program or default_main_program()
     want = None
@@ -74,6 +127,12 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         want = {v.name for v in vars}
     elif predicate is not None:
         want = {v.name for v in _collect(program, predicate)}
+    if want is not None:
+        missing = sorted(want - set(blob))
+        if missing:
+            raise ValueError(
+                f"variables missing from {path}: {missing} "
+                f"(archive holds {len(blob)} vars)")
     for name, arr in blob.items():
         if want is None or name in want:
             scope.set(name, jnp.asarray(arr))
@@ -117,6 +176,8 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "fetch_names": [v.name for v in target_vars],
     }
     model_path = os.path.join(dirname, model_filename or "__model__")
+    # model_filename may itself carry subdirectories ("deploy/__model__")
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
     with open(model_path, "wb") as f:
         f.write(serialize_program(program, meta))
     if not program_only:
@@ -145,14 +206,12 @@ def save(program: Program, model_path: str):
     blob = core.batched_to_numpy_dict(
         [(v.name, val) for v in program.list_vars() if v.persistable
          and (val := scope.find_var(v.name)) is not None])
-    with open(model_path + ".pdparams", "wb") as f:
-        pickle.dump(blob, f, protocol=4)
+    _save_blob(blob, model_path + ".pdparams")
 
 
 def load(program: Program, model_path: str, executor=None, var_list=None):
     import jax.numpy as jnp
-    with open(model_path + ".pdparams", "rb") as f:
-        blob = pickle.load(f)
+    blob = _load_blob(model_path + ".pdparams")
     scope = global_scope()
     for name, arr in blob.items():
         scope.set(name, jnp.asarray(arr))

@@ -317,27 +317,73 @@ class Model:
         return batch[:-n_lab], batch[-n_lab:]
 
     # -- save/load ------------------------------------------------------
+    def _state_blobs(self, training=True):
+        """(param arrays, optimizer slot arrays, optimizer json dicts)
+        — the three pieces every save format persists. Slot arrays are
+        the momentum/adam-moment accumulators (optimizer.state_dict),
+        keyed ``<param>_<slot>``."""
+        state = self.network.state_dict()
+        params = {k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                  for k, v in state.items()}
+        opt_arrs, opt_dicts = {}, {}
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            opt = self._optimizer.state_dict()
+            opt_arrs = {k: np.asarray(v) for k, v in opt.items()
+                        if v is not None and not isinstance(v, dict)}
+            opt_dicts = {k: v for k, v in opt.items()
+                         if isinstance(v, dict)}
+        return params, opt_arrs, opt_dicts
+
     def save(self, path, training=True):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        state = self.network.state_dict()
-        np.savez(path + ".pdparams",
-                 **{k: np.asarray(v.numpy() if hasattr(v, "numpy") else v)
-                    for k, v in state.items()})
+        params, opt_arrs, opt_dicts = self._state_blobs(training)
+        from .. import checkpoint as ckpt
+        if ckpt.enabled():
+            # checkpoint-store format (docs/CHECKPOINT.md): params +
+            # optimizer slot state in ONE atomically-committed
+            # manifest; unchanged tensors dedup against the previous
+            # step's chunks
+            arrays = {f"p:{k}": v for k, v in params.items()}
+            arrays.update({f"o:{k}": v for k, v in opt_arrs.items()})
+            ckpt.CheckpointStore(path + ".ckpt").save(
+                arrays, meta={"kind": "hapi.Model",
+                              "has_opt": bool(opt_arrs or opt_dicts),
+                              "opt_json": opt_dicts})
+            return
+        np.savez(path + ".pdparams", **params)
         if training and self._optimizer is not None and \
                 hasattr(self._optimizer, "state_dict"):
-            opt = self._optimizer.state_dict()
             import json
-            arrs = {k: np.asarray(v) for k, v in opt.items()
-                    if v is not None and not isinstance(v, dict)}
-            dicts = {k: v for k, v in opt.items() if isinstance(v, dict)}
-            if dicts:  # e.g. LR_Scheduler state
+            arrs = dict(opt_arrs)
+            if opt_dicts:  # e.g. LR_Scheduler state
                 arrs["__json__"] = np.frombuffer(
-                    json.dumps(dicts).encode(), dtype=np.uint8)
+                    json.dumps(opt_dicts).encode(), dtype=np.uint8)
             np.savez(path + ".pdopt", **arrs)
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import checkpoint as ckpt
+        from ..fluid.io import _prefer_store
+        if _prefer_store(path + ".ckpt", path + ".pdparams.npz"):
+            blob, meta = ckpt.CheckpointStore(path + ".ckpt").restore()
+            params = {k[2:]: v for k, v in blob.items()
+                      if k.startswith("p:")}
+            state = self.network.state_dict()
+            missing = [k for k in state if k not in params]
+            if missing and not skip_mismatch:
+                raise KeyError(
+                    f"parameters {missing[:5]} missing from {path}")
+            self.network.set_state_dict(params)
+            if not reset_optimizer and self._optimizer is not None \
+                    and hasattr(self._optimizer, "set_state_dict"):
+                sd = {k[2:]: v for k, v in blob.items()
+                      if k.startswith("o:")}
+                sd.update((meta or {}).get("opt_json") or {})
+                if sd:
+                    self._optimizer.set_state_dict(sd)
+            return self
         blob = np.load(path + ".pdparams.npz", allow_pickle=False)
         state = self.network.state_dict()
         missing = [k for k in state if k not in blob.files]

@@ -212,6 +212,31 @@ class Engine:
         return self.submit(prompt, max_new_tokens,
                            deadline=deadline).result(timeout)
 
+    # -- checkpoint warm-start ------------------------------------------
+    def warm_start(self, root: str, step: int | None = None):
+        """Swap in weights from a committed checkpoint manifest
+        (paddle_tpu.checkpoint) without rebuilding the engine: shapes/
+        dtypes must match the current model (the jitted programs and
+        page pools are layout-anchored and stay valid). Call while the
+        engine is idle — weights swap between steps, not inside one."""
+        with self._lock:
+            # in-place restore against the live model's own tree — no
+            # throwaway random-init model while holding the step lock
+            self.model.load_checkpoint(root, step=step)
+        return self
+
+    @classmethod
+    def from_checkpoint(cls, root: str, step: int | None = None,
+                        attn_impl: str | None = None,
+                        **engine_kw) -> "Engine":
+        """Build an Engine whose model (config + weights) comes from a
+        checkpoint manifest — the serving cold-start path that skips
+        re-initialising and re-uploading weights from scratch."""
+        from .model import GPTDecodeModel
+        model = GPTDecodeModel.from_checkpoint(root, step=step,
+                                               attn_impl=attn_impl)
+        return cls(model, **engine_kw)
+
     # -- step loop -----------------------------------------------------
     def _row(self, req: Request | None) -> list[int]:
         if req is None:

@@ -60,6 +60,73 @@ class GPTDecodeModel:
         # silently clip under jnp.take)
         self.max_positions = cfg.max_position_embeddings
 
+    # -- checkpoint warm-start (paddle_tpu.checkpoint) ------------------
+    def save_checkpoint(self, root: str, step: int | None = None) -> int:
+        """Persist the param pytree through the checkpoint store
+        (content-addressed chunks; repeated saves of a mostly-unchanged
+        model dedup). Keys are tree paths, structure comes from the
+        config at load time — no pickle anywhere."""
+        import dataclasses
+        from ..checkpoint import CheckpointStore
+        leaves, _treedef = jax.tree_util.tree_flatten_with_path(
+            self.params)
+        arrays = {jax.tree_util.keystr(path): leaf
+                  for path, leaf in leaves}
+        return CheckpointStore(root).save(
+            arrays, step=step,
+            meta={"kind": "gpt-decode",
+                  "cfg": dataclasses.asdict(self.cfg)})
+
+    @classmethod
+    def from_checkpoint(cls, root: str, step: int | None = None,
+                        attn_impl: str | None = None,
+                        cfg: "GPTConfig | None" = None) \
+            -> "GPTDecodeModel":
+        """Rebuild a decode model from a committed manifest: the config
+        rides the manifest meta (overridable), a template pytree from it
+        supplies the structure, and every leaf is restored by tree-path
+        key. The serving engine's warm-start entry."""
+        from ..checkpoint import CheckpointStore
+        from ..models.gpt import GPTConfig
+        store = CheckpointStore(root)
+        arrays, meta = store.restore(step)
+        if cfg is None:
+            mcfg = (meta or {}).get("cfg")
+            if not mcfg:
+                raise ValueError(
+                    f"manifest under {root} has no model config — pass "
+                    f"cfg= explicitly")
+            cfg = GPTConfig(**mcfg)
+        model = cls(cfg, attn_impl=attn_impl)
+        model._adopt_params(arrays, root)
+        return model
+
+    def load_checkpoint(self, root: str, step: int | None = None) \
+            -> "GPTDecodeModel":
+        """Swap this model's weights in place from a committed
+        manifest (same structure required) — no throwaway model init,
+        which matters when warm-starting a live engine on big
+        configs."""
+        from ..checkpoint import CheckpointStore
+        arrays, _meta = CheckpointStore(root).restore(step)
+        self._adopt_params(arrays, root)
+        return self
+
+    def _adopt_params(self, arrays: dict, root: str):
+        """Rebuild the param pytree from tree-path-keyed arrays using
+        the CURRENT params as structural template."""
+        template, treedef = jax.tree_util.tree_flatten_with_path(
+            self.params)
+        leaves = []
+        for path, tmpl in template:
+            key = jax.tree_util.keystr(path)
+            if key not in arrays:
+                raise KeyError(f"checkpoint under {root} is missing "
+                               f"param {key}")
+            leaves.append(jnp.asarray(arrays[key],
+                                      dtype=tmpl.dtype))
+        self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+
     # -- cache ---------------------------------------------------------
     def init_cache(self, num_pages: int, page_size: int):
         """[L, num_pages+1, ps, H, d] zero pools (last page = trash)."""

@@ -16,7 +16,12 @@ is a string literal and enforces:
     ONE site in the tree, so two modules can never fight over the same
     series with different help strings/labels (the runtime registry
     would raise only if the kinds/labels conflict; the static rule is
-    stricter on purpose).
+    stricter on purpose);
+  * REQUIRED_METRICS must each have a registration site — the
+    checkpoint tier's instrumentation (save seconds, bytes written,
+    chunk dedup hits, WAL rows) is part of its acceptance contract
+    (docs/CHECKPOINT.md), so deleting it fails this check instead of
+    shipping silently unobservable saves.
 
 Usage: check_metric_names.py [root_dir]   (default:
 <repo>/paddle_tpu). Exits 1 listing offending file:line sites. Run by
@@ -36,6 +41,19 @@ NAME_RE = re.compile(r"^paddle_tpu_[a-z][a-z0-9_]*$")
 # prose/examples; skip only files that themselves DEFINE the helpers
 SKIP_FILES = {os.path.join("observability", "registry.py"),
               os.path.join("observability", "__init__.py")}
+
+# metric families whose presence is contractual (docs/CHECKPOINT.md):
+# a registration site must exist for each, or the check fails
+REQUIRED_METRICS = {
+    "paddle_tpu_ckpt_save_seconds",
+    "paddle_tpu_ckpt_restore_seconds",
+    "paddle_tpu_ckpt_bytes_written_total",
+    "paddle_tpu_ckpt_chunks_written_total",
+    "paddle_tpu_ckpt_chunks_dedup_hits_total",
+    "paddle_tpu_ckpt_wal_rows_appended_total",
+    "paddle_tpu_ckpt_wal_compactions_total",
+    "paddle_tpu_ckpt_manifests_committed_total",
+}
 
 
 def _call_name(node: ast.Call) -> str | None:
@@ -81,7 +99,8 @@ def check_file(path: str) -> tuple[list[tuple[int, str]],
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) > 1:
+    default_root = len(argv) <= 1
+    if not default_root:
         root = argv[1]
     else:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(
@@ -107,6 +126,12 @@ def main(argv: list[str]) -> int:
             violations.append(
                 f"duplicate registration of {name!r} at "
                 + ", ".join(where))
+    if default_root:  # an explicit root is a partial tree by design
+        for name in sorted(REQUIRED_METRICS - set(sites)):
+            violations.append(
+                f"required metric {name!r} has no registration site "
+                "(checkpoint-tier instrumentation is contractual — "
+                "docs/CHECKPOINT.md)")
     if violations:
         print(f"metric naming violations under {root} "
               "(see docs/OBSERVABILITY.md naming scheme):")

@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Static check: no pickle deserialization anywhere under
-paddle_tpu/distributed/.
+paddle_tpu/distributed/ or paddle_tpu/checkpoint/.
 
 The PS/heter transport used to be length-prefixed pickle over TCP —
 remote code execution if ever bound beyond localhost (ADVICE). The
@@ -12,9 +12,16 @@ hazard: in a transport package the line between "trusted disk" and
 "network bytes" is one refactor away from disappearing, so the whole
 tree is held to the data-only rule.
 
-Usage: check_no_wire_pickle.py [root_dir]   (default:
-<repo>/paddle_tpu/distributed). Exits 1 listing offending file:line
-sites. Run by the test suite (tests/test_ps_fault_tolerance.py).
+paddle_tpu/checkpoint/ is held to the same rule for its RESTORE paths
+(docs/CHECKPOINT.md threat model): checkpoints are routinely copied
+between machines/object stores, so restoring one must never execute
+bytes — manifests are CRC'd JSON, chunks are hash-verified raw bytes,
+WAL records are CRC'd struct+JSON.
+
+Usage: check_no_wire_pickle.py [root_dir ...]   (default:
+<repo>/paddle_tpu/distributed AND <repo>/paddle_tpu/checkpoint).
+Exits 1 listing offending file:line sites. Run by the test suite
+(tests/test_ps_fault_tolerance.py, tests/test_checkpoint.py).
 """
 from __future__ import annotations
 
@@ -82,25 +89,29 @@ def check_file(path: str) -> list[tuple[int, str]]:
 
 def main(argv: list[str]) -> int:
     if len(argv) > 1:
-        root = argv[1]
+        roots = argv[1:]
     else:
         repo = os.path.dirname(os.path.dirname(os.path.abspath(
             __file__)))
-        root = os.path.join(repo, "paddle_tpu", "distributed")
+        roots = [os.path.join(repo, "paddle_tpu", "distributed"),
+                 os.path.join(repo, "paddle_tpu", "checkpoint")]
     bad = []
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            for lineno, what in check_file(path):
-                bad.append(f"{path}:{lineno}: {what}")
+    for root in roots:
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                for lineno, what in check_file(path):
+                    bad.append(f"{path}:{lineno}: {what}")
+    shown = ", ".join(roots)
     if bad:
         print("pickle deserialization is banned under "
-              f"{root} (wire-safety, see docs/PS_WIRE_PROTOCOL.md):")
+              f"{shown} (wire-safety, see docs/PS_WIRE_PROTOCOL.md "
+              "and docs/CHECKPOINT.md):")
         print("\n".join(bad))
         return 1
-    print(f"OK: no pickle deserialization under {root}")
+    print(f"OK: no pickle deserialization under {shown}")
     return 0
 
 

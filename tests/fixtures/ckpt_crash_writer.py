@@ -1,0 +1,65 @@
+"""Killable checkpoint writer for the crash-consistency tests.
+
+Env:
+  CKPT_ROOT    store directory (required)
+  CKPT_PHASE   commit  — save state v1 and exit 0
+               crash   — save mutated state v2; the parent arms
+                         PADDLE_PS_FAULT_KILL_AFTER_BYTES so the chunk
+                         writer dies mid-save (fault_injection
+                         KILL_EXIT_CODE), after some chunks are on disk
+                         but BEFORE the manifest commit
+               recover — save the same v2 again to completion; prints
+                         one JSON line of dedup stats
+
+State v1/v2 are deterministic (seeded), so the parent can assert the
+post-crash restore equals v1 bit-for-bit and the recovery save dedups
+v2's unchanged chunks.
+"""
+import json
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.checkpoint import CheckpointStore  # noqa: E402
+
+
+def make_state(mutated: bool) -> dict:
+    rs = np.random.RandomState(1234)
+    state = {
+        "w_embed": rs.randn(256, 64).astype(np.float32),
+        "w_out": rs.randn(64, 32).astype(np.float32),
+        "steps": np.int64(7),
+    }
+    if mutated:
+        # ~1% of one tensor changes between steps; the rest must dedup
+        state["w_embed"] = state["w_embed"].copy()
+        state["w_embed"][:2] += 0.5
+        state["steps"] = np.int64(8)
+    return state
+
+
+def main():
+    root = os.environ["CKPT_ROOT"]
+    phase = os.environ["CKPT_PHASE"]
+    store = CheckpointStore(root, chunk_bytes=4096)
+    if phase == "commit":
+        store.save(make_state(mutated=False), meta={"phase": "v1"})
+    elif phase == "crash":
+        # PADDLE_PS_FAULT_KILL_AFTER_BYTES (set by the parent) kills
+        # this process inside ChunkStore.put — os._exit, no cleanup
+        store.save(make_state(mutated=True), meta={"phase": "v2"})
+        raise SystemExit("writer was supposed to die mid-save")
+    elif phase == "recover":
+        store.save(make_state(mutated=True), meta={"phase": "v2"})
+        print(json.dumps({
+            "dedup_hits": store.chunks.dedup_hits,
+            "chunks_written": store.chunks.chunks_written,
+            "bytes_written": store.chunks.bytes_written}), flush=True)
+    else:
+        raise SystemExit(f"unknown CKPT_PHASE {phase!r}")
+
+
+if __name__ == "__main__":
+    main()
