@@ -32,8 +32,10 @@ secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
 | bert_tiny | lenet | gpt (350M tokens/sec) | resnet50 | widedeep |
 infer (BERT predictor latency) | flash_attn (pallas-vs-jnp microbench) |
 allreduce | metrics_overhead (telemetry enabled-vs-disabled decode
-step-time delta, <2% bar) | checkpoint (store save/restore MB/s, dedup
-ratio on a 1%-mutated state, async-vs-sync save step overhead, <5% bar).
+step-time delta, <2% bar) | flight_overhead (flight recorder only
+toggled, same harness and bar) | checkpoint (store save/restore MB/s,
+dedup ratio on a 1%-mutated state, async-vs-sync save step overhead,
+<5% bar).
 """
 from __future__ import annotations
 
@@ -664,16 +666,14 @@ def bench_serving(num_requests=48, num_slots=8, hidden=512, layers=8,
             "pool_pages": st["pool"]["num_pages"]}
 
 
-def bench_metrics_overhead(steps=200, hidden=256, layers=4, heads=4,
-                           slots=4, seed=0):
-    """Telemetry cost guardrail: decode step time with the
-    observability registry+tracer enabled vs disabled on the SAME
-    engine (same compiled programs, same slot occupancy). The
-    acceptance bar is <2% overhead enabled — the counters/spans on the
-    Engine.step hot path are host-side microseconds against a
-    millisecond jitted decode. A/B/A ordering (on, off, on) so cache
-    warmup or clock drift cannot masquerade as telemetry cost."""
-    from paddle_tpu import observability as obs
+def _bench_serving_toggle_overhead(set_enabled, metric_name, steps=200,
+                                   hidden=256, layers=4, heads=4,
+                                   slots=4, seed=0):
+    """Shared A/B/A harness: decode step time with some telemetry
+    subsystem enabled vs disabled (``set_enabled(bool)``) on the SAME
+    engine (same compiled programs, same slot occupancy). A/B/A
+    ordering (on, off, on) so cache warmup or clock drift cannot
+    masquerade as telemetry cost."""
     from paddle_tpu.models.gpt import GPTConfig
     from paddle_tpu.serving import Engine, GPTDecodeModel
 
@@ -702,15 +702,15 @@ def bench_metrics_overhead(steps=200, hidden=256, layers=4, heads=4,
 
     timed(20)  # compile both programs outside the measurement
     on1 = timed(steps)
-    obs.set_enabled(False)
+    set_enabled(False)
     try:
         off = timed(steps)
     finally:
-        obs.set_enabled(True)
+        set_enabled(True)
     on2 = timed(steps)
     on = min(on1, on2)
     overhead = (on - off) / off * 100 if off > 0 else 0.0
-    return {"metric": "serving_metrics_overhead_pct",
+    return {"metric": metric_name,
             "value": round(overhead, 2), "unit": "%",
             "enabled_step_ms": round(on * 1e3, 4),
             "disabled_step_ms": round(off * 1e3, 4),
@@ -718,6 +718,34 @@ def bench_metrics_overhead(steps=200, hidden=256, layers=4, heads=4,
                                 round(on2 * 1e3, 4)],
             "steps": steps, "slots": slots,
             "model": f"gpt-h{hidden}-l{layers}"}
+
+
+def bench_metrics_overhead(steps=200, hidden=256, layers=4, heads=4,
+                           slots=4, seed=0):
+    """Telemetry cost guardrail: the whole observability substrate
+    (registry + tracer + flight recorder) enabled vs disabled. The
+    acceptance bar is <2% overhead enabled — the counters/spans/events
+    on the Engine.step hot path are host-side microseconds against a
+    millisecond jitted decode."""
+    from paddle_tpu import observability as obs
+    return _bench_serving_toggle_overhead(
+        obs.set_enabled, "serving_metrics_overhead_pct", steps=steps,
+        hidden=hidden, layers=layers, heads=heads, slots=slots,
+        seed=seed)
+
+
+def bench_flight_overhead(steps=200, hidden=256, layers=4, heads=4,
+                          slots=4, seed=0):
+    """Flight-recorder cost guardrail (ISSUE 5 acceptance): ONLY the
+    flight rings toggled — registry and tracer stay on both ways, so
+    the delta isolates the recorder's per-event cost (ring append
+    under one lock + two counter incs) on the decode hot path. Same
+    <2% bar as metrics_overhead."""
+    from paddle_tpu.observability import flight
+    return _bench_serving_toggle_overhead(
+        flight.RECORDER.set_enabled, "serving_flight_overhead_pct",
+        steps=steps, hidden=hidden, layers=layers, heads=heads,
+        slots=slots, seed=seed)
 
 
 def bench_checkpoint(state_mb=64, train_steps=150, save_every=50,
@@ -974,6 +1002,8 @@ def main():
         rec = bench_serving()
     elif which == "metrics_overhead":
         rec = bench_metrics_overhead()
+    elif which == "flight_overhead":
+        rec = bench_flight_overhead()
     elif which == "checkpoint":
         rec = bench_checkpoint()
     elif which == "gpt_1p3b":

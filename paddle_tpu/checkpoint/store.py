@@ -36,10 +36,11 @@ from __future__ import annotations
 import os
 import threading
 import time
+import weakref
 
 import numpy as np
 
-from ..observability import registry as _obs
+from ..observability import flight as _flight, registry as _obs
 from . import manifest as _manifest
 from .chunks import ChunkStore
 
@@ -57,6 +58,44 @@ _RESTORE_SECONDS = _obs.histogram(
 _SAVES = _obs.counter(
     "paddle_tpu_ckpt_saves_total",
     "checkpoint saves committed, by mode", ["mode"])
+
+# async-writer queue gauges, evaluated at exposition time over every
+# live store (zero hot-path writes): a rising queue depth / pending
+# bytes means the train cadence is outrunning the writer (backpressure
+# imminent), and a large in-flight save age is a wedged disk — the
+# stall signals the watchdog/postmortem tier reads
+_STORES: "weakref.WeakSet[CheckpointStore]" = weakref.WeakSet()
+
+
+def _sum_stores(fn) -> float:
+    total = 0.0
+    for s in list(_STORES):
+        try:
+            total += fn(s)
+        except Exception:
+            pass
+    return total
+
+
+_WRITER_QUEUE_DEPTH = _obs.gauge(
+    "paddle_tpu_ckpt_writer_queue_depth",
+    "async saves queued for the background writer (live, all stores)")
+_WRITER_QUEUE_DEPTH.set_function(lambda: _sum_stores(
+    lambda s: s._queue.qsize() if s._queue is not None else 0))
+_WRITER_PENDING_BYTES = _obs.gauge(
+    "paddle_tpu_ckpt_writer_pending_bytes",
+    "host-copy bytes held by queued + in-flight async saves (live)")
+_WRITER_PENDING_BYTES.set_function(
+    lambda: _sum_stores(lambda s: s._pending_bytes))
+_INFLIGHT_SAVE_SECONDS = _obs.gauge(
+    "paddle_tpu_ckpt_inflight_save_seconds",
+    "age of the oldest in-flight async save write (live; 0 when idle)")
+# snapshot _save_started ONCE per store — the writer thread clears it
+# concurrently, and a second read racing that clear would be float-None
+_INFLIGHT_SAVE_SECONDS.set_function(lambda: max(
+    (time.monotonic() - t
+     for t in (s._save_started for s in list(_STORES))
+     if t is not None), default=0.0))
 
 
 class ShardedArray:
@@ -146,6 +185,9 @@ class CheckpointStore:
         self._async_error: BaseException | None = None
         self._queue: "queue.Queue | None" = None  # lazy writer thread
         self._last_step = 0
+        self._pending_bytes = 0          # queued + in-flight host copies
+        self._save_started: float | None = None  # writer busy since
+        _STORES.add(self)
 
     # -- save -----------------------------------------------------------
     def _resolve_step(self, step: int | None) -> int:
@@ -187,6 +229,9 @@ class CheckpointStore:
         self._retention_gc()
         _SAVE_SECONDS.labels(mode=mode).observe(time.perf_counter() - t0)
         _SAVES.labels(mode=mode).inc()
+        _flight.record("ckpt", "manifest_commit", step=int(step),
+                       mode=mode, arrays=len(arrays),
+                       seconds=round(time.perf_counter() - t0, 6))
         return payload
 
     def save(self, state: dict, step: int | None = None,
@@ -206,13 +251,26 @@ class CheckpointStore:
             if item is None:
                 q.task_done()
                 return
-            host, step, meta = item
+            host, step, meta, nbytes = item
+            self._save_started = time.monotonic()
+            _flight.record("ckpt", "write_start", step=step,
+                           bytes=nbytes, queued=q.qsize())
             try:
                 self._write_state(host, step, meta, "async")
             except BaseException as e:  # surfaced on wait()/next save
                 with self._async_lock:
                     self._async_error = e
+                _flight.record("ckpt", "write_error", step=step,
+                               error=f"{type(e).__name__}: {e}")
+            else:
+                _flight.record(
+                    "ckpt", "write_done", step=step, bytes=nbytes,
+                    seconds=round(
+                        time.monotonic() - self._save_started, 6))
             finally:
+                self._save_started = None
+                with self._async_lock:
+                    self._pending_bytes -= nbytes
                 q.task_done()
 
     def save_async(self, state: dict, step: int | None = None,
@@ -249,7 +307,12 @@ class CheckpointStore:
                     [np.array(p, copy=True) for p in val.pieces])
             else:
                 host[name] = np.array(_host_array(val), copy=True)
-        self._queue.put((host, step, meta))
+        nbytes = int(sum(v.nbytes for v in host.values()))
+        with self._async_lock:
+            self._pending_bytes += nbytes
+        _flight.record("ckpt", "enqueue", step=step, bytes=nbytes,
+                       queued=self._queue.qsize())
+        self._queue.put((host, step, meta, nbytes))
         return step
 
     def wait(self):

@@ -39,7 +39,7 @@ import zlib
 
 import numpy as np
 
-from ..observability import registry as _obs
+from ..observability import flight as _flight, registry as _obs
 
 __all__ = ["RowJournal", "replay_file", "committed_length",
            "WAL_MAGIC"]
@@ -159,6 +159,7 @@ class RowJournal:
     @staticmethod
     def note_compaction():
         _WAL_COMPACTIONS.inc()
+        _flight.record("ckpt", "wal_compaction")
 
 
 def _walk(blob: bytes):

@@ -22,6 +22,14 @@ frame when nothing is configured):
   PADDLE_PS_FAULT_KILL_POINT=recv|reply   kill before dispatch (request
                                 lost) or after commit-before-reply (the
                                 hard exactly-once case); default reply
+  PADDLE_PS_FAULT_STALL=sec     hang injection: sleep this long at the
+                                stall point (a wedged-not-dead tier —
+                                what the observability watchdog must
+                                catch; the in-flight op pins the tier
+                                non-idle while its progress counter
+                                freezes)
+  PADDLE_PS_FAULT_STALL_POINT=dispatch    where to stall (currently the
+                                PS server's dispatch path)
   PADDLE_PS_FAULT_SIDE=client|server|both   which transport end injects
                                 (default both — set it when client and
                                 server share one process env)
@@ -48,7 +56,8 @@ class FaultInjector:
     def __init__(self, drop: float = 0.0, delay: float = 0.0,
                  truncate: float = 0.0, corrupt: float = 0.0,
                  kill_after: int = 0, kill_point: str = "reply",
-                 kill_after_bytes: int = 0,
+                 kill_after_bytes: int = 0, stall: float = 0.0,
+                 stall_point: str = "dispatch",
                  side: str = "both", seed: int = 0):
         self.drop = drop
         self.delay = delay
@@ -57,13 +66,16 @@ class FaultInjector:
         self.kill_after = kill_after
         self.kill_point = kill_point
         self.kill_after_bytes = kill_after_bytes
+        self.stall = stall
+        self.stall_point = stall_point
         self.side = side
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._requests = 0
         self._bytes = 0
         self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
-                         "corrupted": 0, "requests": 0, "bytes": 0}
+                         "corrupted": 0, "requests": 0, "bytes": 0,
+                         "stalled": 0}
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -77,6 +89,8 @@ class FaultInjector:
             kill_point=e("PADDLE_PS_FAULT_KILL_POINT", "reply"),
             kill_after_bytes=int(
                 e("PADDLE_PS_FAULT_KILL_AFTER_BYTES", "0") or 0),
+            stall=float(e("PADDLE_PS_FAULT_STALL", "0") or 0),
+            stall_point=e("PADDLE_PS_FAULT_STALL_POINT", "dispatch"),
             side=e("PADDLE_PS_FAULT_SIDE", "both"),
             seed=int(e("PADDLE_PS_FAULT_SEED", "0") or 0))
 
@@ -84,7 +98,7 @@ class FaultInjector:
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.truncate
                     or self.corrupt or self.kill_after
-                    or self.kill_after_bytes)
+                    or self.kill_after_bytes or self.stall)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
@@ -133,6 +147,17 @@ class FaultInjector:
     def maybe_kill(self, point: str, armed: bool):
         if armed and self.kill_point == point:
             os._exit(KILL_EXIT_CODE)
+
+    # -- hang injection (watchdog tests) ---------------------------------
+    def maybe_stall(self, point: str, side: str | None = None):
+        """Wedge the calling thread for `stall` seconds — a tier that is
+        alive but making no progress, which is the failure mode the
+        stall watchdog (observability/watchdog.py) exists to detect."""
+        if self.stall and self.stall_point == point \
+                and self._applies(side):
+            with self._lock:
+                self.counters["stalled"] += 1
+            time.sleep(self.stall)
 
     # -- writer kill switch (checkpoint crash tests) ---------------------
     def maybe_kill_bytes(self, n: int):

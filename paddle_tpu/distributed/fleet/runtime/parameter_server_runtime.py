@@ -21,15 +21,19 @@ reconnect instead of restarting the job.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import socketserver
 import threading
 import time
+import weakref
 
 import numpy as np
 
-from ....observability import registry as _obs
+from ....observability import (debug as _debug, flight as _flight,
+                               registry as _obs, watchdog as _watchdog)
+from .fault_injection import injector
 from .rpc import (RpcClient, RpcServerState, TransportStats,
                   serve_connection)
 
@@ -46,6 +50,9 @@ _SNAPSHOT_BYTES = _obs.counter(
 _SNAPSHOT_SECONDS = _obs.histogram(
     "paddle_tpu_ps_snapshot_write_seconds",
     "wall time of one snapshot file write", ["kind"])
+
+# watchdog token uniqueness across same-endpoint server respawns
+_ps_server_ids = itertools.count()
 
 
 class LargeScaleKV:
@@ -363,9 +370,14 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     # ops that never mutate server state: exempt from dedup caching
     READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
-                          "heartbeat", "metrics"})
+                          "heartbeat", "metrics", "debug_dump"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
+    # verbs that legitimately block on straggler trainers: they never
+    # count as in-flight work for the stall watchdog (a barrier waiting
+    # out a slow trainer is round semantics, not a wedged server)
+    _BLOCKING_OPS = frozenset({"send_barrier", "fetch_barrier",
+                               "dgc_push", "dgc_pull"})
 
     def __init__(self, endpoint: str, worker_timeout: float = 60.0,
                  snapshot_dir: str | None = None,
@@ -458,6 +470,27 @@ class PSServer(socketserver.ThreadingTCPServer):
 
         super().__init__((host, int(port)), Handler)
         self.endpoint = f"{host}:{self.server_address[1]}"
+        # stall watchdog: completed dispatches are this shard's
+        # progress counter; the shard is idle while no non-barrier op
+        # is in flight, so a quiet server never looks stalled but a
+        # wedged dispatch (hung disk, poisoned lock) fires the token.
+        # The name carries a unique instance id: a respawned server on
+        # the SAME endpoint must not have its token popped when the
+        # old instance's finalize runs at GC.
+        self._wd_lock = threading.Lock()
+        self._wd_inflight = 0
+        self._wd_done = 0
+        self._wd_name = (f"ps.server.{self.endpoint.replace(':', '_')}"
+                         f".{next(_ps_server_ids)}")
+        _srv_ref = weakref.ref(self)
+        _watchdog.WATCHDOG.watch(
+            self._wd_name,
+            probe=lambda: (lambda s: None if s is None
+                           else s._wd_done)(_srv_ref()),
+            idle=lambda: (lambda s: True if s is None
+                          else s._wd_inflight == 0)(_srv_ref()))
+        weakref.finalize(self, _watchdog.WATCHDOG.unwatch,
+                         self._wd_name)
         if auto_restore and self.snapshot_dir \
                 and self._fs.is_file(self.snapshot_path):
             self.load_snapshot()
@@ -675,9 +708,14 @@ class PSServer(socketserver.ThreadingTCPServer):
                 req["table"], keys, t.rows_for(keys), dim=t.dim,
                 init_std=t.init_std, seed=t.seed, req_id=req_id,
                 extra=encode_body(reply)))
+            _flight.record("ps", "wal_commit", endpoint=self.endpoint,
+                           op=op, table=req.get("table"),
+                           rows=int(keys.size), req_id=req_id)
         else:
             self._wal_guard(lambda: self._wal.append_mark(
                 req_id, extra=encode_body(reply)))
+            _flight.record("ps", "wal_commit", endpoint=self.endpoint,
+                           op=op, rows=0, req_id=req_id)
 
     def _delta_path(self, seq: int) -> str:
         tag = self.endpoint.replace(":", "_")
@@ -823,11 +861,14 @@ class PSServer(socketserver.ThreadingTCPServer):
                 self._deltas_since_base += 1
                 self.delta_snapshots += 1
             self.snapshots_taken += 1
-        _SNAPSHOT_SECONDS.labels(kind=kind).observe(
-            time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        nbytes = sum(a.nbytes for a in arrays.values())
+        _SNAPSHOT_SECONDS.labels(kind=kind).observe(dt)
         _SNAPSHOTS.labels(kind=kind).inc()
-        _SNAPSHOT_BYTES.labels(kind=kind).inc(
-            sum(a.nbytes for a in arrays.values()))
+        _SNAPSHOT_BYTES.labels(kind=kind).inc(nbytes)
+        _flight.record("ps", "snapshot", endpoint=self.endpoint,
+                       kind=kind, seq=seq, bytes=int(nbytes),
+                       seconds=round(dt, 6))
 
     def _export_arrays(self, seq: int = 0, names: set | None = None,
                        kind: str = "base") -> dict:
@@ -984,6 +1025,33 @@ class PSServer(socketserver.ThreadingTCPServer):
             self._dirty.add(name)
 
     def _dispatch(self, req: dict):
+        """In-flight accounting wrapper around the op switch: arms the
+        stall watchdog token (non-barrier ops only), applies the
+        hang-injection stall point, and records push/pull flight
+        events for the postmortem ring."""
+        op = req.get("op")
+        track = op not in self._BLOCKING_OPS
+        if track:
+            with self._wd_lock:
+                self._wd_inflight += 1
+        inj = injector()
+        if inj.active:
+            inj.maybe_stall("dispatch", "server")
+        try:
+            rep = self._dispatch_inner(req)
+        finally:
+            if track:
+                with self._wd_lock:
+                    self._wd_inflight -= 1
+                    self._wd_done += 1
+        if op in ("push", "pull"):
+            _flight.record("ps", op, endpoint=self.endpoint,
+                           table=req.get("table"),
+                           keys=int(np.asarray(req["keys"]).size)
+                           if "keys" in req else 0)
+        return rep
+
+    def _dispatch_inner(self, req: dict):
         op = req["op"]
         if op == "pull":
             if self._wal is not None:
@@ -1058,6 +1126,11 @@ class PSServer(socketserver.ThreadingTCPServer):
             # (rpc counters, snapshot costs, table sizes are all here) —
             # the PS scrape point (docs/OBSERVABILITY.md)
             return _obs.prometheus_text()
+        if op == "debug_dump":
+            # full postmortem bundle (docs/DEBUGGING.md): same handler
+            # as the serving frontend, persisted server-side when a
+            # debug dir is configured and returned over the wire
+            return _debug.dump_verb(req)
         if op == "heartbeat":
             import time
             with self._beats_lock:
@@ -1262,6 +1335,19 @@ class PSClient:
         if shard is not None:
             return self._call(shard, {"op": "metrics"})
         return {ep: self._call(i, {"op": "metrics"})
+                for i, ep in enumerate(self.endpoints)}
+
+    def debug_dump(self, shard: int | None = None,
+                   write: bool = True):
+        """Postmortem bundle from one shard (or every shard when None)
+        — metrics, trace ring, flight rings, env. `write=True` also
+        persists it shard-side into the shard's own
+        PADDLE_TPU_DEBUG_DIR (the destination is never
+        wire-controlled; docs/DEBUGGING.md)."""
+        req = {"op": "debug_dump", "write": bool(write)}
+        if shard is not None:
+            return self._call(shard, dict(req))
+        return {ep: self._call(i, dict(req))
                 for i, ep in enumerate(self.endpoints)}
 
     # -- DGC sparse-gradient rounds (shard by index hash) ----------------

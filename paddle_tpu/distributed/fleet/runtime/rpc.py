@@ -48,7 +48,8 @@ import zlib
 
 import numpy as np
 
-from ....observability import registry as _obs, tracing as _tracing
+from ....observability import (flight as _flight, registry as _obs,
+                               tracing as _tracing)
 from .fault_injection import injector
 
 __all__ = [
@@ -429,9 +430,19 @@ class RpcClient:
             if isinstance(req, dict) and TRACE_KEY not in req:
                 req = {**req, TRACE_KEY: sp.trace_id}
             t_call = time.monotonic()
-            rep = self._call_locked(req, timeout, deadline)
-            _CLIENT_LATENCY.labels(op=op or "?").observe(
-                time.monotonic() - t_call)
+            try:
+                rep = self._call_locked(req, timeout, deadline)
+            except Exception as e:
+                _flight.record("rpc", "client_error",
+                               trace_id=sp.trace_id, op=op or "?",
+                               endpoint=self.endpoint,
+                               error=f"{type(e).__name__}: {e}")
+                raise
+            dt = time.monotonic() - t_call
+            _CLIENT_LATENCY.labels(op=op or "?").observe(dt)
+            _flight.record("rpc", "client_call", trace_id=sp.trace_id,
+                           op=op or "?", endpoint=self.endpoint,
+                           seconds=round(dt, 6))
             return rep
 
     def _call_locked(self, req, timeout, deadline):
@@ -675,6 +686,8 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
             wire_tid = req.pop(TRACE_KEY, None) \
                 if isinstance(req, dict) else None
             _SERVER_REQS.labels(op=op or "?").inc()
+            _flight.record("rpc", "server_request", trace_id=wire_tid,
+                           op=op or "?", req_id=req_id)
             mutating = op not in state.read_ops
             if mutating and req_id:
                 cached = state.dedup.begin(req_id)
@@ -715,6 +728,9 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
                             state.journal(op, req, req_id, rep)
             if err is not None:
                 _SERVER_ERRORS.labels(op=op or "?").inc()
+                _flight.record("rpc", "server_error",
+                               trace_id=wire_tid, op=op or "?",
+                               error=err.get("error"))
                 send_frame(sock, err, req_id=req_id, flags=F_ERROR,
                            side="server")
                 continue

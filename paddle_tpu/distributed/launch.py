@@ -76,6 +76,17 @@ def _parse(argv):
                         "at exit; aggregate the job with `python -m "
                         "paddle_tpu.observability.registry <dir>` "
                         "(docs/OBSERVABILITY.md)")
+    p.add_argument("--debug_dir", type=str, default=None,
+                   help="postmortem: set PADDLE_TPU_DEBUG_DIR for "
+                        "every child so each process writes a debug "
+                        "bundle (metrics + trace ring + flight "
+                        "recorder + in-flight requests, CRC'd "
+                        "manifest) on SIGTERM, unhandled exceptions "
+                        "and watchdog stalls — including the teardown "
+                        "this launcher runs when a rank dies or hangs. "
+                        "List/merge a job's bundles with `python -m "
+                        "paddle_tpu.observability.registry <dir>` "
+                        "(docs/DEBUGGING.md)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -261,6 +272,10 @@ def launch(argv=None):
         os.makedirs(args.metrics_dir, exist_ok=True)
         for _name, env, _argv in specs:
             env["PADDLE_TPU_METRICS_DIR"] = args.metrics_dir
+    if args.debug_dir:
+        os.makedirs(args.debug_dir, exist_ok=True)
+        for _name, env, _argv in specs:
+            env["PADDLE_TPU_DEBUG_DIR"] = args.debug_dir
     from .elastic import ElasticManager
     hb_dir = None
     if args.max_restarts > 0:

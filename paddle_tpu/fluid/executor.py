@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..observability import registry as _obs
+from ..observability import flight as _flight, registry as _obs
 from . import core, registry
 from .framework import Block, Program, Variable, default_main_program
 from .scope import Scope, global_scope
@@ -404,6 +404,13 @@ class Executor:
             _EXEC_CACHE_HITS.inc()
             return fn
         _EXEC_COMPILES.inc()
+        # one flight event per cache miss: a burst of these in a
+        # postmortem ring IS a recompile storm (feed shapes/structure
+        # churning), with the feed shapes as the evidence
+        _flight.record("executor", "compile",
+                       feeds=[[n, list(v.shape)] for n, v
+                              in zip(feed_names, feed_vals)],
+                       cache_size=len(self._cache))
 
         is_test = program._is_test
         gb = program.global_block()

@@ -1,4 +1,4 @@
-"""paddle_tpu.observability — unified runtime telemetry.
+"""paddle_tpu.observability — unified runtime telemetry + postmortem.
 
 One substrate replacing the fragmented per-tier stat dicts (serving
 engine p50/p99 under a stats lock, PSClient retry counters, autobench
@@ -11,10 +11,23 @@ stderr prints, the disconnected jax.profiler wrapper):
   * ``tracing`` — host spans with trace/span ids, Chrome trace_event
     export, a jax.profiler.TraceAnnotation bridge (host spans line up
     with XPlane device traces), and a trace-id field carried in the PS
-    RPC wire skeleton so one request is followable across processes.
+    RPC wire skeleton so one request is followable across processes;
+  * ``flight`` — the black box: bounded per-tier event rings (request
+    lifecycles, RPC calls, PS push/snapshot/WAL commits, checkpoint
+    writer transitions, compile events), cheap enough to stay on in
+    production, dumped whole into postmortem bundles;
+  * ``watchdog`` — progress-token stall detection: each tier registers
+    a counter it must advance; no progress past a deadline raises
+    ``paddle_tpu_watchdog_*`` metrics, writes a bundle, and can
+    re-raise SIGTERM for the launch.py respawn path;
+  * ``debug`` — atomic, CRC-manifested postmortem bundle directories
+    (``PADDLE_TPU_DEBUG_DIR`` / ``launch.py --debug_dir``), written on
+    watchdog fire, unhandled exception, SIGTERM, and on demand via the
+    ``debug_dump`` verb of the serving frontend and PS servers.
 
-Scrape points: the serving frontend and every PS server answer a
-``metrics`` verb with the Prometheus text (docs/OBSERVABILITY.md).
+Scrape points: the serving frontend and every PS server answer
+``metrics`` (Prometheus text) and ``debug_dump`` (full bundle) verbs
+(docs/OBSERVABILITY.md, docs/DEBUGGING.md).
 
 Quick use:
 
@@ -22,29 +35,35 @@ Quick use:
     reqs = obs.counter("paddle_tpu_myapp_requests_total", "requests")
     with obs.span("myapp.handle", route="/gen"):
         reqs.inc()
+        obs.flight.record("myapp", "handled", route="/gen")
     print(obs.prometheus_text())
-    obs.export_chrome_trace("/tmp/trace.json")
+    obs.write_bundle("/tmp/debug", reason="manual")
 
 ``obs.set_enabled(False)`` (or ``PADDLE_TPU_TELEMETRY=0``) turns every
-metric write and span record into a cheap no-op; the
-``BENCH_CONFIG=metrics_overhead`` entry in bench.py keeps the
-enabled-vs-disabled decode step-time delta honest (<2%).
+metric write, span record and flight event into a cheap no-op; the
+``BENCH_CONFIG=metrics_overhead`` / ``flight_overhead`` entries in
+bench.py keep the enabled-vs-disabled decode step-time delta honest
+(<2%).
 """
 from __future__ import annotations
 
 import atexit
 import os
+import socket
 
-from . import registry, tracing
+from . import debug, flight, registry, tracing, watchdog
+from .debug import collect, load_bundle, write_bundle
+from .flight import RECORDER
 from .registry import (REGISTRY, Counter, Gauge, Histogram, MetricError,
                        MetricsRegistry, aggregate_dir, aggregate_dumps,
                        counter, dump_to_file, gauge, histogram,
                        prometheus_text, to_dict)
 from .tracing import (TRACER, Span, Tracer, current_trace_id,
                       export_chrome_trace, new_trace_id, span)
+from .watchdog import WATCHDOG
 
 __all__ = [
-    "registry", "tracing",
+    "registry", "tracing", "flight", "watchdog", "debug",
     "REGISTRY", "MetricsRegistry", "MetricError",
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
@@ -52,19 +71,48 @@ __all__ = [
     "aggregate_dumps", "aggregate_dir",
     "TRACER", "Tracer", "Span", "span", "current_trace_id",
     "new_trace_id", "export_chrome_trace",
+    "RECORDER", "WATCHDOG",
+    "collect", "write_bundle", "load_bundle",
     "set_enabled", "enabled",
 ]
 
 
 def set_enabled(on: bool):
-    """Master switch: metric writes AND span recording (trace ids still
-    propagate so cross-process correlation survives a disabled tier)."""
+    """Master switch: metric writes, span recording AND flight events
+    (trace ids still propagate so cross-process correlation survives a
+    disabled tier)."""
     REGISTRY.set_enabled(on)
     TRACER.enabled = bool(on)
+    RECORDER.set_enabled(on)
 
 
 def enabled() -> bool:
     return REGISTRY.enabled
+
+
+def _postmortem_dump(reason: str):
+    """Evidence dump for process-death paths. Into the metrics dir:
+    the registry JSON plus the trace ring and flight rings (each a
+    per-process file the offline aggregator can sit next to). Into the
+    debug dir: one full CRC-manifested bundle."""
+    d = os.environ.get("PADDLE_TPU_METRICS_DIR")
+    if d:
+        tag = f"{socket.gethostname()}_{os.getpid()}"
+        try:
+            REGISTRY.dump_to_file()
+        except Exception:
+            pass
+        try:
+            TRACER.export_chrome_trace(
+                os.path.join(d, f"trace_{tag}.json"))
+        except Exception:
+            pass
+        try:
+            RECORDER.dump_to_file(
+                os.path.join(d, f"flight_{tag}.json"))
+        except Exception:
+            pass
+    debug.try_write_bundle(reason)
 
 
 if os.environ.get("PADDLE_TPU_METRICS_DIR"):
@@ -77,12 +125,16 @@ if os.environ.get("PADDLE_TPU_METRICS_DIR"):
         except Exception:
             pass
 
+
+if os.environ.get("PADDLE_TPU_METRICS_DIR") \
+        or os.environ.get("PADDLE_TPU_DEBUG_DIR"):
     # SIGTERM does NOT run atexit hooks, and that is exactly how
-    # launch.py stops PS servers (and any survivors after a failure):
-    # dump first, then die with the default disposition so the exit
-    # code stays 143. Installed only over the DEFAULT handler — an app
-    # with its own SIGTERM logic keeps it (and can call dump_to_file
-    # itself).
+    # launch.py stops PS servers (and any survivors after a failure or
+    # a hung-rank teardown): dump the metrics + trace ring + flight
+    # rings (+ a debug bundle when PADDLE_TPU_DEBUG_DIR is set), then
+    # die with the default disposition so the exit code stays 143.
+    # Installed only over the DEFAULT handler — an app with its own
+    # SIGTERM logic keeps it (and can call _postmortem_dump itself).
     def _install_sigterm_dump():
         import signal
         import threading
@@ -92,10 +144,15 @@ if os.environ.get("PADDLE_TPU_METRICS_DIR"):
             return
 
         def _on_term(signum, frame):
-            try:
-                REGISTRY.dump_to_file()
-            except Exception:
-                pass
+            # the handler interrupts an arbitrary main-thread frame,
+            # which may HOLD one of the non-reentrant locks the dump
+            # needs (flight ring, a registry child, a scheduler lock
+            # behind a requests provider). A deadlocked dump must cost
+            # a bounded wait, not the exit: arm a hard-exit escalation
+            # FIRST, so the process still dies 143 with whatever
+            # evidence made it to disk.
+            debug.arm_hard_exit(name="sigterm-dump-escalate")
+            _postmortem_dump("sigterm")
             signal.signal(signal.SIGTERM, signal.SIG_DFL)
             os.kill(os.getpid(), signal.SIGTERM)
 
@@ -103,5 +160,24 @@ if os.environ.get("PADDLE_TPU_METRICS_DIR"):
 
     try:
         _install_sigterm_dump()
+    except Exception:
+        pass
+
+
+if os.environ.get("PADDLE_TPU_DEBUG_DIR"):
+    # unhandled exceptions (main thread or any worker thread) leave a
+    # bundle behind before the traceback prints
+    try:
+        debug.install_crash_hooks()
+    except Exception:
+        pass
+
+
+if os.environ.get("PADDLE_TPU_WATCHDOG", "") not in ("", "0"):
+    # opt-in background stall polling; tiers register their progress
+    # tokens unconditionally (registration is free), the thread only
+    # runs when a job asks for it
+    try:
+        WATCHDOG.start()
     except Exception:
         pass

@@ -529,6 +529,17 @@ def dump_to_file(path: str | None = None) -> str:
 
 if __name__ == "__main__":  # python -m paddle_tpu.observability.registry
     import sys
-    agg = aggregate_dir(sys.argv[1] if len(sys.argv) > 1 else ".")
+    _dir = sys.argv[1] if len(sys.argv) > 1 else "."
+    # bundle-aware job aggregation: metrics_*.json dumps PLUS the
+    # metrics.json of every postmortem bundle in the dir, with a
+    # "bundles" listing (reason/host/pid/valid) when any exist; only a
+    # missing debug module degrades to the plain aggregate — a real
+    # aggregation failure must surface, not masquerade as "no bundles"
+    try:
+        from .debug import aggregate_with_bundles
+    except ImportError:
+        agg = aggregate_dir(_dir)
+    else:
+        agg = aggregate_with_bundles(_dir)
     json.dump(agg, sys.stdout, indent=2)
     print()

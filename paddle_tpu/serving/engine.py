@@ -34,7 +34,9 @@ from collections import defaultdict, deque
 
 import numpy as np
 
-from ..observability import registry as _obs, tracing as _tracing
+from ..observability import (debug as _debug, flight as _flight,
+                             registry as _obs, tracing as _tracing,
+                             watchdog as _watchdog)
 from .kv_cache import PagePool, defrag_plan
 from .scheduler import QueueFull, Request, Scheduler
 
@@ -89,6 +91,17 @@ def _bucket_len(n: int, page_size: int) -> int:
     """Smallest page-aligned power-of-two-pages length >= n."""
     pages = max(1, math.ceil(n / page_size))
     return page_size * (1 << (pages - 1).bit_length())
+
+
+def _req_summary(req: Request, where: str) -> dict:
+    """One request's postmortem line (JSON-safe, lock-free reads)."""
+    return {"id": req.id, "where": where, "status": req.status,
+            "trace_id": req.trace_id,
+            "prompt_len": int(req.prompt.size),
+            "generated": len(req.generated),
+            "max_new_tokens": req.max_new_tokens, "slot": req.slot,
+            "age_s": round(time.monotonic() - req.submitted_at, 3),
+            "error": req.error}
 
 
 class Engine:
@@ -149,6 +162,33 @@ class Engine:
         # a dead engine's series (incl. the weakref gauges, which would
         # otherwise report 0.0 forever) leave the exposition
         weakref.finalize(self, _drop_engine_series, eid)
+        # postmortem wiring: a progress token (the engine must keep
+        # producing tokens OR retiring requests while the scheduler is
+        # non-idle — a wedged jitted call inside step() is exactly what
+        # the watchdog exists to catch) and an in-flight-request
+        # provider for debug bundles. Both probe through the weakref so
+        # a dead engine unregisters itself; neither takes the step lock
+        # (a wedged step HOLDS it). Decode steps alone are NOT the
+        # probe: a healthy stream of requests that all finish at
+        # prefill (max_new_tokens=1) or all fail/expire never runs a
+        # decode step, so _wd_progress also advances on every token and
+        # every request retirement.
+        self._wd_progress = 0
+        self._recent: deque[dict] = deque(maxlen=32)
+        wd_name = f"serving.engine.{eid}"
+        _watchdog.WATCHDOG.watch(
+            wd_name,
+            probe=lambda: (lambda e: None if e is None
+                           else e._wd_progress)(wr()),
+            idle=lambda: (lambda e: True if e is None
+                          else e.scheduler.idle)(wr()))
+        weakref.finalize(self, _watchdog.WATCHDOG.unwatch, wd_name)
+        _debug.register_requests_provider(
+            wd_name,
+            lambda: (lambda e: None if e is None
+                     else e._debug_requests())(wr()))
+        weakref.finalize(self, _debug.unregister_requests_provider,
+                         wd_name)
         self._lock = threading.Lock()    # step loop exclusivity
         self._stats_lock = threading.Lock()  # deque append vs snapshot
         self._wake = threading.Event()
@@ -166,6 +206,8 @@ class Engine:
             # actual XLA trace, so this counts COMPILES, not steps
             compiles[bucket] += 1
             _COMPILES.labels(engine=eid, bucket=bucket).inc()
+            _flight.record("serving", "compile", engine=eid,
+                           bucket=bucket)
 
         def prefill(params, cache, tokens, true_len, page_row):
             note_compile(f"prefill[{tokens.shape[0]}]")  # trace-time
@@ -196,11 +238,17 @@ class Engine:
                       else time.monotonic() + deadline,
                       eos_id=eos_id if eos_id is not None else self.eos_id)
         # carry the caller's trace context (e.g. the frontend handler's
-        # wire trace id) onto the request so engine-side spans for it
-        # correlate across threads
-        req.trace_id = _tracing.TRACER.current_trace_id()
+        # wire trace id) onto the request — minting a fresh id for
+        # in-process callers, so EVERY request's flight timeline is
+        # keyed by a trace id even without a wire hop
+        req.trace_id = _tracing.TRACER.current_trace_id() \
+            or _tracing.new_trace_id()
         self.scheduler.submit(req)
         self._m_reqs.inc()
+        _flight.record("serving", "submit", trace_id=req.trace_id,
+                       engine=self.engine_id, request=req.id,
+                       prompt_len=int(req.prompt.size),
+                       max_new_tokens=req.max_new_tokens)
         self._wake.set()
         return req
 
@@ -259,7 +307,11 @@ class Engine:
                 np.int32(req.prompt.size), jnp.asarray(self._row(req),
                                                        dtype=jnp.int32))
             tok = int(tok)
-        self._m_prefill_h.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._m_prefill_h.observe(dt)
+        _flight.record("serving", "prefill", trace_id=req.trace_id,
+                       engine=self.engine_id, request=req.id,
+                       bucket=T, seconds=round(dt, 6))
         self._note_tokens(1)
         if self.scheduler.record_token(req, tok):
             self._note_done(req)
@@ -316,6 +368,8 @@ class Engine:
                 raise
             self._note_tokens(len(active))
             self._m_steps.inc()
+            _flight.record("serving", "step", engine=self.engine_id,
+                           active=len(active))
             for i, r in active:
                 if self.scheduler.record_token(r, int(next_toks[i])):
                     self._note_done(r)
@@ -398,16 +452,42 @@ class Engine:
 
     # -- stats ---------------------------------------------------------
     def _note_tokens(self, n: int):
+        self._wd_progress += 1
         self._m_tokens.inc(n)
         with self._stats_lock:
             self._tok_window.append((time.monotonic(), n))
 
     def _note_done(self, req: Request):
+        self._wd_progress += 1
         lat = req.latency()
         if lat is not None:
             self._m_latency_h.observe(lat)
             with self._stats_lock:
                 self._latencies.append(lat)
+        with self._stats_lock:
+            self._recent.append(_req_summary(req, "finished"))
+
+    # -- postmortem view (debug bundles / debug_dump verb) --------------
+    def _debug_requests(self) -> dict:
+        """JSON-safe in-flight table for postmortem bundles. Reads only
+        the scheduler's queue lock (never the step lock — a wedged
+        decode step holds that one, and this runs while it is stuck).
+        Queue AND slots are read under that one lock, matching admit's
+        dequeue+assign critical section, so no live request can fall
+        between the two lists."""
+        with self.scheduler._lock:
+            queued = list(self.scheduler.queue)
+            slotted = [(i, r) for i, r
+                       in enumerate(self.scheduler.slots)
+                       if r is not None]
+        inflight = [_req_summary(r, "queued") for r in queued]
+        inflight += [_req_summary(r, f"slot{i}") for i, r in slotted]
+        with self._stats_lock:
+            recent = list(self._recent)
+        return {"engine": self.engine_id,
+                "num_slots": self.num_slots,
+                "queue_depth": len(queued),
+                "inflight": inflight, "recent": recent}
 
     def stats(self) -> dict:
         """/stats counters: queue depth, latency percentiles, tokens/sec,

@@ -23,6 +23,10 @@ Ops:
     pool occupancy, preemptions, compile counters)
   {"op": "metrics"} -> Prometheus text over the process-wide telemetry
     registry (docs/OBSERVABILITY.md) — the serving scrape point
+  {"op": "debug_dump", "write": bool} -> a full postmortem bundle
+    (metrics + trace ring + flight rings + in-flight requests,
+    docs/DEBUGGING.md), optionally persisted into the server's own
+    PADDLE_TPU_DEBUG_DIR (never a wire-chosen path)
   {"op": "ping"}  -> True
 
 In-process use (tests, co-located workers) needs none of this — call
@@ -37,7 +41,8 @@ import numpy as np
 
 from ..distributed.fleet.runtime.rpc import (RpcClient, RpcServerState,
                                              serve_connection)
-from ..observability import registry as _obs, tracing as _tracing
+from ..observability import (debug as _debug, registry as _obs,
+                             tracing as _tracing)
 from .scheduler import QueueFull
 
 __all__ = ["ServingServer", "ServingClient"]
@@ -47,7 +52,7 @@ class ServingServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    READ_OPS = frozenset({"stats", "ping", "metrics"})
+    READ_OPS = frozenset({"stats", "ping", "metrics", "debug_dump"})
 
     def __init__(self, engine, endpoint: str = "127.0.0.1:0",
                  secret: str | None = None,
@@ -99,6 +104,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
             # Prometheus exposition over the whole process registry —
             # scrape point for the serving tier (docs/OBSERVABILITY.md)
             return _obs.prometheus_text()
+        if op == "debug_dump":
+            # full postmortem bundle on demand (docs/DEBUGGING.md):
+            # metrics + trace ring + flight rings + in-flight request
+            # table, persisted to the server-side PADDLE_TPU_DEBUG_DIR
+            # (never a wire-chosen path) and returned over the wire
+            return _debug.dump_verb(req)
         if op == "generate":
             prompt = np.asarray(req["prompt"], np.int32)
             # serve_connection already opened a span rooted at the wire
@@ -161,6 +172,15 @@ class ServingClient:
     def metrics(self) -> str:
         """Prometheus text from the serving process's registry."""
         return self._rpc.call({"op": "metrics"})
+
+    def debug_dump(self, write: bool = True) -> dict:
+        """Pull a full postmortem bundle from a (healthy or wedged)
+        server: metrics, trace ring, flight rings, env, in-flight
+        requests. ``write=True`` also persists it server-side into the
+        server's own PADDLE_TPU_DEBUG_DIR (the destination is never
+        wire-controlled; docs/DEBUGGING.md)."""
+        return self._rpc.call({"op": "debug_dump",
+                               "write": bool(write)})
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,

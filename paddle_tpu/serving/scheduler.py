@@ -30,7 +30,7 @@ from collections import deque
 
 import numpy as np
 
-from ..observability import registry as _obs
+from ..observability import flight as _flight, registry as _obs
 from .kv_cache import PagePool
 
 __all__ = ["Request", "Scheduler", "QueueFull"]
@@ -183,6 +183,10 @@ class Scheduler:
         with self._lock:
             if len(self.queue) >= self.max_queue:
                 self._m_rejected.inc()
+                _flight.record("serving", "reject",
+                               trace_id=req.trace_id, inst=self.inst,
+                               request=req.id, reason="queue_full",
+                               queue_depth=len(self.queue))
                 raise QueueFull(
                     f"queue at capacity ({self.max_queue}); retry later")
             self.queue.append(req)
@@ -239,14 +243,29 @@ class Scheduler:
                 head = self.queue[0]
                 table = self.pool.alloc_table(head.total_tokens)
                 if table is None:
+                    # the scheduler DECIDED to block admission: the
+                    # reason belongs in the flight record, it is what a
+                    # postmortem reader needs to explain a deep queue
+                    _flight.record("serving", "admit_blocked",
+                                   trace_id=head.trace_id,
+                                   inst=self.inst, request=head.id,
+                                   reason="pool_full",
+                                   need_tokens=head.total_tokens)
                     break            # pool full: wait for evictions
                 self.queue.popleft()
-            head.table = table
-            head.slot = i
-            head.status = "running"
-            head.started_at = self.now()
-            self.slots[i] = head
+                # slot assignment inside the SAME critical section as
+                # the dequeue: a postmortem snapshot reading queue +
+                # slots under this lock must never catch a request in
+                # neither place
+                head.table = table
+                head.slot = i
+                head.status = "running"
+                head.started_at = self.now()
+                self.slots[i] = head
             self._m_admitted.inc()
+            _flight.record("serving", "admit", trace_id=head.trace_id,
+                           inst=self.inst, request=head.id, slot=i,
+                           pages=len(table.pages))
             out.append(head)
         return out
 
@@ -290,6 +309,9 @@ class Scheduler:
         req.status = status
         req.finished_at = self.now()
         _EVICTIONS.labels(inst=self.inst, reason=status).inc()
+        _flight.record("serving", "evict", trace_id=req.trace_id,
+                       inst=self.inst, request=req.id, reason=status,
+                       generated=len(req.generated))
         req._done.set()
 
     def stats(self) -> dict:

@@ -42,8 +42,9 @@ NAME_RE = re.compile(r"^paddle_tpu_[a-z][a-z0-9_]*$")
 SKIP_FILES = {os.path.join("observability", "registry.py"),
               os.path.join("observability", "__init__.py")}
 
-# metric families whose presence is contractual (docs/CHECKPOINT.md):
-# a registration site must exist for each, or the check fails
+# metric families whose presence is contractual (docs/CHECKPOINT.md,
+# docs/DEBUGGING.md): a registration site must exist for each, or the
+# check fails
 REQUIRED_METRICS = {
     "paddle_tpu_ckpt_save_seconds",
     "paddle_tpu_ckpt_restore_seconds",
@@ -53,6 +54,20 @@ REQUIRED_METRICS = {
     "paddle_tpu_ckpt_wal_rows_appended_total",
     "paddle_tpu_ckpt_wal_compactions_total",
     "paddle_tpu_ckpt_manifests_committed_total",
+    # checkpoint async-writer queue (docs/DEBUGGING.md): a rising depth
+    # means the save cadence is outrunning the writer
+    "paddle_tpu_ckpt_writer_queue_depth",
+    "paddle_tpu_ckpt_writer_pending_bytes",
+    "paddle_tpu_ckpt_inflight_save_seconds",
+    # stall watchdog + flight recorder (docs/DEBUGGING.md): the
+    # postmortem tier's own observability is part of its acceptance
+    # contract — deleting it would ship silent hang detection
+    "paddle_tpu_watchdog_checks_total",
+    "paddle_tpu_watchdog_stalls_total",
+    "paddle_tpu_watchdog_stalled",
+    "paddle_tpu_watchdog_progress_age_seconds",
+    "paddle_tpu_flight_events_total",
+    "paddle_tpu_flight_dropped_total",
 }
 
 
