@@ -35,7 +35,10 @@ allreduce | metrics_overhead (telemetry enabled-vs-disabled decode
 step-time delta, <2% bar) | flight_overhead (flight recorder only
 toggled, same harness and bar) | checkpoint (store save/restore MB/s,
 dedup ratio on a 1%-mutated state, async-vs-sync save step overhead,
-<5% bar).
+<5% bar) | slo (open-loop traffic replay against the serving tier:
+SLO attainment, goodput, p99 TTFT/ITL) | chaos (same seeded traffic +
+a serving_decode stall mid-run: watchdog detection + recovery seconds
+and post-recovery SLO delta vs the fault-free baseline).
 """
 from __future__ import annotations
 
@@ -666,6 +669,167 @@ def bench_serving(num_requests=48, num_slots=8, hidden=512, layers=8,
             "pool_pages": st["pool"]["num_pages"]}
 
 
+def _slo_engine(hidden=256, layers=4, heads=4, num_slots=8, seed=0):
+    """Small serving engine, every prefill bucket + the decode program
+    pre-compiled (compiles must never land inside an SLO window)."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, GPTDecodeModel
+
+    cfg = GPTConfig(hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=256,
+                    vocab_size=4096)
+    eng = Engine(GPTDecodeModel(cfg, seed=seed), num_slots=num_slots,
+                 num_pages=128, page_size=8, max_seq_len=96)
+    for plen in (4, 8, 16, 32):
+        eng.submit(np.full((plen,), 1, np.int32), 2)
+    eng.run_until_idle()
+    return eng
+
+
+def _slo_traffic(duration, rate, seed):
+    from paddle_tpu.serving import TrafficConfig
+    return TrafficConfig(
+        rate=rate, duration=duration, arrival="diurnal",
+        diurnal_period=duration, seed=seed,
+        prompt_lens={4: 3, 8: 3, 16: 2, 32: 1},
+        output_lens={4: 3, 8: 2, 16: 1},
+        tenants={"web": 3, "batch": 1}, tiers={0: 1, 1: 2, 2: 1},
+        deadlines={0: 10.0, 1: 20.0, 2: None}, vocab_size=512)
+
+
+def bench_slo(duration=6.0, rate=30.0, seed=7):
+    """Production traffic replay (docs/SERVING.md harness): a seeded
+    open-loop diurnal mix of prompt/output lengths, tenants and
+    priority tiers drives the serving engine; reports SLO attainment
+    (met/offered), goodput (tokens from requests that met their
+    deadline) and p99 TTFT / inter-token latency at that offered
+    load."""
+    from paddle_tpu.serving import LoadGenerator, slo_report
+
+    eng = _slo_engine()
+    gen = LoadGenerator(_slo_traffic(duration, rate, seed),
+                        name="bench_slo")
+    with eng:
+        res = gen.run_engine(eng)
+        finished = res.wait(300)
+    rep = slo_report(res)
+    st = eng.stats()
+    return {"metric": "serving_slo_attainment",
+            "value": rep["attainment"], "unit": "met/offered",
+            "offered": rep["offered"],
+            "offered_rate_rps": rate, "duration_s": duration,
+            "goodput_tokens_per_sec": rep["goodput_tokens_per_sec"],
+            "ttft_ms_p50": rep["ttft_ms_p50"],
+            "ttft_ms_p99": rep["ttft_ms_p99"],
+            "itl_ms_p99": rep["itl_ms_p99"],
+            "by_status": rep["by_status"],
+            "shed": st["shed"], "preemptions": st["preemptions"],
+            "expired_in_queue": st["expired_in_queue"],
+            "all_finished": bool(finished)}
+
+
+def bench_chaos(duration=8.0, rate=25.0, seed=7, stall_s=0.8,
+                wd_deadline=0.5):
+    """Chaos drill as a bench (docs/DEBUGGING.md recipe): the SAME
+    seeded traffic replayed twice — fault-free baseline, then with the
+    serving_decode stall knob wedging the step thread mid-run. Reports
+    watchdog detection seconds, recovery seconds (fault armed ->
+    progress again), and the post-recovery SLO attainment delta vs the
+    baseline's identical traffic slice."""
+    import threading
+
+    from paddle_tpu.distributed.fleet.runtime import (
+        fault_injection as fi)
+    from paddle_tpu.observability.watchdog import WATCHDOG
+    from paddle_tpu.serving import LoadGenerator, slo_report
+
+    mk_gen = lambda name: LoadGenerator(
+        _slo_traffic(duration, rate, seed), name=name)
+    eng_a = _slo_engine()
+    with eng_a:
+        res_a = mk_gen("chaos_base").run_engine(eng_a)
+        res_a.wait(300)
+    base = slo_report(res_a)
+
+    # the engine's watchdog token captures its deadline at registration
+    prev = os.environ.get("PADDLE_TPU_WATCHDOG_DEADLINE")
+    os.environ["PADDLE_TPU_WATCHDOG_DEADLINE"] = str(wd_deadline)
+    try:
+        eng_b = _slo_engine()
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_WATCHDOG_DEADLINE", None)
+        else:
+            os.environ["PADDLE_TPU_WATCHDOG_DEADLINE"] = prev
+    token = f"serving.engine.{eng_b.engine_id}"
+    box = []
+    detect_s = recovery_s = None
+    with eng_b:
+        runner = threading.Thread(
+            target=lambda: box.append(
+                mk_gen("chaos_fault").run_engine(eng_b)), daemon=True)
+        runner.start()
+        time.sleep(min(1.0, duration / 4))          # traffic flowing
+        t_fault = time.monotonic()
+        fi.reset_injector(fi.FaultInjector(
+            stall=stall_s, stall_point="serving_decode"))
+        while detect_s is None \
+                and time.monotonic() - t_fault < 30:
+            # level-triggered stalled(), not check_once()'s fire
+            # event: an auto-started watchdog poll thread
+            # (PADDLE_TPU_WATCHDOG=1) would consume the edge
+            WATCHDOG.check_once()
+            if token in WATCHDOG.stalled():
+                detect_s = time.monotonic() - t_fault
+            time.sleep(0.05)
+        fi.reset_injector(fi.FaultInjector())
+        t_cleared = time.monotonic()
+        while recovery_s is None \
+                and time.monotonic() - t_cleared < 30:
+            WATCHDOG.check_once()
+            if token not in WATCHDOG.stalled():
+                recovery_s = time.monotonic() - t_fault
+            time.sleep(0.05)
+        runner.join(timeout=300)
+        res_b = box[0] if box else None
+        if res_b is not None:
+            res_b.wait(300)
+    faulted = slo_report(res_b) if res_b is not None else None
+    # post-recovery window: identical arrivals in both runs
+    post = post_base = None
+    if res_b is not None and recovery_s is not None:
+        rec_off = (t_cleared + stall_s) - res_b.started_at
+        if rec_off < duration - 0.5:
+            post = slo_report(res_b, window=(rec_off, float("inf")),
+                              gen="chaos_post")
+            post_base = slo_report(res_a,
+                                   window=(rec_off, float("inf")),
+                                   gen="chaos_post_base")
+    delta = None
+    if post is not None and post_base is not None \
+            and post_base["attainment"] is not None:
+        delta = round(post_base["attainment"] - post["attainment"], 4)
+    return {"metric": "serving_chaos_slo_delta", "value": delta,
+            "unit": "attainment_drop_post_recovery",
+            "fault": f"stall@serving_decode {stall_s}s",
+            "detect_s": None if detect_s is None
+            else round(detect_s, 3),
+            "recovery_s": None if recovery_s is None
+            else round(recovery_s, 3),
+            "baseline_attainment": base["attainment"],
+            "faulted_attainment": None if faulted is None
+            else faulted["attainment"],
+            "post_recovery_attainment": None if post is None
+            else post["attainment"],
+            "post_recovery_baseline": None if post_base is None
+            else post_base["attainment"],
+            "baseline_goodput_tokens_per_sec":
+                base["goodput_tokens_per_sec"],
+            "faulted_goodput_tokens_per_sec": None if faulted is None
+            else faulted["goodput_tokens_per_sec"],
+            "offered_rate_rps": rate, "duration_s": duration}
+
+
 def _bench_serving_toggle_overhead(set_enabled, metric_name, steps=200,
                                    hidden=256, layers=4, heads=4,
                                    slots=4, seed=0):
@@ -1000,6 +1164,10 @@ def main():
         rec = bench_infer_latency()
     elif which == "serving":
         rec = bench_serving()
+    elif which == "slo":
+        rec = bench_slo()
+    elif which == "chaos":
+        rec = bench_chaos()
     elif which == "metrics_overhead":
         rec = bench_metrics_overhead()
     elif which == "flight_overhead":

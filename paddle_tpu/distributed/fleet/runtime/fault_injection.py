@@ -28,26 +28,49 @@ frame when nothing is configured):
                                 catch; the in-flight op pins the tier
                                 non-idle while its progress counter
                                 freezes)
-  PADDLE_PS_FAULT_STALL_POINT=dispatch    where to stall (currently the
-                                PS server's dispatch path)
+  PADDLE_PS_FAULT_STALL_POINT=dispatch|serving_decode   where to stall:
+                                the PS server's dispatch path, or the
+                                serving engine's decode step (the step
+                                thread wedges INSIDE its step lock —
+                                the chaos-drill fault for the serving
+                                tier, docs/DEBUGGING.md)
   PADDLE_PS_FAULT_SIDE=client|server|both   which transport end injects
                                 (default both — set it when client and
                                 server share one process env)
   PADDLE_PS_FAULT_SEED=n        deterministic fault schedule
 
+A PADDLE_PS_FAULT_-prefixed env var that is NOT one of the above is a
+typo (a chaos drill that silently injects nothing is worse than one
+that fails loudly): `from_env` logs a warning naming it.
+
 Counters (`injector().counters`) are exposed for tests and benchmarks.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 
 import numpy as np
 
-__all__ = ["FaultInjector", "injector", "reset_injector"]
+__all__ = ["FaultInjector", "injector", "reset_injector",
+           "KNOWN_FAULT_KNOBS"]
 
 KILL_EXIT_CODE = 23
+
+# every env knob from_env reads; anything else under the prefix is a
+# misspelling the guard below flags
+KNOWN_FAULT_KNOBS = frozenset({
+    "PADDLE_PS_FAULT_DROP", "PADDLE_PS_FAULT_DELAY",
+    "PADDLE_PS_FAULT_TRUNCATE", "PADDLE_PS_FAULT_CORRUPT",
+    "PADDLE_PS_FAULT_KILL_AFTER", "PADDLE_PS_FAULT_KILL_POINT",
+    "PADDLE_PS_FAULT_KILL_AFTER_BYTES", "PADDLE_PS_FAULT_STALL",
+    "PADDLE_PS_FAULT_STALL_POINT", "PADDLE_PS_FAULT_SIDE",
+    "PADDLE_PS_FAULT_SEED",
+})
+
+logger = logging.getLogger(__name__)
 
 
 class FaultInjector:
@@ -79,6 +102,15 @@ class FaultInjector:
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
+        # typo guard: a misspelled knob (KILL_AFTR, STAL, ...) would
+        # otherwise arm NOTHING and the drill would "pass" fault-free
+        unknown = sorted(k for k in os.environ
+                         if k.startswith("PADDLE_PS_FAULT_")
+                         and k not in KNOWN_FAULT_KNOBS)
+        if unknown:
+            logger.warning(
+                "ignoring unknown fault knob(s) %s — known knobs: %s",
+                ", ".join(unknown), ", ".join(sorted(KNOWN_FAULT_KNOBS)))
         e = os.environ.get
         return cls(
             drop=float(e("PADDLE_PS_FAULT_DROP", "0") or 0),

@@ -24,13 +24,18 @@ Network mode (PS wire format, see serving/frontend.py):
     out = ServingClient(srv.endpoint).generate([1, 2, 3], 16)
 """
 from .kv_cache import PagePool, PageTable, defrag_plan, pages_needed
-from .scheduler import QueueFull, Request, Scheduler
+from .scheduler import (QueueFull, QuotaExceeded, Request, Scheduler,
+                        TokenBucket)
 from .model import GPTDecodeModel
 from .engine import Engine
 from .frontend import ServingClient, ServingServer
+from .loadgen import (Arrival, LoadGenerator, LoadResult, TrafficConfig,
+                      slo_report)
 
 __all__ = [
     "PagePool", "PageTable", "pages_needed", "defrag_plan",
-    "Request", "Scheduler", "QueueFull",
+    "Request", "Scheduler", "QueueFull", "QuotaExceeded", "TokenBucket",
     "GPTDecodeModel", "Engine", "ServingServer", "ServingClient",
+    "Arrival", "LoadGenerator", "LoadResult", "TrafficConfig",
+    "slo_report",
 ]

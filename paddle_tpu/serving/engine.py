@@ -34,6 +34,7 @@ from collections import defaultdict, deque
 
 import numpy as np
 
+from ..distributed.fleet.runtime import fault_injection as _fi
 from ..observability import (debug as _debug, flight as _flight,
                              registry as _obs, tracing as _tracing,
                              watchdog as _watchdog)
@@ -100,6 +101,7 @@ def _req_summary(req: Request, where: str) -> dict:
             "prompt_len": int(req.prompt.size),
             "generated": len(req.generated),
             "max_new_tokens": req.max_new_tokens, "slot": req.slot,
+            "tier": req.priority, "tenant": req.tenant,
             "age_s": round(time.monotonic() - req.submitted_at, 3),
             "error": req.error}
 
@@ -230,13 +232,18 @@ class Engine:
     # -- submission (any thread) ---------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
                deadline: float | None = None,
-               eos_id: int | None = None) -> Request:
+               eos_id: int | None = None, priority: int = 1,
+               tenant: str = "default") -> Request:
         """Enqueue a request. `deadline` is RELATIVE seconds from now;
-        raises QueueFull (backpressure) when the queue is at capacity."""
+        raises QueueFull (backpressure) when the queue is at capacity
+        and QuotaExceeded (a QueueFull) when `tenant` is over its
+        token-bucket quota. `priority` is the admission tier
+        (0 = highest; see scheduler.Scheduler)."""
         req = Request(prompt, max_new_tokens,
                       deadline=None if deadline is None
                       else time.monotonic() + deadline,
-                      eos_id=eos_id if eos_id is not None else self.eos_id)
+                      eos_id=eos_id if eos_id is not None else self.eos_id,
+                      priority=priority, tenant=tenant)
         # carry the caller's trace context (e.g. the frontend handler's
         # wire trace id) onto the request — minting a fresh id for
         # in-process callers, so EVERY request's flight timeline is
@@ -248,17 +255,20 @@ class Engine:
         _flight.record("serving", "submit", trace_id=req.trace_id,
                        engine=self.engine_id, request=req.id,
                        prompt_len=int(req.prompt.size),
-                       max_new_tokens=req.max_new_tokens)
+                       max_new_tokens=req.max_new_tokens,
+                       tier=req.priority, tenant=req.tenant)
         self._wake.set()
         return req
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,
-                 timeout: float | None = 120.0) -> np.ndarray:
+                 timeout: float | None = 120.0, priority: int = 1,
+                 tenant: str = "default") -> np.ndarray:
         """Blocking convenience: submit + wait (requires the scheduler
         thread running, or another thread driving step())."""
-        return self.submit(prompt, max_new_tokens,
-                           deadline=deadline).result(timeout)
+        return self.submit(prompt, max_new_tokens, deadline=deadline,
+                           priority=priority,
+                           tenant=tenant).result(timeout)
 
     # -- checkpoint warm-start ------------------------------------------
     def warm_start(self, root: str, step: int | None = None):
@@ -345,6 +355,12 @@ class Engine:
                 tokens[i] = r.generated[-1]
                 positions[i] = r.position
                 tables[i] = self._row(r)
+            # hang injection (chaos drills): PADDLE_PS_FAULT_STALL with
+            # PADDLE_PS_FAULT_STALL_POINT=serving_decode wedges the
+            # step thread here — inside the step lock, exactly like a
+            # hung jitted decode — which is what the stall watchdog
+            # must catch while requests keep queueing
+            _fi.injector().maybe_stall("serving_decode")
             try:
                 t0 = time.perf_counter()
                 with _tracing.span("engine.decode",

@@ -8,9 +8,14 @@ drop is served from the dedup cache instead of decoding twice.
 
 Ops:
   {"op": "generate", "prompt": <int ndarray>, "max_new_tokens": n,
-   "deadline": seconds|None, "timeout": seconds}
-      -> {"status": "done"|"deadline"|"timeout"|"rejected"|"error",
+   "deadline": seconds|None, "timeout": seconds,
+   "priority": tier (0 = highest, default 1), "tenant": str}
+      -> {"status": "done"|"deadline"|"timeout"|"rejected"|"shed"|
+                    "error",
           "tokens": <int32 ndarray>, ...}
+    Backpressure AND tenant-quota rejections reply status="rejected";
+    a queued request shed for a higher-priority submit replies
+    status="shed" (docs/SERVING.md admission control).
     Blocks the connection's handler thread until the request finishes
     (the engine keeps batching others meanwhile). Backpressure surfaces
     as status="rejected" — a well-formed reply, not a transport error,
@@ -120,7 +125,9 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 try:
                     h = self.engine.submit(
                         prompt, int(req.get("max_new_tokens", 16)),
-                        deadline=req.get("deadline"))
+                        deadline=req.get("deadline"),
+                        priority=int(req.get("priority", 1)),
+                        tenant=str(req.get("tenant", "default")))
                 except QueueFull as e:
                     sp.attrs["status"] = "rejected"
                     return {"status": "rejected", "error": str(e)}
@@ -184,11 +191,13 @@ class ServingClient:
 
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,
-                 timeout: float = 120.0) -> dict:
+                 timeout: float = 120.0, priority: int = 1,
+                 tenant: str = "default") -> dict:
         return self._rpc.call(
             {"op": "generate", "prompt": np.asarray(prompt, np.int32),
              "max_new_tokens": int(max_new_tokens),
-             "deadline": deadline, "timeout": timeout},
+             "deadline": deadline, "timeout": timeout,
+             "priority": int(priority), "tenant": str(tenant)},
             timeout=timeout + 30.0, deadline=timeout + 60.0)
 
     def close(self):

@@ -1,0 +1,444 @@
+"""Production traffic harness: open-loop load generation + SLO report.
+
+The serving tier has only ever been driven closed-loop (submit, wait,
+submit) — which can never expose queueing collapse, because a slow
+server slows its own offered load. This module is the open-loop
+replayer the ROADMAP's production-traffic item calls for: arrivals
+fire on a precomputed schedule whether or not earlier requests
+finished, the way traffic from millions of independent users does.
+
+Design rules:
+
+  * deterministic — every arrival time, prompt length, output length,
+    tenant, tier and prompt token comes from a counter-based Philox
+    stream keyed by ``TrafficConfig.seed``; two generators with the
+    same config produce byte-identical schedules (no wall-clock
+    randomness, so chaos tests can replay the exact same traffic
+    around an injected fault);
+  * open loop — `run` submits on schedule and NEVER waits for
+    completions; backpressure shows up as rejected/shed counts in the
+    report, not as a silenced arrival process;
+  * arrival processes — `constant`, `diurnal` (sinusoidal rate
+    modulation, a day compressed into `diurnal_period` seconds) and
+    `bursty` (square-wave on/off bursts), all realised by thinning a
+    homogeneous Poisson stream at the peak rate;
+  * tagged requests — tenant, priority tier and per-tier relative
+    deadline ride each request into the scheduler's admission control
+    (priority aging, token-bucket quotas, shed-by-priority);
+  * SLOs are first-class — `slo_report` turns the finished handles
+    into p50/p99 TTFT, p99 inter-token latency, deadline attainment
+    and goodput (tokens from requests that met their deadline), and
+    mirrors them onto ``paddle_tpu_slo_*`` registry metrics so a
+    scrape sees the same numbers the bench JSON reports.
+
+No jax imports — the generator drives an Engine (in-process), a
+ServingClient (wire) or any submit callable, and is unit-testable
+against a bare Scheduler (tests/test_slo_harness.py).
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ..observability import registry as _obs
+from .scheduler import QueueFull
+
+__all__ = ["TrafficConfig", "Arrival", "LoadGenerator", "LoadResult",
+           "slo_report"]
+
+# SLO surface (docs/SERVING.md): the load generator writes what it
+# measured, labeled per generator run, so `/metrics` exposes the same
+# attainment/goodput numbers the bench JSON rows carry
+_TTFT_H = _obs.histogram(
+    "paddle_tpu_slo_ttft_seconds",
+    "submit-to-first-token latency of generated traffic", ["gen"])
+_ITL_H = _obs.histogram(
+    "paddle_tpu_slo_inter_token_seconds",
+    "mean inter-token latency per finished request", ["gen"])
+_MET = _obs.counter(
+    "paddle_tpu_slo_deadline_met_total",
+    "generated requests that completed within their deadline", ["gen"])
+_MISSED = _obs.counter(
+    "paddle_tpu_slo_deadline_missed_total",
+    "generated requests that expired, were preempted, shed, rejected "
+    "or errored", ["gen"])
+_GOODPUT = _obs.counter(
+    "paddle_tpu_slo_goodput_tokens_total",
+    "tokens from requests that met their deadline", ["gen"])
+_ATTAIN = _obs.gauge(
+    "paddle_tpu_slo_attainment_ratio",
+    "met requests / offered requests for the latest report", ["gen"])
+
+_gen_ids = itertools.count()
+
+
+def _drop_gen_series(gen: str):
+    for m in (_TTFT_H, _ITL_H, _MET, _MISSED, _GOODPUT, _ATTAIN):
+        m.remove_matching(gen=gen)
+
+
+def _weighted(rng: np.random.Generator, choices):
+    """choices: dict value -> weight (or list of (value, weight))."""
+    items = list(choices.items()) if isinstance(choices, dict) \
+        else list(choices)
+    vals = [v for v, _ in items]
+    w = np.asarray([float(p) for _, p in items], np.float64)
+    return vals[int(rng.choice(len(vals), p=w / w.sum()))]
+
+
+class TrafficConfig:
+    """One traffic mix. All rates are requests/sec of OFFERED load."""
+
+    def __init__(self, rate: float = 20.0, duration: float = 5.0,
+                 arrival: str = "constant",
+                 diurnal_period: float = 10.0,
+                 diurnal_depth: float = 0.8,
+                 burst_period: float = 2.0, burst_fraction: float = 0.25,
+                 burst_factor: float = 4.0,
+                 prompt_lens=None, output_lens=None,
+                 tenants=None, tiers=None, deadlines=None,
+                 vocab_size: int = 256, seed: int = 0):
+        if arrival not in ("constant", "diurnal", "bursty"):
+            raise ValueError(f"unknown arrival process {arrival!r}")
+        if not 0.0 <= diurnal_depth < 1.0:
+            raise ValueError("diurnal_depth must be in [0, 1)")
+        self.rate = float(rate)
+        self.duration = float(duration)
+        self.arrival = arrival
+        self.diurnal_period = float(diurnal_period)
+        self.diurnal_depth = float(diurnal_depth)
+        self.burst_period = float(burst_period)
+        self.burst_fraction = float(burst_fraction)
+        self.burst_factor = float(burst_factor)
+        # mixed-length traffic (Ragged Paged Attention regime): short
+        # chat turns next to long-context prompts, short and long
+        # generations interleaved
+        self.prompt_lens = prompt_lens or {4: 4, 8: 3, 16: 2, 32: 1}
+        self.output_lens = output_lens or {2: 3, 4: 3, 8: 2, 16: 1}
+        self.tenants = tenants or {"default": 1}
+        self.tiers = tiers or {0: 1, 1: 2, 2: 1}
+        # per-tier RELATIVE deadline seconds (None = unbounded)
+        self.deadlines = deadlines if deadlines is not None \
+            else {0: 30.0, 1: 60.0, 2: None}
+        self.vocab_size = int(vocab_size)
+        self.seed = int(seed)
+
+    # -- time-varying offered rate --------------------------------------
+    def rate_at(self, t: float) -> float:
+        if self.arrival == "diurnal":
+            return self.rate * (1.0 + self.diurnal_depth * math.sin(
+                2.0 * math.pi * t / self.diurnal_period))
+        if self.arrival == "bursty":
+            frac = (t % self.burst_period) / self.burst_period
+            return self.rate * self.burst_factor \
+                if frac < self.burst_fraction else self.rate
+        return self.rate
+
+    @property
+    def peak_rate(self) -> float:
+        if self.arrival == "diurnal":
+            return self.rate * (1.0 + self.diurnal_depth)
+        if self.arrival == "bursty":
+            return self.rate * self.burst_factor
+        return self.rate
+
+
+class Arrival:
+    """One scheduled request: offset seconds from run start + tags."""
+
+    __slots__ = ("index", "t", "prompt", "max_new_tokens", "tenant",
+                 "tier", "deadline")
+
+    def __init__(self, index, t, prompt, max_new_tokens, tenant, tier,
+                 deadline):
+        self.index = index
+        self.t = t
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.tenant = tenant
+        self.tier = tier
+        self.deadline = deadline
+
+    def __repr__(self):
+        return (f"Arrival({self.index}, t={self.t:.4f}, "
+                f"plen={len(self.prompt)}, mnt={self.max_new_tokens}, "
+                f"tenant={self.tenant!r}, tier={self.tier}, "
+                f"deadline={self.deadline})")
+
+
+class LoadResult:
+    """What a run produced: (arrival, handle) pairs for submitted
+    requests plus the arrivals the scheduler turned away at submit."""
+
+    def __init__(self, name: str, started_at: float, elapsed: float):
+        self.name = name
+        self.started_at = started_at
+        self.elapsed = elapsed
+        self.handles: list[tuple[Arrival, object]] = []
+        self.rejected: list[Arrival] = []
+        # gen labels slo_report already mirrored to the registry for
+        # this result: re-reporting (full run, then a window slice)
+        # must not double-count the paddle_tpu_slo_* series
+        self._mirrored: set[str] = set()
+
+    @property
+    def offered(self) -> int:
+        return len(self.handles) + len(self.rejected)
+
+    def wait(self, timeout: float = 120.0) -> bool:
+        """Block until every submitted request finished (including
+        shed/preempted — anything that set its done event)."""
+        deadline = time.monotonic() + timeout
+        for _, h in self.handles:
+            if not h.wait(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+
+class LoadGenerator:
+    """Deterministic open-loop replayer for one TrafficConfig."""
+
+    def __init__(self, cfg: TrafficConfig, name: str | None = None):
+        self.cfg = cfg
+        self.name = name if name is not None else f"g{next(_gen_ids)}"
+        # a dead generator's series leave the exposition
+        weakref.finalize(self, _drop_gen_series, self.name)
+
+    # -- schedule (pure, deterministic) ---------------------------------
+    def schedule(self) -> list[Arrival]:
+        """The full arrival list for this config — counter-based Philox
+        streams only, so the same seed replays byte-identically."""
+        cfg = self.cfg
+        rng = np.random.Generator(np.random.Philox(key=cfg.seed))
+        lam = cfg.peak_rate
+        out: list[Arrival] = []
+        t = 0.0
+        i = 0
+        while True:
+            t += float(rng.exponential(1.0 / lam))
+            if t >= cfg.duration:
+                break
+            # thinning: keep the candidate with prob rate(t)/peak
+            if float(rng.random()) > cfg.rate_at(t) / lam:
+                continue
+            plen = int(_weighted(rng, cfg.prompt_lens))
+            mnt = int(_weighted(rng, cfg.output_lens))
+            tenant = str(_weighted(rng, cfg.tenants))
+            tier = int(_weighted(rng, cfg.tiers))
+            deadline = cfg.deadlines.get(tier)
+            # prompt tokens from a stream keyed by (seed, index): the
+            # i-th request's content does not depend on how many
+            # earlier candidates the thinning pass dropped
+            prng = np.random.Generator(np.random.Philox(
+                key=(cfg.seed, i)))
+            prompt = prng.integers(0, cfg.vocab_size, size=plen,
+                                   dtype=np.int64).astype(np.int32)
+            out.append(Arrival(i, t, prompt, mnt, tenant, tier,
+                               deadline))
+            i += 1
+        return out
+
+    # -- execution ------------------------------------------------------
+    def run(self, submit, *, now=time.monotonic, sleep=time.sleep,
+            stop: threading.Event | None = None) -> LoadResult:
+        """Open-loop replay: call ``submit(arrival)`` at each scheduled
+        offset (late submits fire immediately — the generator never
+        skips offered load). `submit` returns a handle with
+        ``wait(timeout)`` (e.g. scheduler.Request) or None for
+        fire-and-forget transports; QueueFull/QuotaExceeded count as
+        rejected offered load, and so does a ValueError from an
+        arrival the target cannot serve (prompt+max_new over the
+        engine's max_seq_len) — one oversized arrival must not abort
+        the replay, or the same-arrivals baseline/faulted comparison
+        breaks. `stop` aborts the replay early."""
+        t0 = now()
+        res = LoadResult(self.name, t0, 0.0)
+        for arr in self.schedule():
+            if stop is not None and stop.is_set():
+                break
+            delay = (t0 + arr.t) - now()
+            if delay > 0:
+                sleep(delay)
+            try:
+                h = submit(arr)
+            except (QueueFull, ValueError):
+                res.rejected.append(arr)
+                continue
+            if h is not None:
+                res.handles.append((arr, h))
+        res.elapsed = now() - t0
+        return res
+
+    def run_engine(self, engine, **kw) -> LoadResult:
+        """Replay against a serving Engine in-process."""
+        def submit(arr: Arrival):
+            return engine.submit(arr.prompt, arr.max_new_tokens,
+                                 deadline=arr.deadline,
+                                 priority=arr.tier, tenant=arr.tenant)
+        return self.run(submit, **kw)
+
+    def run_client(self, client, timeout: float = 120.0,
+                   **kw) -> LoadResult:
+        """Replay over the wire (serving/frontend.py ServingClient).
+        The blocking `generate` calls run on their own threads so the
+        arrival process stays open-loop; each handle mimics Request
+        enough for slo_report (wait/status/generated/deadline...).
+        The wire `generate` is one-shot (no streaming), so a wire
+        handle cannot observe first/inter-token times: slo_report over
+        a run_client result carries attainment + goodput but
+        ttft/itl percentiles are None (in-process run_engine reports
+        the full surface)."""
+        threads: list[threading.Thread] = []
+
+        class _WireHandle:
+            def __init__(self, arr: Arrival, submitted_at: float):
+                self.status = "pending"
+                self.generated: list[int] = []
+                self.deadline = None if arr.deadline is None \
+                    else submitted_at + arr.deadline
+                self._queued_at = submitted_at
+                self.submitted_at = submitted_at
+                self.finished_at = None
+                self.first_token_at = None
+                self.last_token_at = None
+                self._done = threading.Event()
+
+            def wait(self, t=None):
+                return self._done.wait(t)
+
+            def ttft(self):
+                return None
+
+            def inter_token(self):
+                return None
+
+        def submit(arr: Arrival):
+            h = _WireHandle(arr, time.monotonic())
+
+            def call():
+                try:
+                    rep = client.generate(
+                        arr.prompt, arr.max_new_tokens,
+                        deadline=arr.deadline, timeout=timeout,
+                        priority=arr.tier, tenant=arr.tenant)
+                    h.status = rep.get("status", "error")
+                    h.generated = list(np.asarray(
+                        rep.get("tokens", ())).ravel())
+                except Exception:
+                    h.status = "error"
+                h.finished_at = time.monotonic()
+                h._done.set()
+
+            th = threading.Thread(target=call, daemon=True)
+            th.start()
+            threads.append(th)
+            return h
+
+        res = self.run(submit, **kw)
+        for th in threads:
+            th.join(timeout)
+        return res
+
+
+def _pct(sorted_vals: list[float], p: float) -> float | None:
+    """Nearest-rank percentile: the smallest value with at least p% of
+    the samples at or below it (p50 of [a, b] is a, not b)."""
+    if not sorted_vals:
+        return None
+    i = max(0, math.ceil(p / 100.0 * len(sorted_vals)) - 1)
+    return sorted_vals[min(len(sorted_vals) - 1, i)]
+
+
+def slo_report(result: LoadResult, window: tuple | None = None,
+               gen: str | None = None) -> dict:
+    """SLO attainment over a LoadResult (call after `result.wait()`).
+
+    A request MEETS its SLO when it finished with status "done" within
+    its deadline (unbounded requests just need "done"); expired,
+    preempted, shed, rejected and errored requests miss. Goodput counts
+    only tokens from requests that met. `window=(lo, hi)` restricts the
+    report to arrivals with lo <= arr.t < hi — how the chaos drills
+    compare pre-fault / post-recovery slices of one run; rates
+    (goodput_tokens_per_sec) are then per second of the WINDOW, not of
+    the whole run.
+    """
+    gen = gen if gen is not None else result.name
+    pairs = result.handles
+    rejected = list(result.rejected)
+    span = max(result.elapsed, 1e-9)
+    if window is not None:
+        lo, hi = window
+        pairs = [(a, h) for a, h in pairs if lo <= a.t < hi]
+        rejected = [a for a in rejected if lo <= a.t < hi]
+        # rates are per second OF THE WINDOW, not of the whole run —
+        # a post-recovery slice must not be diluted by pre-fault time
+        span = max(min(hi, result.elapsed) - max(lo, 0.0), 1e-9)
+    # mirror to the registry once per (result, gen): the docs idiom —
+    # slo_report(res) then slo_report(res, window=...) — must not
+    # double-count the scrape surface. Custom gen labels have no
+    # LoadGenerator finalizer, so their series lifetime is tied to the
+    # RESULT they were mirrored through (no unbounded exposition from
+    # periodic windowed reports with unique labels).
+    mirror = gen not in result._mirrored
+    result._mirrored.add(gen)
+    if mirror:
+        weakref.finalize(result, _drop_gen_series, gen)
+    ttfts: list[float] = []
+    itls: list[float] = []
+    met = 0
+    good_tokens = 0
+    by_status: dict[str, int] = {}
+    for arr, h in pairs:
+        by_status[h.status] = by_status.get(h.status, 0) + 1
+        tt = h.ttft()
+        if tt is not None:
+            ttfts.append(tt)
+            if mirror:
+                _TTFT_H.labels(gen=gen).observe(tt)
+        itl = h.inter_token()
+        if itl is not None:
+            itls.append(itl)
+            if mirror:
+                _ITL_H.labels(gen=gen).observe(itl)
+        ok = h.status == "done" and (
+            h.deadline is None or h.finished_at is None
+            or h.finished_at <= h.deadline)
+        if ok:
+            met += 1
+            good_tokens += len(h.generated)
+        if mirror:
+            (_MET if ok else _MISSED).labels(gen=gen).inc()
+    if mirror:
+        _MISSED.labels(gen=gen).inc(len(rejected))
+    by_status["rejected"] = by_status.get("rejected", 0) + len(rejected)
+    offered = len(pairs) + len(rejected)
+    attainment = met / offered if offered else None
+    if mirror:
+        if attainment is not None:
+            _ATTAIN.labels(gen=gen).set(attainment)
+        _GOODPUT.labels(gen=gen).inc(good_tokens)
+    ttfts.sort()
+    itls.sort()
+    return {
+        "offered": offered,
+        "met": met,
+        "attainment": round(attainment, 4) if attainment is not None
+        else None,
+        "goodput_tokens_per_sec": round(good_tokens / span, 2),
+        "goodput_tokens": good_tokens,
+        "ttft_ms_p50": None if not ttfts
+        else round(_pct(ttfts, 50) * 1e3, 3),
+        "ttft_ms_p99": None if not ttfts
+        else round(_pct(ttfts, 99) * 1e3, 3),
+        "itl_ms_p50": None if not itls
+        else round(_pct(itls, 50) * 1e3, 3),
+        "itl_ms_p99": None if not itls
+        else round(_pct(itls, 99) * 1e3, 3),
+        "by_status": by_status,
+        "elapsed_s": round(result.elapsed, 3),
+    }

@@ -5,20 +5,36 @@ is exactly one compiled decode program per (slots, pages) bucket); this
 module is the policy layer that decides, between steps, which requests
 occupy those slots:
 
-  * admission — FIFO from the queue into free slots, gated by the page
-    pool: a request is admitted only when its WORST-CASE page demand
-    (prompt + max_new_tokens) is allocatable, so an admitted request can
-    never run out of pages mid-decode (no mid-flight OOM, no deadlock);
+  * admission — priority-ordered from the queue into free slots, gated
+    by the page pool: a request is admitted only when its WORST-CASE
+    page demand (prompt + max_new_tokens) is allocatable, so an admitted
+    request can never run out of pages mid-decode (no mid-flight OOM,
+    no deadlock). Requests carry a priority TIER (0 = highest); within
+    a tier, order is FIFO with head-of-line blocking, and a waiting
+    request's effective tier rises one step per `aging_s` seconds so a
+    sustained high-tier flood can never starve the low tiers;
+  * quotas — per-tenant token buckets charge each ENQUEUED submit its
+    worst-case token demand (a submit that bounces off a full queue is
+    not charged); an over-quota tenant is rejected at submit
+    (`QuotaExceeded`, a `QueueFull` subclass so frontends reply
+    "rejected", not a transport error);
+  * shedding — when the queue is at capacity, a submit sheds the
+    lowest-effective-priority queued request instead of rejecting a
+    HIGHER-priority newcomer (status "shed"); equal-or-lower newcomers
+    are rejected as before (backpressure semantics unchanged);
   * prefill-then-decode — a newly admitted request is prefilled once
     (its prompt KV written to its pages, first token sampled), then
     joins the in-flight decode batch;
   * eviction — EOS or max_new_tokens completes a request; a missed
     deadline preempts it (partial output returned, ALL its pages freed
-    back to the pool that step);
-  * backpressure — the bounded queue rejects submits past `max_queue`.
+    back to the pool that step). A deadline that lapses while the
+    request is still QUEUED counts separately (`expired_in_queue`):
+    admission-control tuning must distinguish "never ran" from
+    "ran out of time mid-decode".
 
 Pure host logic over kv_cache.PagePool — no jax imports — so the policy
-is unit-testable without a model (tests/test_serving.py).
+is unit-testable without a model (tests/test_serving.py,
+tests/test_slo_harness.py).
 """
 from __future__ import annotations
 
@@ -33,7 +49,8 @@ import numpy as np
 from ..observability import flight as _flight, registry as _obs
 from .kv_cache import PagePool
 
-__all__ = ["Request", "Scheduler", "QueueFull"]
+__all__ = ["Request", "Scheduler", "QueueFull", "QuotaExceeded",
+           "TokenBucket"]
 
 # lifecycle counters on the process-wide registry, labeled per scheduler
 # instance; Scheduler.stats() keys are unchanged — they now READ these
@@ -55,17 +72,70 @@ _EVICTIONS = _obs.counter(
     "paddle_tpu_serving_evictions_total",
     "requests leaving the slot table / queue, by reason",
     ["inst", "reason"])
+_EXPIRED_QUEUE = _obs.counter(
+    "paddle_tpu_serving_expired_in_queue_total",
+    "queued requests whose deadline lapsed before they ever ran "
+    "(distinct from running-request preemptions)", ["inst"],
+    always=True)
+_SHED = _obs.counter(
+    "paddle_tpu_serving_shed_total",
+    "queued requests shed to make room for a higher-priority submit",
+    ["inst"], always=True)
+_QUOTA_REJECTED = _obs.counter(
+    "paddle_tpu_serving_quota_rejected_total",
+    "submits rejected by a tenant token-bucket quota", ["inst"],
+    always=True)
 
 _sched_ids = itertools.count()
 
 
 def _drop_sched_series(inst: str):
-    for m in (_ADMITTED, _COMPLETED, _PREEMPTED, _REJECTED, _EVICTIONS):
+    for m in (_ADMITTED, _COMPLETED, _PREEMPTED, _REJECTED, _EVICTIONS,
+              _EXPIRED_QUEUE, _SHED, _QUOTA_REJECTED):
         m.remove_matching(inst=inst)
 
 
 class QueueFull(RuntimeError):
     """Backpressure: the engine's admission queue is at capacity."""
+
+
+class QuotaExceeded(QueueFull):
+    """The tenant's token bucket cannot cover this request right now.
+    Subclasses QueueFull so every existing backpressure handler (the
+    frontend's "rejected" reply, client retry policies) treats it as
+    load shedding, never a transport error."""
+
+
+class TokenBucket:
+    """Per-tenant admission quota: `rate` tokens/sec refill up to
+    `burst`. Charged the request's WORST-CASE token demand at submit
+    (prompt + max_new_tokens) — the same worst-case currency the page
+    pool admits on. Clock injectable for deterministic tests."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_t", "_now")
+
+    def __init__(self, rate: float, burst: float | None = None,
+                 now=time.monotonic):
+        if rate <= 0:
+            raise ValueError("rate must be positive")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._tokens = self.burst
+        self._now = now
+        self._t = now()
+
+    def available(self) -> float:
+        t = self._now()
+        self._tokens = min(self.burst,
+                           self._tokens + (t - self._t) * self.rate)
+        self._t = t
+        return self._tokens
+
+    def take(self, n: float) -> bool:
+        if self.available() < n:
+            return False
+        self._tokens -= n
+        return True
 
 
 _req_ids = itertools.count(1)
@@ -74,12 +144,15 @@ _req_ids = itertools.count(1)
 class Request:
     """One generation request, queued -> running -> finished.
 
-    status: queued | running | done | deadline | error | cancelled.
-    `deadline` is an absolute time.monotonic() stamp (None = no bound).
+    status: queued | running | done | deadline | error | cancelled |
+    shed. `deadline` is an absolute time.monotonic() stamp (None = no
+    bound). `priority` is a tier (0 = highest; default 1); `tenant`
+    names the quota bucket the request is charged against.
     """
 
     def __init__(self, prompt, max_new_tokens: int, deadline: float | None
-                 = None, eos_id: int | None = None):
+                 = None, eos_id: int | None = None, priority: int = 1,
+                 tenant: str = "default"):
         self.id = next(_req_ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -89,6 +162,8 @@ class Request:
             raise ValueError("max_new_tokens must be >= 1")
         self.deadline = deadline
         self.eos_id = eos_id
+        self.priority = max(0, int(priority))
+        self.tenant = str(tenant)
         self.trace_id: str | None = None  # set by Engine.submit
         self.generated: list[int] = []
         self.status = "queued"
@@ -98,6 +173,14 @@ class Request:
         self.submitted_at = time.monotonic()
         self.started_at: float | None = None
         self.finished_at: float | None = None
+        # stamped on the SCHEDULER's clock (injectable in tests):
+        # _queued_at anchors priority aging; first/last_token_at are the
+        # SLO surface (TTFT, inter-token latency) the load generator
+        # reads (serving/loadgen.py)
+        self._queued_at: float | None = None
+        self.first_token_at: float | None = None
+        self.last_token_at: float | None = None
+        self._finished = False       # set once, under the scheduler lock
         self._done = threading.Event()
 
     # -- results -------------------------------------------------------
@@ -131,20 +214,40 @@ class Request:
             return None
         return self.finished_at - self.submitted_at
 
+    def ttft(self) -> float | None:
+        """Time to first token (submit -> first sampled token)."""
+        if self.first_token_at is None or self._queued_at is None:
+            return None
+        return self.first_token_at - self._queued_at
+
+    def inter_token(self) -> float | None:
+        """Mean inter-token latency over this request's decode."""
+        if (self.first_token_at is None or self.last_token_at is None
+                or len(self.generated) < 2):
+            return None
+        return (self.last_token_at - self.first_token_at) \
+            / (len(self.generated) - 1)
+
 
 class Scheduler:
     """Slot table + queue; the engine calls the methods between steps."""
 
     def __init__(self, pool: PagePool, num_slots: int,
                  max_seq_len: int, max_queue: int = 256,
-                 now=time.monotonic, inst: str | None = None):
+                 now=time.monotonic, inst: str | None = None,
+                 aging_s: float = 30.0):
         self.pool = pool
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.max_queue = max_queue
         self.now = now
+        # a queued request's effective tier rises one step per aging_s
+        # seconds waited, so a sustained high-tier flood can never
+        # starve the low tiers (<=0 disables aging)
+        self.aging_s = aging_s
         self.slots: list[Request | None] = [None] * num_slots
         self.queue: deque[Request] = deque()
+        self.quotas: dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
         # counters (engine /stats) — registry-backed, labeled per
         # instance (`inst` lets the Engine align the label with its own)
@@ -153,6 +256,9 @@ class Scheduler:
         self._m_completed = _COMPLETED.labels(inst=self.inst)
         self._m_preempted = _PREEMPTED.labels(inst=self.inst)
         self._m_rejected = _REJECTED.labels(inst=self.inst)
+        self._m_expired_queue = _EXPIRED_QUEUE.labels(inst=self.inst)
+        self._m_shed = _SHED.labels(inst=self.inst)
+        self._m_quota_rejected = _QUOTA_REJECTED.labels(inst=self.inst)
         # a dead scheduler's series leave the exposition
         weakref.finalize(self, _drop_sched_series, self.inst)
 
@@ -174,22 +280,97 @@ class Scheduler:
     def rejected(self) -> int:
         return int(self._m_rejected.value)
 
+    @property
+    def expired_in_queue(self) -> int:
+        return int(self._m_expired_queue.value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._m_shed.value)
+
+    @property
+    def quota_rejected(self) -> int:
+        return int(self._m_quota_rejected.value)
+
+    # -- admission policy ----------------------------------------------
+    def set_tenant_quota(self, tenant: str, tokens_per_sec: float,
+                         burst: float | None = None):
+        """Install (or replace) a token-bucket quota for `tenant`; each
+        submit is charged its worst-case token demand. Tenants without
+        a bucket are unthrottled."""
+        self.quotas[str(tenant)] = TokenBucket(
+            tokens_per_sec, burst, now=self.now)
+
+    def effective_priority(self, req: Request, t: float | None = None) \
+            -> int:
+        """The request's tier after aging: one step toward 0 per
+        `aging_s` seconds waited in the queue."""
+        if self.aging_s <= 0 or req._queued_at is None:
+            return req.priority
+        t = self.now() if t is None else t
+        return max(0, req.priority
+                   - int((t - req._queued_at) // self.aging_s))
+
     # -- queue side (frontend threads) ---------------------------------
     def submit(self, req: Request) -> Request:
         if req.total_tokens > self.max_seq_len:
             raise ValueError(
                 f"prompt+max_new_tokens = {req.total_tokens} exceeds "
                 f"max_seq_len {self.max_seq_len}")
+        victim: Request | None = None
         with self._lock:
-            if len(self.queue) >= self.max_queue:
-                self._m_rejected.inc()
+            t = self.now()
+            req._queued_at = t
+            bucket = self.quotas.get(req.tenant)
+            # quota is CHECKED here but only CHARGED once the request
+            # is actually enqueued (below): a submit that bounces off a
+            # full queue must not drain the tenant's bucket, or retries
+            # against backpressure turn into phantom quota rejections
+            if bucket is not None \
+                    and bucket.available() < req.total_tokens:
+                self._m_quota_rejected.inc()
                 _flight.record("serving", "reject",
                                trace_id=req.trace_id, inst=self.inst,
-                               request=req.id, reason="queue_full",
-                               queue_depth=len(self.queue))
-                raise QueueFull(
-                    f"queue at capacity ({self.max_queue}); retry later")
+                               request=req.id, reason="quota",
+                               tenant=req.tenant,
+                               need_tokens=req.total_tokens)
+                raise QuotaExceeded(
+                    f"tenant {req.tenant!r} over quota "
+                    f"({req.total_tokens} tokens); retry later")
+            if len(self.queue) >= self.max_queue:
+                # load-shed by priority: a saturated queue drops its
+                # lowest-effective-priority entry for a strictly
+                # higher-priority newcomer; otherwise the newcomer is
+                # rejected (plain backpressure, unchanged semantics)
+                worst = max(self.queue,
+                            key=lambda r: (self.effective_priority(r, t),
+                                           r.id), default=None)
+                if worst is not None \
+                        and self.effective_priority(worst, t) \
+                        > self.effective_priority(req, t):
+                    self.queue.remove(worst)
+                    victim = worst
+                else:
+                    self._m_rejected.inc()
+                    _flight.record("serving", "reject",
+                                   trace_id=req.trace_id, inst=self.inst,
+                                   request=req.id, reason="queue_full",
+                                   queue_depth=len(self.queue))
+                    raise QueueFull(
+                        f"queue at capacity ({self.max_queue}); "
+                        f"retry later")
+            if bucket is not None:
+                # cannot fail: available() was checked under this same
+                # lock and no other submit ran since
+                bucket.take(req.total_tokens)
             self.queue.append(req)
+        if victim is not None:
+            self._m_shed.inc()
+            _flight.record("serving", "shed", trace_id=victim.trace_id,
+                           inst=self.inst, request=victim.id,
+                           tier=victim.priority, tenant=victim.tenant,
+                           for_request=req.id, for_tier=req.priority)
+            self._finish(victim, "shed")
         return req
 
     @property
@@ -208,31 +389,46 @@ class Scheduler:
     def expire_deadlines(self) -> list[Request]:
         """Finish every queued or running request whose deadline passed;
         running ones are PREEMPTED: their pages all go back to the pool
-        now, their partial output stands."""
+        now, their partial output stands. Queued ones count under the
+        distinct `expired_in_queue` key — they never held a slot, and
+        admission-control tuning must tell the two apart."""
         t = self.now()
+        expired_queued: list[Request] = []
         hit: list[Request] = []
         with self._lock:
             kept = deque()
             for r in self.queue:
                 if r.deadline is not None and t > r.deadline:
-                    hit.append(r)
+                    expired_queued.append(r)
                 else:
                     kept.append(r)
             self.queue = kept
+        for r in expired_queued:
+            self._m_expired_queue.inc()
+            self._finish(r, "deadline", reason="expired_in_queue")
+            hit.append(r)
         for i, r in enumerate(self.slots):
             if r is not None and r.deadline is not None and t > r.deadline:
                 self.slots[i] = None
                 self._m_preempted.inc()
+                self._finish(r, "deadline")
                 hit.append(r)
-        for r in hit:
-            self._finish(r, "deadline")
         return hit
 
+    def _pick_head(self, t: float) -> Request | None:
+        """The queue's admission head: best (aged) tier, then FIFO.
+        Head-of-line blocking applies to THIS request — a pool-blocked
+        head is never bypassed by a smaller lower-priority request
+        (fairness over utilization, as in the original FIFO)."""
+        return min(self.queue,
+                   key=lambda r: (self.effective_priority(r, t), r.id),
+                   default=None)
+
     def admit(self) -> list[Request]:
-        """FIFO-admit queued requests into free slots while the pool can
-        cover their worst case; returns the newly admitted requests (the
-        engine prefills them). Head-of-line blocking is intentional —
-        FIFO fairness over utilization."""
+        """Admit queued requests into free slots in effective-priority
+        order (tier after aging, FIFO within a tier) while the pool can
+        cover their worst case; returns the newly admitted requests
+        (the engine prefills them)."""
         out: list[Request] = []
         for i in range(self.num_slots):
             if self.slots[i] is not None:
@@ -240,7 +436,7 @@ class Scheduler:
             with self._lock:
                 if not self.queue:
                     break
-                head = self.queue[0]
+                head = self._pick_head(self.now())
                 table = self.pool.alloc_table(head.total_tokens)
                 if table is None:
                     # the scheduler DECIDED to block admission: the
@@ -252,7 +448,7 @@ class Scheduler:
                                    reason="pool_full",
                                    need_tokens=head.total_tokens)
                     break            # pool full: wait for evictions
-                self.queue.popleft()
+                self.queue.remove(head)
                 # slot assignment inside the SAME critical section as
                 # the dequeue: a postmortem snapshot reading queue +
                 # slots under this lock must never catch a request in
@@ -265,7 +461,8 @@ class Scheduler:
             self._m_admitted.inc()
             _flight.record("serving", "admit", trace_id=head.trace_id,
                            inst=self.inst, request=head.id, slot=i,
-                           pages=len(table.pages))
+                           pages=len(table.pages), tier=head.priority,
+                           tenant=head.tenant)
             out.append(head)
         return out
 
@@ -273,6 +470,9 @@ class Scheduler:
         """Append a sampled token; returns True when the request is now
         finished (EOS or max_new_tokens) and has been evicted."""
         req.generated.append(int(token))
+        req.last_token_at = self.now()
+        if req.first_token_at is None:
+            req.first_token_at = req.last_token_at
         req.table.length = req.position + 1
         if (req.eos_id is not None and token == req.eos_id) \
                 or len(req.generated) >= req.max_new_tokens:
@@ -292,27 +492,44 @@ class Scheduler:
                 pass
         if req.done():
             return False
-        self.evict(req, "cancelled")
-        return True
+        # evict is idempotent: a concurrent shed that wins the race
+        # makes this a no-op and cancel reports False
+        return self.evict(req, "cancelled")
 
-    def evict(self, req: Request, status: str):
+    def evict(self, req: Request, status: str) -> bool:
         if req.slot is not None and self.slots[req.slot] is req:
             self.slots[req.slot] = None
-        self._finish(req, status)
-        if status == "done":
+        finished = self._finish(req, status)
+        if finished and status == "done":
             self._m_completed.inc()
+        return finished
 
-    def _finish(self, req: Request, status: str):
+    def _finish(self, req: Request, status: str,
+                reason: str | None = None) -> bool:
+        """`status` is the request's public lifecycle state; `reason`
+        (default: the status) is the finer-grained eviction label —
+        e.g. a queued deadline lapse finishes with status "deadline"
+        but reason "expired_in_queue". Idempotent: the shed path runs
+        on the submitting thread OUTSIDE the engine step lock, so it
+        can race a concurrent cancel — first caller wins, the loser
+        is a no-op (returns False)."""
+        with self._lock:
+            if req._finished:
+                return False
+            req._finished = True
         if req.table is not None:
             self.pool.free(req.table)
             req.table = None
         req.status = status
         req.finished_at = self.now()
-        _EVICTIONS.labels(inst=self.inst, reason=status).inc()
+        _EVICTIONS.labels(inst=self.inst,
+                          reason=reason or status).inc()
         _flight.record("serving", "evict", trace_id=req.trace_id,
-                       inst=self.inst, request=req.id, reason=status,
+                       inst=self.inst, request=req.id,
+                       reason=reason or status,
                        generated=len(req.generated))
         req._done.set()
+        return True
 
     def stats(self) -> dict:
         return {"queue_depth": self.queue_depth,
@@ -321,4 +538,7 @@ class Scheduler:
                 "admitted": self.admitted,
                 "completed": self.completed,
                 "preemptions": self.preemptions,
-                "rejected": self.rejected}
+                "rejected": self.rejected,
+                "expired_in_queue": self.expired_in_queue,
+                "shed": self.shed,
+                "quota_rejected": self.quota_rejected}

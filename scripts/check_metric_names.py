@@ -68,6 +68,19 @@ REQUIRED_METRICS = {
     "paddle_tpu_watchdog_progress_age_seconds",
     "paddle_tpu_flight_events_total",
     "paddle_tpu_flight_dropped_total",
+    # SLO harness (docs/SERVING.md production traffic harness): the
+    # load generator's attainment/goodput surface and the scheduler's
+    # admission-control decisions are acceptance-contractual — the
+    # chaos drills assert against these exact names
+    "paddle_tpu_slo_ttft_seconds",
+    "paddle_tpu_slo_inter_token_seconds",
+    "paddle_tpu_slo_deadline_met_total",
+    "paddle_tpu_slo_deadline_missed_total",
+    "paddle_tpu_slo_goodput_tokens_total",
+    "paddle_tpu_slo_attainment_ratio",
+    "paddle_tpu_serving_expired_in_queue_total",
+    "paddle_tpu_serving_shed_total",
+    "paddle_tpu_serving_quota_rejected_total",
 }
 
 

@@ -119,6 +119,90 @@ def test_fleetrun_ps_mode_env(tmp_path):
     assert all("TRAINER" in w for w in workers)
 
 
+def test_launcher_respawns_ps_killed_mid_push_under_load(tmp_path):
+    """Respawn under ACTIVE load (ISSUE 6 satellite): the PS shard dies
+    at kill point `reply` — inside an in-flight push, committed but
+    unacknowledged — while two workers x three client threads each keep
+    more pushes in flight (not between steps: every thread has its own
+    transport channel, so concurrent pushes genuinely overlap the
+    kill). launch.py must respawn the shard ALONE from its
+    write-through snapshot, and retry + server-side dedup must land
+    every push exactly once: each worker's row moves by exactly
+    threads x pushes."""
+    script = tmp_path / "midpush_job.py"
+    script.write_text(
+        "import os, threading\n"
+        "import numpy as np\n"
+        "role = os.environ['TRAINING_ROLE']\n"
+        "if role == 'PSERVER':\n"
+        "    snap = os.environ['PADDLE_PS_SNAPSHOT_DIR']\n"
+        "    if not os.path.exists(snap) or not os.listdir(snap):\n"
+        "        # first life only: die mid-push (after commit, before\n"
+        "        # the reply) once the concurrent flood is under way\n"
+        "        os.environ['PADDLE_PS_FAULT_KILL_AFTER'] = '25'\n"
+        "        os.environ['PADDLE_PS_FAULT_KILL_POINT'] = 'reply'\n"
+        "    from paddle_tpu.distributed.fleet.runtime."
+        "parameter_server_runtime import PSServer\n"
+        "    PSServer(os.environ['PADDLE_CURRENT_ENDPOINT'])"
+        ".serve_forever()\n"
+        "else:\n"
+        "    from paddle_tpu.distributed.fleet.runtime."
+        "parameter_server_runtime import PSClient\n"
+        "    eps = os.environ['PADDLE_PSERVERS_IP_PORT_LIST']"
+        ".split(',')\n"
+        "    rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "    T, N = 3, 15\n"
+        "    cl0 = PSClient(eps, backoff=0.02, deadline=120.0)\n"
+        "    base = cl0.pull('t', 4, [rank]).copy()\n"
+        "    clients = [PSClient(eps, backoff=0.02, deadline=120.0)\n"
+        "               for _ in range(T)]\n"
+        "    start = threading.Barrier(T)\n"
+        "    errs = []\n"
+        "    def run(cl):\n"
+        "        try:\n"
+        "            start.wait()\n"
+        "            for _ in range(N):\n"
+        "                cl.push('t', 4, [rank], np.ones((1, 4)),"
+        " lr=1.0)\n"
+        "        except Exception as e:\n"
+        "            errs.append(e)\n"
+        "    ths = [threading.Thread(target=run, args=(cl,))\n"
+        "           for cl in clients]\n"
+        "    for th in ths: th.start()\n"
+        "    for th in ths: th.join()\n"
+        "    assert not errs, errs\n"
+        "    final = cl0.pull('t', 4, [rank])\n"
+        "    np.testing.assert_allclose(base - final, float(T * N),\n"
+        "                               rtol=1e-6)\n"
+        "    retries = sum(c.stats.retries for c in clients)\n"
+        "    assert retries > 0, 'kill never interrupted a push'\n"
+        "    print(f'MIDPUSH WORKER {rank} OK', flush=True)\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_TPU_DISABLE_NATIVE"] = "1"
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         f"--servers=127.0.0.1:{_free_port()}",
+         f"--workers=127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}",
+         "--max_restarts=2",
+         "--ps_snapshot_dir", str(tmp_path / "snap"),
+         "--ps_snapshot_every=1",
+         "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (res.stderr, res.stdout)
+    # the shard restarted alone: no whole-job elastic restart
+    assert "restarting it from snapshot" in res.stderr, res.stderr
+    assert "elastic restart" not in res.stderr
+    logs = ""
+    for f in sorted(os.listdir(tmp_path / "logs")):
+        logs += open(tmp_path / "logs" / f).read()
+    assert "MIDPUSH WORKER 0 OK" in logs, logs
+    assert "MIDPUSH WORKER 1 OK" in logs, logs
+
+
 def test_launch_metrics_dir_collects_per_process_dumps(tmp_path):
     """--metrics_dir: every child dumps its registry at exit and the
     aggregator merges them (counters sum across processes)."""

@@ -289,7 +289,10 @@ ENGINE_STATS_KEYS = {
     "queue_depth", "active_slots", "num_slots", "admitted", "completed",
     "preemptions", "rejected", "pool", "steps", "tokens_generated",
     "tokens_per_sec", "latency_ms_p50", "latency_ms_p99",
-    "completed_seen", "compiles"}
+    "completed_seen", "compiles",
+    # PR-6 admission control: every PR-2 key above is unchanged; the
+    # scheduler's new decision counters ride along
+    "expired_in_queue", "shed", "quota_rejected"}
 POOL_STATS_KEYS = {
     "num_pages", "page_size", "free_pages", "used_pages", "occupancy",
     "alloc_count", "free_count", "alloc_failures"}

@@ -1,0 +1,776 @@
+"""Production traffic harness (ISSUE 6): deterministic open-loop load
+generation, SLO reporting, priority/quota/shed admission control, and
+chaos recovery drills — fault knobs fired UNDER generated load with
+bounded-degradation assertions. Plus the env-knob static check and the
+fault-knob typo guard satellites."""
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.runtime import fault_injection as fi
+from paddle_tpu.serving import (Engine, GPTDecodeModel, LoadGenerator,
+                                LoadResult, PagePool, QueueFull,
+                                QuotaExceeded, Request, Scheduler,
+                                TokenBucket, TrafficConfig, slo_report)
+from paddle_tpu.serving.loadgen import Arrival
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset_injector(fi.FaultInjector())
+    yield
+    fi.reset_injector(fi.FaultInjector())
+
+
+# ---------------------------------------------------------------------------
+# load generator: determinism + arrival-process shape
+# ---------------------------------------------------------------------------
+
+def test_schedule_is_deterministic_and_seed_sensitive():
+    mk = lambda seed: TrafficConfig(rate=50, duration=2.0,
+                                    arrival="diurnal", seed=seed)
+    s1 = LoadGenerator(mk(3)).schedule()
+    s2 = LoadGenerator(mk(3)).schedule()
+    assert len(s1) == len(s2) > 20
+    for a, b in zip(s1, s2):
+        assert a.t == b.t and a.tenant == b.tenant and a.tier == b.tier
+        assert a.max_new_tokens == b.max_new_tokens
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+    s3 = LoadGenerator(mk(4)).schedule()
+    assert [a.t for a in s3] != [a.t for a in s1]
+    # tags come from the declared distributions
+    cfg = mk(3)
+    assert {a.tier for a in s1} <= set(cfg.tiers)
+    assert {a.tenant for a in s1} <= set(cfg.tenants)
+    assert all(a.deadline == cfg.deadlines[a.tier] for a in s1)
+
+
+def test_diurnal_arrivals_modulate_rate():
+    cfg = TrafficConfig(rate=60, duration=10.0, arrival="diurnal",
+                        diurnal_period=10.0, diurnal_depth=0.8, seed=1)
+    sched = LoadGenerator(cfg).schedule()
+    # sin is positive over the first half-period, negative the second:
+    # the peak half must carry clearly more arrivals than the trough
+    peak = sum(1 for a in sched if a.t < 5.0)
+    trough = len(sched) - peak
+    assert peak > 2 * trough, (peak, trough)
+
+
+def test_bursty_arrivals_concentrate_in_bursts():
+    cfg = TrafficConfig(rate=30, duration=8.0, arrival="bursty",
+                        burst_period=2.0, burst_fraction=0.25,
+                        burst_factor=4.0, seed=2)
+    sched = LoadGenerator(cfg).schedule()
+    in_burst = sum(1 for a in sched
+                   if (a.t % 2.0) / 2.0 < 0.25)
+    out_burst = len(sched) - in_burst
+    # 25% of the time at 4x rate ≈ as many arrivals as the other 75%
+    assert in_burst > out_burst, (in_burst, out_burst)
+
+
+def test_open_loop_run_never_waits_for_completions():
+    """The replayer must offer load on schedule even when nothing ever
+    finishes — handles pile up, the arrival count stays the offered
+    count (closed-loop generators cannot express this)."""
+    cfg = TrafficConfig(rate=200, duration=0.25, seed=9)
+    gen = LoadGenerator(cfg)
+
+    class _Never:
+        def wait(self, t=None):
+            return False
+
+    n_sched = len(gen.schedule())
+    res = gen.run(lambda arr: _Never())
+    assert len(res.handles) == n_sched > 10
+    assert res.elapsed < 5.0
+
+
+# ---------------------------------------------------------------------------
+# SLO report math (fabricated handles, no model)
+# ---------------------------------------------------------------------------
+
+def _handle(status, gen_n=5, sub=0.0, first=0.1, last=0.5, fin=0.5,
+            deadline=None):
+    r = Request([1], max(gen_n, 1))
+    r.status = status
+    r._queued_at = sub
+    r.first_token_at = first
+    r.last_token_at = last
+    r.finished_at = fin
+    r.generated = [0] * gen_n
+    r.deadline = deadline
+    return r
+
+
+def test_slo_report_attainment_goodput_and_percentiles():
+    res = LoadResult("t", 0.0, 2.0)
+    arr = LoadGenerator(TrafficConfig(rate=50, duration=1.0,
+                                      seed=0)).schedule()
+    # 2 met, 1 done-but-late, 1 preempted, 1 rejected at submit
+    res.handles = [
+        (arr[0], _handle("done", gen_n=6, deadline=None)),
+        (arr[1], _handle("done", gen_n=4, deadline=1.0, fin=0.5)),
+        (arr[2], _handle("done", gen_n=8, deadline=0.3, fin=0.5)),
+        (arr[3], _handle("deadline", gen_n=2, deadline=0.3)),
+    ]
+    res.rejected = [arr[4]]
+    rep = slo_report(res, gen="unit")
+    assert rep["offered"] == 5 and rep["met"] == 2
+    assert rep["attainment"] == pytest.approx(0.4)
+    assert rep["goodput_tokens"] == 10
+    assert rep["goodput_tokens_per_sec"] == pytest.approx(5.0)
+    assert rep["ttft_ms_p50"] == pytest.approx(100.0)
+    assert rep["by_status"] == {"done": 3, "deadline": 1, "rejected": 1}
+    # the registry mirrors the report (paddle_tpu_slo_* surface)
+    from paddle_tpu.observability import REGISTRY
+    att = REGISTRY.get("paddle_tpu_slo_attainment_ratio")
+    assert att.labels(gen="unit").value == pytest.approx(0.4)
+    met = REGISTRY.get("paddle_tpu_slo_deadline_met_total")
+    assert met.labels(gen="unit").value == 2
+
+
+def test_slo_report_window_rates_use_window_span():
+    """Review regression: a windowed report's goodput rate is per
+    second of the WINDOW — a post-recovery slice must not be diluted
+    by the pre-fault portion of the run."""
+    res = LoadResult("t", 0.0, 8.0)
+    sched = LoadGenerator(TrafficConfig(rate=50, duration=8.0,
+                                        seed=0)).schedule()
+    late = next(a for a in sched if a.t >= 4.0)
+    res.handles = [(late, _handle("done", gen_n=400))]
+    full = slo_report(res, gen="ws0")
+    assert full["goodput_tokens_per_sec"] == pytest.approx(400 / 8.0)
+    tail = slo_report(res, window=(4.0, float("inf")), gen="ws1")
+    assert tail["goodput_tokens_per_sec"] == pytest.approx(400 / 4.0)
+
+
+def test_slo_report_window_slices_by_arrival_time():
+    res = LoadResult("t", 0.0, 2.0)
+    sched = LoadGenerator(TrafficConfig(rate=50, duration=1.0,
+                                        seed=0)).schedule()
+    early, late = sched[0], sched[-1]
+    res.handles = [(early, _handle("deadline")),
+                   (late, _handle("done"))]
+    full = slo_report(res, gen="w0")
+    assert full["met"] == 1 and full["offered"] == 2
+    tail = slo_report(res, window=(late.t, float("inf")), gen="w1")
+    assert tail["offered"] == 1 and tail["attainment"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# admission control: priority, aging, quotas, shedding (fake clock)
+# ---------------------------------------------------------------------------
+
+def _mk_sched(num_pages=16, page_size=4, num_slots=1, max_queue=8,
+              aging_s=30.0):
+    clock = {"t": 0.0}
+    pool = PagePool(num_pages, page_size)
+    s = Scheduler(pool, num_slots, max_seq_len=num_pages * page_size,
+                  max_queue=max_queue, now=lambda: clock["t"],
+                  aging_s=aging_s)
+    return s, pool, clock
+
+
+def test_priority_tiers_admit_highest_first_fifo_within_tier():
+    s, _, _ = _mk_sched()
+    r_low = s.submit(Request([1], 1, priority=2))
+    r_high = s.submit(Request([1], 1, priority=0))
+    r_mid = s.submit(Request([1], 1, priority=1))
+    r_high2 = s.submit(Request([1], 1, priority=0))
+    order = []
+    for _ in range(4):
+        got, = s.admit()
+        order.append(got)
+        s.evict(got, "done")
+    assert order == [r_high, r_high2, r_mid, r_low]
+
+
+def test_aging_promotes_waiting_low_tier_request():
+    """Starvation-freedom under SUSTAINED high-tier load: the waiting
+    low-tier request's effective tier rises one step per aging_s, and
+    FIFO order (older id) breaks the tie once it reaches tier 0."""
+    s, _, clock = _mk_sched(aging_s=1.0)
+    r_low = s.submit(Request([1], 1, priority=2))
+    admitted_low_at = None
+    for round_i in range(6):
+        high = s.submit(Request([1], 1, priority=0))
+        got, = s.admit()
+        s.evict(got, "done")
+        if got is r_low:
+            admitted_low_at = round_i
+            break
+        assert got is high
+        clock["t"] += 1.0
+    # tier 2 -> effective 0 after 2 aging steps; round 2 must pick it
+    assert admitted_low_at == 2
+    assert s.effective_priority(r_low, clock["t"]) == 0
+
+
+def test_low_tier_always_completes_once_high_load_stops():
+    """Acceptance: even with aging disabled, a low-tier request admits
+    as soon as the high-tier flood stops — tiers order the queue, they
+    never drop it."""
+    s, _, _ = _mk_sched(aging_s=0.0)
+    r_low = s.submit(Request([1], 1, priority=2))
+    for _ in range(5):
+        high = s.submit(Request([1], 1, priority=0))
+        got, = s.admit()
+        assert got is high
+        s.evict(got, "done")
+    got, = s.admit()
+    assert got is r_low
+    s.record_token(r_low, 3)
+    assert r_low.status == "done"
+
+
+def test_tenant_token_bucket_quota_rejects_and_refills():
+    s, _, clock = _mk_sched()
+    s.set_tenant_quota("acme", tokens_per_sec=10.0, burst=20.0)
+    s.submit(Request([1] * 8, 8, tenant="acme"))      # 16 tokens: fits
+    with pytest.raises(QuotaExceeded):
+        s.submit(Request([1] * 8, 8, tenant="acme"))  # bucket drained
+    assert s.stats()["quota_rejected"] == 1
+    # other tenants are unthrottled
+    s.submit(Request([1] * 8, 8, tenant="other"))
+    # refill: 10 tokens/sec * 2s covers the next 16-token submit
+    clock["t"] += 2.0
+    s.submit(Request([1] * 8, 8, tenant="acme"))
+    assert s.stats()["quota_rejected"] == 1
+    # QuotaExceeded IS QueueFull: every backpressure handler sheds it
+    assert issubclass(QuotaExceeded, QueueFull)
+
+
+def test_queue_full_rejection_does_not_charge_quota():
+    """Review regression: a submit that bounces off a full queue must
+    not drain the tenant's bucket — retries against backpressure would
+    otherwise turn into phantom quota rejections."""
+    s, _, _ = _mk_sched(max_queue=1, num_slots=0)
+    s.set_tenant_quota("acme", tokens_per_sec=0.001, burst=2.0)
+    first = s.submit(Request([1], 1, priority=1))  # fills the queue
+    for _ in range(3):                             # each bounces
+        with pytest.raises(QueueFull):
+            s.submit(Request([1], 1, priority=1, tenant="acme"))
+    assert s.stats()["rejected"] == 3
+    assert s.stats()["quota_rejected"] == 0
+    assert s.quotas["acme"].available() == pytest.approx(2.0)
+    # once the queue frees, the untouched bucket covers the submit
+    s.cancel(first)
+    s.submit(Request([1], 1, priority=1, tenant="acme"))
+    assert s.quotas["acme"].available() < 1.0
+
+
+def test_token_bucket_unit():
+    clock = {"t": 0.0}
+    b = TokenBucket(5.0, burst=10.0, now=lambda: clock["t"])
+    assert b.take(10) and not b.take(1)
+    clock["t"] = 1.0
+    assert b.available() == pytest.approx(5.0)
+    assert b.take(5) and not b.take(0.1)
+
+
+def test_queue_full_sheds_lowest_priority_for_higher_submit():
+    s, _, _ = _mk_sched(max_queue=2, num_slots=0)
+    a = s.submit(Request([1], 1, priority=2))
+    b = s.submit(Request([1], 1, priority=1))
+    # equal-or-lower priority newcomer: plain backpressure, unchanged
+    with pytest.raises(QueueFull):
+        s.submit(Request([1], 1, priority=2))
+    assert s.stats()["rejected"] == 1 and s.stats()["shed"] == 0
+    # strictly higher-priority newcomer sheds the worst queued request
+    c = s.submit(Request([1], 1, priority=0))
+    assert a.status == "shed" and a.done()
+    assert a.result().tolist() == []          # shed = empty, not error
+    assert s.stats()["shed"] == 1
+    assert s.queue_depth == 2 and b.status == "queued" \
+        and c.status == "queued"
+
+
+def test_finish_is_idempotent_shed_vs_cancel():
+    """Review regression: the shed path finishes the victim on the
+    SUBMITTING thread, outside the engine step lock — a concurrent
+    cancel must lose the race cleanly (no double-counted eviction, no
+    status flip after the waiter read it)."""
+    from paddle_tpu.observability import REGISTRY
+    s, _, _ = _mk_sched(max_queue=1, num_slots=0)
+    victim = s.submit(Request([1], 1, priority=2))
+    s.submit(Request([1], 1, priority=0))          # sheds the victim
+    assert victim.status == "shed" and victim.done()
+    assert s.cancel(victim) is False               # late cancel: no-op
+    assert victim.status == "shed"
+    ev = REGISTRY.get("paddle_tpu_serving_evictions_total")
+    assert ev.labels(inst=s.inst, reason="shed").value == 1
+    assert ev.labels(inst=s.inst, reason="cancelled").value == 0
+
+
+def test_slo_report_mirrors_metrics_once_per_gen():
+    """Review regression: the docs idiom — slo_report(res) then
+    slo_report(res, window=...) with the default gen — must not
+    double-count the paddle_tpu_slo_* scrape surface."""
+    from paddle_tpu.observability import REGISTRY
+    res = LoadResult("once", 0.0, 2.0)
+    sched = LoadGenerator(TrafficConfig(rate=50, duration=1.0,
+                                        seed=0)).schedule()
+    res.handles = [(sched[0], _handle("done", gen_n=5))]
+    r1 = slo_report(res)
+    r2 = slo_report(res, window=(0.0, float("inf")))
+    assert r1["met"] == r2["met"] == 1             # report still computed
+    met = REGISTRY.get("paddle_tpu_slo_deadline_met_total")
+    good = REGISTRY.get("paddle_tpu_slo_goodput_tokens_total")
+    assert met.labels(gen="once").value == 1
+    assert good.labels(gen="once").value == 5
+    # a DIFFERENT gen label mirrors independently
+    slo_report(res, gen="once_w")
+    assert met.labels(gen="once_w").value == 1
+
+
+def test_run_counts_oversized_arrivals_as_rejected():
+    """Review regression: an arrival the target cannot serve (submit
+    raises ValueError, e.g. prompt+max_new over max_seq_len) counts as
+    rejected offered load — it must not abort the open-loop replay."""
+    cfg = TrafficConfig(rate=200, duration=0.2, seed=3)
+    gen = LoadGenerator(cfg)
+    n_sched = len(gen.schedule())
+    assert n_sched > 5
+
+    class _H:
+        def wait(self, t=None):
+            return True
+
+    def submit(arr):
+        if arr.max_new_tokens > 2:
+            raise ValueError("prompt+max_new_tokens exceeds max_seq_len")
+        return _H()
+
+    res = gen.run(submit)
+    assert res.offered == n_sched
+    assert len(res.rejected) > 0 and len(res.handles) > 0
+
+
+def test_custom_gen_series_dropped_with_result():
+    """Review regression: paddle_tpu_slo_* series mirrored under a
+    custom gen label (the chaos-window idiom) are torn down with the
+    LoadResult they were mirrored through — no unbounded exposition."""
+    import gc
+
+    from paddle_tpu.observability import REGISTRY
+    sched = LoadGenerator(TrafficConfig(rate=50, duration=1.0,
+                                        seed=0)).schedule()
+    res = LoadResult("t", 0.0, 1.0)
+    res.handles = [(sched[0], _handle("done"))]
+    slo_report(res, gen="ephemeral_gen")
+    met = REGISTRY.get("paddle_tpu_slo_deadline_met_total")
+    assert ("ephemeral_gen",) in dict(met._series())
+    del res
+    gc.collect()
+    assert ("ephemeral_gen",) not in dict(met._series())
+
+
+def test_percentile_is_nearest_rank():
+    from paddle_tpu.serving.loadgen import _pct
+    assert _pct([0.01, 0.9], 50) == 0.01           # median, not max
+    assert _pct([0.01, 0.9], 99) == 0.9
+    vals = sorted(float(i) for i in range(1, 11))
+    assert _pct(vals, 50) == 5.0                   # 5th of 10
+    assert _pct(vals, 99) == 10.0
+    assert _pct([], 50) is None
+
+
+def test_expired_in_queue_split_from_preemption():
+    """Satellite regression: a queued request whose deadline lapses
+    before it ever runs counts under `expired_in_queue`, NOT under the
+    running-request `preemptions` counter it used to share."""
+    s, _, clock = _mk_sched(num_slots=1)
+    running = s.submit(Request([1], 4, deadline=5.0))
+    got, = s.admit()
+    assert got is running
+    queued = s.submit(Request([1], 4, deadline=5.0))  # never gets a slot
+    clock["t"] = 6.0
+    hit = s.expire_deadlines()
+    assert set(hit) == {running, queued}
+    st = s.stats()
+    assert st["preemptions"] == 1 and st["expired_in_queue"] == 1
+    # both finish with the public "deadline" status (wire contract
+    # unchanged); the metric split is the tuning surface
+    assert running.status == queued.status == "deadline"
+    assert queued.started_at is None and running.started_at is not None
+    from paddle_tpu.observability import REGISTRY
+    ev = REGISTRY.get("paddle_tpu_serving_evictions_total")
+    assert ev.labels(inst=s.inst, reason="expired_in_queue").value == 1
+    assert ev.labels(inst=s.inst, reason="deadline").value == 1
+
+
+def test_expired_in_queue_metric_registered():
+    from paddle_tpu.observability import REGISTRY
+    for name in ("paddle_tpu_serving_expired_in_queue_total",
+                 "paddle_tpu_serving_shed_total",
+                 "paddle_tpu_serving_quota_rejected_total"):
+        assert REGISTRY.get(name) is not None, name
+
+
+# ---------------------------------------------------------------------------
+# engine integration: traffic replay, wire passthrough
+# ---------------------------------------------------------------------------
+
+def _tiny_engine(**kw):
+    from paddle_tpu.models.gpt import GPTConfig
+    model = GPTDecodeModel(GPTConfig.tiny(num_layers=1), seed=0)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 48)
+    return Engine(model, **kw)
+
+
+def _traffic(seed, duration=1.0, rate=40, arrival="bursty"):
+    return TrafficConfig(
+        rate=rate, duration=duration, arrival=arrival, seed=seed,
+        burst_period=0.5, burst_fraction=0.3, burst_factor=3.0,
+        prompt_lens={2: 2, 4: 2, 8: 1}, output_lens={2: 2, 4: 1},
+        tenants={"web": 2, "batch": 1}, tiers={0: 1, 1: 2, 2: 1},
+        deadlines={0: 30.0, 1: 60.0, 2: None}, vocab_size=64)
+
+
+def _prewarm(eng):
+    # compile every prefill bucket + the decode program outside any
+    # measured window (the bench_serving convention)
+    for plen in (2, 4, 8):
+        eng.submit(np.full(plen, 1), 2)
+    eng.run_until_idle()
+
+
+def test_loadgen_drives_engine_to_full_attainment():
+    eng = _tiny_engine()
+    _prewarm(eng)
+    gen = LoadGenerator(_traffic(seed=11), name="e2e")
+    with eng:
+        res = gen.run_engine(eng)
+        assert res.wait(120)
+    rep = slo_report(res)
+    assert rep["offered"] > 20
+    assert rep["attainment"] == 1.0, rep
+    assert rep["goodput_tokens"] > 0
+    assert rep["ttft_ms_p99"] >= rep["ttft_ms_p50"] > 0
+    st = eng.stats()
+    assert st["shed"] == 0 and st["quota_rejected"] == 0
+    assert st["pool"]["used_pages"] == 0
+
+
+def test_loadgen_replays_over_the_wire():
+    """run_client: the same open-loop replay drives the network
+    frontend (PR-1 wire format) — blocking `generate` calls ride their
+    own threads so the arrival process never closes the loop, and the
+    wire handles feed the same slo_report."""
+    from paddle_tpu.serving import ServingClient, ServingServer
+    eng = _tiny_engine()
+    _prewarm(eng)
+    gen = LoadGenerator(_traffic(seed=21, duration=0.8, rate=25),
+                        name="wire")
+    with eng, ServingServer(eng, "127.0.0.1:0") as srv:
+        cli = ServingClient(srv.endpoint)
+        try:
+            res = gen.run_client(cli, timeout=60)
+        finally:
+            cli.close()
+    rep = slo_report(res)
+    assert rep["offered"] > 5
+    assert rep["attainment"] == 1.0, rep
+    assert rep["goodput_tokens"] > 0
+
+
+def test_frontend_carries_priority_and_tenant_over_the_wire():
+    from paddle_tpu.serving import ServingClient, ServingServer
+    eng = _tiny_engine()
+    eng.scheduler.set_tenant_quota("starved", tokens_per_sec=0.001,
+                                   burst=1.0)
+    with ServingServer(eng, "127.0.0.1:0") as srv:
+        cli = ServingClient(srv.endpoint)
+        try:
+            ok = cli.generate([1, 2], 2, tenant="web", priority=0,
+                              timeout=60)
+            assert ok["status"] == "done"
+            rej = cli.generate([1, 2], 2, tenant="starved", timeout=60)
+            assert rej["status"] == "rejected"
+        finally:
+            cli.close()
+    assert eng.stats()["quota_rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# chaos drill 1: engine stall (PADDLE_PS_FAULT_STALL @ serving_decode)
+# under generated load — watchdog detects, recovery restores SLO
+# ---------------------------------------------------------------------------
+
+def test_chaos_engine_stall_detected_and_slo_recovers(monkeypatch):
+    """Acceptance: the fault-free baseline and the faulted run replay
+    IDENTICAL traffic (same seed). Mid-run the serving_decode stall
+    knob wedges the step thread; the watchdog must fire within its
+    deadline; after the knob clears, post-recovery SLO attainment is
+    within a fixed band of the baseline's same traffic slice."""
+    from paddle_tpu.observability.watchdog import WATCHDOG
+
+    monkeypatch.setenv("PADDLE_TPU_WATCHDOG_DEADLINE", "0.3")
+    duration = 4.0
+    mk_gen = lambda name: LoadGenerator(
+        _traffic(seed=77, duration=duration, rate=25), name=name)
+
+    # -- baseline ------------------------------------------------------
+    eng_a = _tiny_engine()
+    _prewarm(eng_a)
+    with eng_a:
+        res_a = mk_gen("chaos_base").run_engine(eng_a)
+        assert res_a.wait(180)
+    base = slo_report(res_a)
+    assert base["attainment"] == 1.0, base
+
+    # -- faulted run ---------------------------------------------------
+    eng_b = _tiny_engine()
+    _prewarm(eng_b)
+    token = f"serving.engine.{eng_b.engine_id}"
+    res_box: list = []
+    with eng_b:
+        runner = threading.Thread(
+            target=lambda: res_box.append(
+                mk_gen("chaos_fault").run_engine(eng_b)), daemon=True)
+        runner.start()
+        time.sleep(0.5)               # traffic flowing
+        fi.reset_injector(fi.FaultInjector(stall=0.8,
+                                           stall_point="serving_decode"))
+        # detection: drive the watchdog the way its poll thread would
+        t_fault = time.monotonic()
+        fired = []
+        while not fired and time.monotonic() - t_fault < 10:
+            fired = [t for t in WATCHDOG.check_once() if t == token]
+            time.sleep(0.05)
+        assert fired == [token], "watchdog missed the stalled engine"
+        detect_s = time.monotonic() - t_fault
+        assert detect_s < 5.0, f"detection took {detect_s}s"
+        # recovery: clear the fault knob; the engine resumes by itself
+        fi.reset_injector(fi.FaultInjector())
+        recovered_mono = time.monotonic()
+        runner.join(timeout=180)
+        assert res_box, "traffic run never finished"
+        res_b = res_box[0]
+        assert res_b.wait(180)
+    # the engine made progress again: the next poll clears the episode
+    WATCHDOG.check_once()
+    assert token not in WATCHDOG.stalled()
+
+    # post-recovery slice: arrivals offered after the engine resumed
+    # (+0.8s margin for the sleep already in flight when we cleared)
+    rec_off = recovered_mono + 0.8 - res_b.started_at
+    assert rec_off < duration - 0.5, "no post-recovery traffic window"
+    post_fault = slo_report(res_b, window=(rec_off, float("inf")),
+                            gen="chaos_post")
+    post_base = slo_report(res_a, window=(rec_off, float("inf")),
+                           gen="chaos_post_base")
+    assert post_fault["offered"] > 5
+    # fixed band: post-recovery attainment within 0.1 of the fault-free
+    # run over the SAME traffic slice
+    assert post_fault["attainment"] >= post_base["attainment"] - 0.1, \
+        (post_fault, post_base)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill 2: PS-server kill + frame corruption under serving load —
+# respawn from write-through snapshot keeps training exactly-once while
+# serving SLOs hold
+# ---------------------------------------------------------------------------
+
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "ps_fault_server.py")
+
+
+def _spawn_ps(ep, snap_dir, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PS_ENDPOINT"] = ep
+    env["PADDLE_PS_SNAPSHOT_DIR"] = snap_dir
+    env["PADDLE_PS_SNAPSHOT_EVERY"] = "1"
+    env.update(extra_env or {})
+    p = subprocess.Popen([sys.executable, FIXTURE], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    ready = json.loads(p.stdout.readline())
+    return p, ready
+
+
+def test_chaos_ps_kill_under_serving_load(tmp_path, monkeypatch):
+    """Acceptance: a PS shard dies at the hardest point (commit before
+    reply) while serving traffic and PS pushes run concurrently, with
+    client-side frame corruption on top. The shard respawns from its
+    write-through snapshot, every push lands exactly once, and serving
+    attainment stays within the fixed band of the healthy phase — the
+    tiers degrade independently."""
+    import socket
+
+    from paddle_tpu.distributed.fleet.runtime. \
+        parameter_server_runtime import PSClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ep = f"127.0.0.1:{port}"
+    snap = str(tmp_path / "snap")
+    os.makedirs(snap, exist_ok=True)
+    monkeypatch.setenv("PADDLE_PS_BACKOFF", "0.02")
+    monkeypatch.setenv("PADDLE_PS_DEADLINE", "180")
+
+    n_healthy, n_faulted = 10, 50
+    srv, _ = _spawn_ps(ep, snap, extra_env={
+        "PADDLE_PS_FAULT_KILL_AFTER": "30",
+        "PADDLE_PS_FAULT_KILL_POINT": "reply"})
+    restarted: list = []
+    stop_watch = threading.Event()
+
+    def respawner():
+        while not stop_watch.is_set():
+            if srv.poll() is not None and not restarted:
+                assert srv.returncode == fi.KILL_EXIT_CODE
+                p2, ready2 = _spawn_ps(ep, snap)
+                assert ready2["restored"]
+                restarted.append(p2)
+                return
+            time.sleep(0.05)
+
+    watcher = threading.Thread(target=respawner, daemon=True)
+    watcher.start()
+
+    eng = _tiny_engine()
+    _prewarm(eng)
+    cl = PSClient([ep])
+    push_err: list = []
+    try:
+        base_row = cl.pull("emb", 4, [0]).copy()
+        # healthy phase: baseline serving SLO while the PS tier pushes
+        def push_n(n):
+            try:
+                for _ in range(n):
+                    cl.push("emb", 4, [0], np.ones((1, 4)), lr=1.0)
+            except Exception as e:                # surface in-test
+                push_err.append(e)
+
+        with eng:
+            t1 = threading.Thread(target=push_n, args=(n_healthy,),
+                                  daemon=True)
+            t1.start()
+            res_a = LoadGenerator(_traffic(seed=5, duration=1.5),
+                                  name="ps_healthy").run_engine(eng)
+            assert res_a.wait(180)
+            t1.join(timeout=120)
+            rep_a = slo_report(res_a)
+
+            # fault phase: corruption on, the kill threshold trips
+            # mid-push, the respawner restores the shard from snapshot
+            fi.reset_injector(fi.FaultInjector(corrupt=0.1,
+                                               side="client", seed=17))
+            t2 = threading.Thread(target=push_n, args=(n_faulted,),
+                                  daemon=True)
+            t2.start()
+            res_b = LoadGenerator(_traffic(seed=5, duration=1.5),
+                                  name="ps_faulted").run_engine(eng)
+            assert res_b.wait(180)
+            t2.join(timeout=180)
+            assert not t2.is_alive(), "pushes wedged across the kill"
+            rep_b = slo_report(res_b)
+        assert not push_err, push_err
+        inj = dict(fi.injector().counters)
+        fi.reset_injector(fi.FaultInjector())
+
+        assert restarted, "kill threshold never hit"
+        assert inj["corrupted"] > 0, inj
+        # exactly-once across corruption + kill + respawn: the row
+        # moved by EXACTLY one lr per push
+        final = cl.pull("emb", 4, [0])
+        np.testing.assert_allclose(base_row - final,
+                                   float(n_healthy + n_faulted),
+                                   rtol=1e-6)
+        # serving rode through: attainment within the fixed band of the
+        # healthy phase (identical traffic, same seed)
+        assert rep_a["attainment"] == 1.0, rep_a
+        assert rep_b["attainment"] >= rep_a["attainment"] - 0.1, \
+            (rep_a, rep_b)
+    finally:
+        stop_watch.set()
+        watcher.join(timeout=30)
+        cl.close()
+        for p in [srv] + restarted:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# satellite: fault-knob typo guard
+# ---------------------------------------------------------------------------
+
+def test_fault_knob_typo_guard_warns_once_per_init(monkeypatch, caplog):
+    logger_name = fi.logger.name
+    monkeypatch.setenv("PADDLE_PS_FAULT_KILL_AFTR", "5")  # sic
+    with caplog.at_level(logging.WARNING, logger=logger_name):
+        inj = fi.FaultInjector.from_env()
+    assert "PADDLE_PS_FAULT_KILL_AFTR" in caplog.text
+    assert "PADDLE_PS_FAULT_KILL_AFTER" in caplog.text  # the fix hint
+    assert not inj.active                    # the typo armed NOTHING
+    caplog.clear()
+    monkeypatch.delenv("PADDLE_PS_FAULT_KILL_AFTR")
+    monkeypatch.setenv("PADDLE_PS_FAULT_DELAY", "0.001")
+    with caplog.at_level(logging.WARNING, logger=logger_name):
+        inj = fi.FaultInjector.from_env()
+    # known knobs stay silent
+    assert "PADDLE_PS_FAULT" not in caplog.text
+    assert inj.active and inj.delay == pytest.approx(0.001)
+
+
+# ---------------------------------------------------------------------------
+# satellite: env-knob static check (wired like check_metric_names)
+# ---------------------------------------------------------------------------
+
+def test_tree_passes_env_knob_check():
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_env_knobs.py")],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_env_knob_check_catches_offenders(tmp_path):
+    code = tmp_path / "code"
+    docs = tmp_path / "docs"
+    code.mkdir()
+    docs.mkdir()
+    (code / "sneaky.py").write_text(
+        "import os\n"
+        "A = os.environ.get('PADDLE_TPU_SNEAKY_KNOB', '0')\n"
+        "B = os.getenv('PADDLE_PS_HIDDEN_SWITCH')\n"
+        "# prefix literals are not knobs:\n"
+        "C = [k for k in os.environ if k.startswith('PADDLE_PS_FAULT_')]\n")
+    (docs / "KNOWN.md").write_text(
+        "| `PADDLE_TPU_SNEAKY_KNOB` | documented |\n")
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_env_knobs.py"),
+         str(code), str(docs)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    assert "PADDLE_PS_HIDDEN_SWITCH" in res.stdout
+    assert "PADDLE_TPU_SNEAKY_KNOB" not in res.stdout
+    assert "PADDLE_PS_FAULT" not in res.stdout
+    # documenting the stray knob turns the check green
+    (docs / "KNOWN.md").write_text(
+        "| `PADDLE_TPU_SNEAKY_KNOB` | documented |\n"
+        "| `PADDLE_PS_HIDDEN_SWITCH` | documented |\n")
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_env_knobs.py"),
+         str(code), str(docs)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout
