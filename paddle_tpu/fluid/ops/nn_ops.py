@@ -425,10 +425,12 @@ def _layer_norm(ctx, ins, attrs):
     ax = attrs.get("begin_norm_axis", 1)
 
     # Pallas fused single-pass kernel on TPU (paddle_tpu/ops/pallas_layer_norm)
-    from ...ops.pallas_layer_norm import can_use_fused_ln, fused_layer_norm
+    from ...ops.pallas_layer_norm import (can_use_fused_ln,
+                                          fused_layer_norm, ln_wins)
     rows = int(np.prod(v.shape[:ax])) if v.ndim > ax else 1
     cols = int(np.prod(v.shape[ax:]))
-    if can_use_fused_ln(rows, cols, scale is not None, bias is not None):
+    if can_use_fused_ln(rows, cols, scale is not None, bias is not None) \
+            and ln_wins(rows, cols, v.dtype, attrs["epsilon"]):
         y2, mean, rstd = fused_layer_norm(
             v.reshape(rows, cols), scale.reshape(cols), bias.reshape(cols),
             attrs["epsilon"])
@@ -1024,15 +1026,11 @@ def _fused_dropout_add_ln(ctx, ins, attrs):
     for s in shape[:-1]:
         r *= s
     from ...ops.pallas_fused_residual import (
-        can_use_fused_dropout_add_ln, fused_dropout_add_ln)
-    if can_use_fused_dropout_add_ln(r, c):
-        seed = jnp.zeros((1,), jnp.int32)
-        if p > 0.0:
-            key = ctx.rng(attrs) if ctx is not None \
-                else jax.random.PRNGKey(0)
-            kd = key if jnp.issubdtype(key.dtype, jnp.integer) \
-                else jax.random.key_data(key)
-            seed = kd.ravel()[-1:].astype(jnp.int32)
+        can_use_fused_dropout_add_ln, dropout_add_ln_wins,
+        fused_dropout_add_ln)
+    if can_use_fused_dropout_add_ln(r, c) \
+            and dropout_add_ln_wins(r, c, v.dtype, float(p), float(eps)):
+        seed = _op_seed(ctx, attrs, p)
         y = fused_dropout_add_ln(v.reshape(r, c), res.reshape(r, c),
                                  scale, bias, seed, float(p), float(eps))
         return out(y.reshape(shape))
@@ -1065,9 +1063,10 @@ def _fused_ffn_op(ctx, ins, attrs):
     m = 1
     for s in v.shape[:-1]:
         m *= s
-    from ...ops.pallas_ffn import can_use_fused_ffn, fused_ffn
+    from ...ops.pallas_ffn import can_use_fused_ffn, ffn_wins, fused_ffn
     if act in ("gelu", "relu") and can_use_fused_ffn(
-            m, h, i, itemsize=v.dtype.itemsize):
+            m, h, i, itemsize=v.dtype.itemsize) \
+            and ffn_wins(m, h, i, v.dtype, act):
         return out(fused_ffn(v, w1, b1, w2, b2, act))
     # composed fallback (non-aligned dims / pallas disabled / other act)
     hid = v.reshape(m, h) @ w1 + b1
@@ -1078,6 +1077,111 @@ def _fused_ffn_op(ctx, ins, attrs):
         from ..registry import require
         hid = require(act).compute(ctx, {"X": [hid]}, {})["Out"][0]
     return out((hid @ w2 + b2).astype(v.dtype).reshape(v.shape))
+
+
+# ---------------------------------------------------------------------------
+# epilogue-fused decoder sub-blocks (Pallas, ops/pallas_block.py — CODA
+# style GEMM-epilogue programs; PR-7 tentpole). Both ops carry the full
+# sub-block: GEMM(s) + bias + dropout + residual-add + layernorm in one
+# kernel each way, behind the measured autobench gate with a composed
+# fallback of identical semantics (the dropout mask is the same counter
+# hash on both paths, so fused and fallback agree bit-for-bit-ish).
+# ---------------------------------------------------------------------------
+
+def _op_seed(ctx, attrs, p):
+    import jax
+    seed = jnp.zeros((1,), jnp.int32)
+    if p > 0.0:
+        key = ctx.rng(attrs) if ctx is not None else jax.random.PRNGKey(0)
+        kd = key if jnp.issubdtype(key.dtype, jnp.integer) \
+            else jax.random.key_data(key)
+        seed = kd.ravel()[-1:].astype(jnp.int32)
+    return seed
+
+
+@register("fused_out_ln", infer_shape=same_shape_as("Residual"),
+          stochastic=True,
+          attrs={"epsilon": 1e-5, "dropout_p": 0.0})
+def _fused_out_ln_op(ctx, ins, attrs):
+    """Out = LN(Residual + dropout(X @ W + B)) * Scale + Bias — the
+    attention-out projection GEMM with the whole post-LN sublayer
+    epilogue carried in the kernel."""
+    from ...ops.pallas_block import (can_use_fused_out_ln, fused_out_ln,
+                                     out_ln_reference, out_ln_wins)
+    v, w, b = x(ins, "X"), x(ins, "W"), x(ins, "B")
+    res = x(ins, "Residual")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    p = attrs["dropout_p"]
+    if ctx is not None and ctx.is_test:
+        p = 0.0
+    p, eps = float(p), float(attrs["epsilon"])
+    din = v.shape[-1]
+    dout = w.shape[1]
+    m = 1
+    for s in v.shape[:-1]:
+        m *= s
+    if scale is None:
+        scale = jnp.ones((dout,), jnp.float32)
+    if bias is None:
+        bias = jnp.zeros((dout,), jnp.float32)
+    seed = _op_seed(ctx, attrs, p)
+    v2 = v.reshape(m, din)
+    res2 = res.reshape(m, dout)
+    if can_use_fused_out_ln(m, din, dout, v.dtype.itemsize) \
+            and out_ln_wins(m, din, dout, v.dtype, p, eps):
+        _z, h = fused_out_ln(v2, w, b, res2, scale, bias, seed, p, eps)
+    else:
+        _z, h = out_ln_reference(v2, w, b, res2, scale, bias, seed, p,
+                                 eps)
+    return out(h.astype(res.dtype).reshape(res.shape))
+
+
+@register("fused_ffn_block", infer_shape=same_shape_as("Residual"),
+          stochastic=True,
+          attrs={"activation": "gelu", "epsilon": 1e-5,
+                 "dropout_p": 0.0, "norm": "post"})
+def _fused_ffn_block_op(ctx, ins, attrs):
+    """Out = [LN]( Residual + dropout( act(X' @ W1 + B1) @ W2 + B2 ) )
+    with X' = LN(X) for norm="pre" — the FFN sub-block as ONE
+    GEMM-epilogue program (norm: "pre" | "post" | "none")."""
+    from ...ops.pallas_block import (can_use_fused_ffn_ln, ffn_ln_wins,
+                                     ffn_ln_reference, fused_ffn_ln)
+    v = x(ins, "X")
+    w1, b1 = x(ins, "W1"), x(ins, "B1")
+    w2, b2 = x(ins, "W2"), x(ins, "B2")
+    res = x(ins, "Residual")
+    scale, bias = x(ins, "Scale"), x(ins, "Bias")
+    act = attrs.get("activation", "gelu")
+    norm = attrs.get("norm", "post")
+    p = attrs["dropout_p"]
+    if ctx is not None and ctx.is_test:
+        p = 0.0
+    p, eps = float(p), float(attrs["epsilon"])
+    h = v.shape[-1]
+    i = w1.shape[1]
+    m = 1
+    for s in v.shape[:-1]:
+        m *= s
+    if scale is None:
+        scale = jnp.ones((h,), jnp.float32)
+    if bias is None:
+        bias = jnp.zeros((h,), jnp.float32)
+    seed = _op_seed(ctx, attrs, p)
+    v2 = v.reshape(m, h)
+    res2 = res.reshape(m, h)
+    if act not in ("gelu", "gelu_tanh", "relu"):
+        raise ValueError(
+            f"fused_ffn_block supports gelu/gelu_tanh/relu, got {act!r}"
+            " (use the composed linear/activation ops instead)")
+    if can_use_fused_ffn_ln(m, h, i, v.dtype.itemsize,
+                            norm == "pre") \
+            and ffn_ln_wins(m, h, i, v.dtype, act, norm, p, eps):
+        y = fused_ffn_ln(v2, w1, b1, w2, b2, res2, scale, bias, seed,
+                         act, norm, p, eps)
+    else:
+        y = ffn_ln_reference(v2, w1, b1, w2, b2, res2, scale, bias,
+                             seed, act, norm, p, eps)
+    return out(y.astype(res.dtype).reshape(res.shape))
 
 
 # -- compile-time shape inference additions (VERDICT r5 missing #3) ---------

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["GPTConfig", "init_gpt_params", "gpt_param_specs", "gpt_forward",
-           "gpt_loss", "gpt_block_fn", "GPTForCausalLM"]
+           "gpt_loss", "gpt_block_fn", "decoder_tail", "GPTForCausalLM"]
 
 
 @dataclasses.dataclass
@@ -53,6 +53,12 @@ class GPTConfig:
     # otherwise stash every layer's attention probs ([L,B,H,T,T] — OOM at
     # 350M/seq-1024 on one v5e chip)
     remat: bool = True
+    # epilogue-fused decoder sub-blocks (ops/pallas_block.py): the
+    # attention-out projection + residual + LN2 and the FFN + residual
+    # run as GEMM-epilogue Pallas programs where the autobench gate
+    # measures them faster than the composed XLA chain (dense blocks,
+    # dropout=0 path only; False pins the composed chain everywhere)
+    fused_blocks: bool = True
 
     def __post_init__(self):
         if self.intermediate_size is None:
@@ -228,6 +234,55 @@ def _dropout(x, rate, key):
     return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
 
+def decoder_tail(p, a2, x2, cfg: GPTConfig):
+    """Post-attention tail of a dense pre-LN decoder block, 2-d form:
+
+        z = x2 + a2 @ wo + bo
+        h = LN2(z)
+        out = z + gelu_tanh(h @ w_up + b_up) @ w_down + b_down
+
+    a2/x2: (M, D). ONE source of truth for this math: gpt_block_fn AND
+    the serving decode model (prefill/decode bodies) both call it, so
+    the serving token-parity contract survives the fused paths. Each
+    sub-block runs as an epilogue-fused Pallas program
+    (ops/pallas_block.py) where the autobench gate measures it faster
+    than the composed XLA chain at this (M, D) shape; everywhere else
+    the composed chain below runs bit-identically to the pre-PR-7
+    code."""
+    cdt = x2.dtype
+    wo, bo = p["wo"].astype(cdt), p["bo"].astype(cdt)
+    w_up, b_up = p["w_up"].astype(cdt), p["b_up"].astype(cdt)
+    w_down, b_down = p["w_down"].astype(cdt), p["b_down"].astype(cdt)
+    eps = cfg.layer_norm_eps
+    m, d = x2.shape
+    f = w_up.shape[-1]
+    it = cdt.itemsize
+    seed = jnp.zeros((1,), jnp.int32)
+    z = h = None
+    if cfg.fused_blocks:
+        from ..ops.pallas_block import (can_use_fused_ffn_ln,
+                                        can_use_fused_out_ln,
+                                        ffn_ln_wins, fused_ffn_ln,
+                                        fused_out_ln, out_ln_wins)
+        if can_use_fused_out_ln(m, d, d, it) \
+                and out_ln_wins(m, d, d, cdt, 0.0, eps):
+            z, h = fused_out_ln(a2, wo, bo, x2, p["ln2_s"], p["ln2_b"],
+                                seed, 0.0, eps)
+    if z is None:
+        z = x2 + (a2 @ wo + bo).astype(x2.dtype)
+        h = _ln(z, p["ln2_s"], p["ln2_b"], eps)
+    if cfg.fused_blocks and can_use_fused_ffn_ln(m, d, f, it) \
+            and ffn_ln_wins(m, d, f, cdt, "gelu_tanh", "none"):
+        ones = jnp.ones((d,), jnp.float32)
+        zeros = jnp.zeros((d,), jnp.float32)
+        return fused_ffn_ln(h.astype(cdt), w_up, b_up, w_down, b_down,
+                            z, ones, zeros, seed, "gelu_tanh", "none",
+                            0.0, eps)
+    u = jax.nn.gelu(h.astype(cdt) @ w_up + b_up, approximate=True)
+    dn = u @ w_down + b_down
+    return z + dn.astype(z.dtype)
+
+
 def gpt_block_fn(p: dict, x, cfg: GPTConfig, key=None):
     """One pre-LN decoder block; p leaves are unstacked ([D,...]).
 
@@ -249,6 +304,15 @@ def gpt_block_fn(p: dict, x, cfg: GPTConfig, key=None):
     k = c(h) @ c(p["wk"]) + c(p["bk"])
     v = c(h) @ c(p["wv"]) + c(p["bv"])
     a = _causal_attention(q, k, v, cfg.num_heads, cfg.attn_impl)
+    if cfg.num_experts == 0 and not drop:
+        # dense deterministic path: attention-out + FFN sub-blocks as
+        # epilogue-fused Pallas programs behind the autobench gate
+        # (composed-chain fallback inside decoder_tail is bit-identical
+        # to the previous inline code)
+        B, T, D = x.shape
+        x = decoder_tail(p, c(a).reshape(B * T, D),
+                         x.reshape(B * T, D), cfg).reshape(B, T, D)
+        return x, jnp.zeros((), jnp.float32)
     proj = a @ c(p["wo"]) + c(p["bo"])
     if drop:
         proj = _dropout(proj, drop, k1)

@@ -80,8 +80,13 @@ class MultiHeadAttention(Layer):
         v = self._split_heads(self.v_proj(key))
         return self.Cache(k, v)
 
-    def forward(self, query, key=None, value=None, attn_mask=None,
-                cache=None):
+    def attention_preproj(self, query, key=None, value=None,
+                          attn_mask=None, cache=None):
+        """Attention WITHOUT the output projection, shaped (B, S, D) —
+        the encoder layer's fused epilogue folds out_proj's GEMM into
+        its epilogue-fused Pallas program (ops/pallas_block.py), so the
+        projection must stay outside the attention op. Returns
+        (pre-projection output, new_cache)."""
         from ..ops.flash_attention import scaled_dot_product_attention
         from .. import tensor as T
         key = query if key is None else key
@@ -103,7 +108,13 @@ class MultiHeadAttention(Layer):
         out = scaled_dot_product_attention(
             q, k, v, attn_mask=mask, dropout_p=self.dropout,
             training=self.training)
-        out = self.out_proj(self._merge_heads(out))
+        return self._merge_heads(out), new_cache
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        out, new_cache = self.attention_preproj(query, key, value,
+                                                attn_mask, cache)
+        out = self.out_proj(out)
         if cache is not None:
             return out, new_cache
         return out
@@ -170,28 +181,76 @@ class TransformerEncoderLayer(Layer):
             {"dropout_p": drop.p if self.training else 0.0,
              "epsilon": norm._epsilon})
 
-    def forward(self, src, src_mask=None, cache=None):
+    def _attn_sublayer(self, src, src_mask, cache):
+        """Self-attention + its epilogue. Post-LN with a biased
+        out-projection runs the whole epilogue — projection GEMM +
+        dropout + residual-add + LN — as ONE epilogue-fused program
+        (fluid/ops fused_out_ln, ops/pallas_block.py, autobench-gated);
+        other configurations keep the composed path."""
         from .. import tensor as T
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
+        attn = self.self_attn
+        if not self.normalize_before and attn.out_proj.bias is not None:
+            pre, new_cache = attn.attention_preproj(src, src, src,
+                                                    src_mask, cache)
+            from ..common_ops import run_op
+            out = run_op(
+                "fused_out_ln",
+                {"X": pre, "W": attn.out_proj.weight,
+                 "B": attn.out_proj.bias, "Residual": residual,
+                 "Scale": self.norm1.weight, "Bias": self.norm1.bias},
+                {"dropout_p": self.dropout0.p if self.training else 0.0,
+                 "epsilon": self.norm1._epsilon})
+            return out, new_cache
         if cache is not None:
-            src, cache = self.self_attn(src, src, src, src_mask, cache)
+            src, new_cache = attn(src, src, src, src_mask, cache)
         else:
-            src = self.self_attn(src, src, src, src_mask)
+            src = attn(src, src, src, src_mask)
+            new_cache = None
         if not self.normalize_before:
             src = self._epilogue(src, residual, self.norm1, self.dropout0)
         else:
             src = T.add(residual, self.dropout0(src))
+        return src, new_cache
+
+    def _ffn_sublayer(self, src):
+        """FFN + its epilogue. With a gelu/relu activation, no act
+        dropout and biased linears, the whole sub-block — (pre)norm +
+        linear1 + act + linear2 + dropout + residual (+ postnorm) —
+        runs as ONE epilogue-fused program (fluid/ops fused_ffn_block,
+        autobench-gated)."""
+        from .. import tensor as T
         residual = src
+        act_name = self._config["activation"]
+        act_drop = self.dropout1.p if self.training else 0.0
+        if act_name in ("gelu", "relu") and act_drop == 0.0 \
+                and self.linear1.bias is not None \
+                and self.linear2.bias is not None:
+            from ..common_ops import run_op
+            return run_op(
+                "fused_ffn_block",
+                {"X": src, "W1": self.linear1.weight,
+                 "B1": self.linear1.bias, "W2": self.linear2.weight,
+                 "B2": self.linear2.bias, "Residual": residual,
+                 "Scale": self.norm2.weight, "Bias": self.norm2.bias},
+                {"activation": act_name,
+                 "norm": "pre" if self.normalize_before else "post",
+                 "dropout_p": self.dropout2.p if self.training else 0.0,
+                 "epsilon": self.norm2._epsilon})
         if self.normalize_before:
             src = self.norm2(src)
         src = self._ffn(src)
         if not self.normalize_before:
-            src = self._epilogue(src, residual, self.norm2, self.dropout2)
-        else:
-            src = T.add(residual, self.dropout2(src))
-        return src if cache is None else (src, cache)
+            return self._epilogue(src, residual, self.norm2,
+                                  self.dropout2)
+        return T.add(residual, self.dropout2(src))
+
+    def forward(self, src, src_mask=None, cache=None):
+        src, new_cache = self._attn_sublayer(src, src_mask, cache)
+        src = self._ffn_sublayer(src)
+        return src if cache is None else (src, new_cache)
 
     def gen_cache(self, src):
         return self.self_attn.gen_cache(src)

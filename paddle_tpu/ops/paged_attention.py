@@ -146,6 +146,30 @@ def paged_attention_pallas(q, k_pages, v_pages, page_table, ctx_lens,
       q, k_pages, v_pages)
 
 
+def _gate_paged(S, H, d, P, ps, M, dtype):
+    """(key, candidates, make_args) — shared by the decode-path gate and
+    the autobench warm CLI (a fleet replica shipping a pre-warmed cache
+    skips first-request measurement on its decode hot path)."""
+    dtype = jnp.dtype(dtype)
+    key = ("paged_attention", S, H, d, P, ps, M, str(dtype))
+
+    def make_args():
+        import numpy as np
+        rng = np.random.RandomState(0)
+        qq = jnp.asarray(rng.randn(S, H, d), dtype)
+        kk = jnp.asarray(rng.randn(P, ps, H, d), dtype)
+        vv = jnp.asarray(rng.randn(P, ps, H, d), dtype)
+        pt = jnp.asarray(rng.randint(0, P, (S, M)), jnp.int32)
+        ln = jnp.asarray(rng.randint(1, M * ps + 1, (S,)), jnp.int32)
+        return qq, kk, vv, pt, ln
+
+    cands = {
+        "xla": paged_attention_xla,
+        "pallas": lambda *a: paged_attention_pallas(*a, interpret=False),
+    }
+    return key, cands, make_args
+
+
 def _auto_impl(q, k_pages, page_table) -> str:
     """Measure-once arbitration (TPU only; everywhere else the gathered
     XLA path is the portable winner and interpret-mode timing would be
@@ -157,23 +181,24 @@ def _auto_impl(q, k_pages, page_table) -> str:
     S, H, d = q.shape
     P, ps = k_pages.shape[0], k_pages.shape[1]
     M = page_table.shape[1]
-    key = ("paged_attention", S, H, d, P, ps, M, str(q.dtype))
+    key, cands, make_args = _gate_paged(S, H, d, P, ps, M, q.dtype)
+    return autobench.prefer(key, cands, make_args, default="xla")
 
-    def make_args():
-        import numpy as np
-        rng = np.random.RandomState(0)
-        qq = jnp.asarray(rng.randn(S, H, d), q.dtype)
-        kk = jnp.asarray(rng.randn(P, ps, H, d), q.dtype)
-        vv = jnp.asarray(rng.randn(P, ps, H, d), q.dtype)
-        pt = jnp.asarray(rng.randint(0, P, (S, M)), jnp.int32)
-        ln = jnp.asarray(rng.randint(1, M * ps + 1, (S,)), jnp.int32)
-        return qq, kk, vv, pt, ln
 
-    return autobench.prefer(
-        key,
-        {"xla": paged_attention_xla,
-         "pallas": lambda *a: paged_attention_pallas(*a, interpret=False)},
-        make_args, default="xla")
+def _warm_paged(spec: dict) -> str:
+    from . import autobench
+    key, cands, make_args = _gate_paged(
+        int(spec["s"]), int(spec["h"]), int(spec["d"]), int(spec["p"]),
+        int(spec["ps"]), int(spec["m"]), spec.get("dtype", "bfloat16"))
+    return autobench.prefer(key, cands, make_args, default="xla")
+
+
+def _register_warmer():
+    from . import autobench
+    autobench.register_warmer("paged_attention", _warm_paged)
+
+
+_register_warmer()
 
 
 def paged_attention_decode(q, k_pages, v_pages, page_table, ctx_lens,

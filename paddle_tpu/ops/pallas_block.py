@@ -1,0 +1,556 @@
+"""Pallas TPU epilogue-fused transformer decoder sub-blocks (CODA style).
+
+The remaining fusion headroom after the flash/FFN/LN kernels is the
+sub-block SEAMS: the attention out-projection's result and the FFN's
+result each take an HBM round trip before their residual-add and
+layernorm. This module rewrites both sub-blocks as GEMM-epilogue
+programs (CODA, arxiv 2605.19269 — the epilogue rides the MXU pipeline
+for free; the XLA fusion study 2301.13062 documents XLA declining
+exactly these cross-op fusions):
+
+  fused_out_ln    z = res + dropout_p(a @ W + b);  h = LN(z)*s + ln_b
+                  — the attention-out projection GEMM whose epilogue
+                  carries bias + dropout + residual-add + layernorm,
+                  emitting BOTH the new residual stream z and the
+                  normalised h (pre-LN blocks feed h to the FFN; post-LN
+                  blocks use h as the sub-block output).
+
+  fused_ffn_ln    out = [LN]( res + dropout_p( act(x' @ W1 + b1) @ W2
+                  + b2 ) ) with x' = LN(x) when norm="pre" —
+                  the whole FFN sub-block as one GEMM-pair program: the
+                  4H intermediate stays in VMEM (pallas_ffn lineage) and
+                  the epilogue carries bias + activation + dropout +
+                  residual + (pre|post)norm.
+
+Both carry custom VJPs (rematerialising backward: save only primal
+inputs, grads via one composed-XLA recompute with the dropout mask
+REPLAYED from the counter hash — no mask tensor ever exists in HBM), so
+the fused paths hold on the training hot path. Both are gated through
+ops/autobench.prefer: on TPU the Pallas program must measurably beat
+the composed XLA chain per shape (and the decision persists in the
+tuning cache); off-TPU only the interpret-mode opt-in runs them.
+
+Ragged rows: the row dimension is padded to the block size inside the
+wrappers (padded rows are dead lanes sliced off on exit), so
+non-multiple-of-block token counts (ragged serving batches, odd
+sequence lengths) stay on the fused path instead of falling back.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import autobench
+from .pallas_attention import on_tpu
+from .pallas_ffn import _ACTS, _CompilerParams, _vmem_budget
+from .pallas_fused_residual import _ids, _keep
+
+__all__ = ["fused_out_ln", "can_use_fused_out_ln", "out_ln_wins",
+           "out_ln_reference", "fused_ffn_ln", "can_use_fused_ffn_ln",
+           "ffn_ln_wins", "ffn_ln_reference"]
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def _seed_spec():
+    """(1,) int32 seed: SMEM on TPU; a plain block in interpret mode
+    (2-D grid variant of pallas_fused_residual._smem_seed_spec)."""
+    if _interpret():
+        return pl.BlockSpec((1,), lambda mi, j: (0,))
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _pad_rows(x2, m_pad: int):
+    m = x2.shape[0]
+    return x2 if m == m_pad else jnp.pad(x2, ((0, m_pad - m), (0, 0)))
+
+
+def _padded_m(m: int) -> int:
+    return -(-m // 128) * 128
+
+
+def _row_block(m_pad: int) -> int:
+    for bm in (512, 256, 128):
+        if m_pad % bm == 0:
+            return bm
+    return 128
+
+
+def _keep_full(seed_arr, m: int, c: int, p: float):
+    """Full-grid dropout mask replay for the composed backward — same
+    counter hash over the same global element ids as the kernel."""
+    rows = jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[:, None],
+                            (m, c))
+    cols = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32)[None, :],
+                            (m, c))
+    return _keep(seed_arr, rows, cols, c, p)
+
+
+# f32 activations for the composed reference/backward (the in-kernel
+# erf-poly gelu differs from lax.erf by <1.5e-7 — inside every caller's
+# tolerance; gelu_tanh and relu are bit-identical formulas)
+_REF_ACTS = {
+    "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+    "gelu_tanh": lambda v: jax.nn.gelu(v, approximate=True),
+    "relu": jax.nn.relu,
+}
+
+
+def _ln_f32(z, scale, bias, eps):
+    mean = jnp.mean(z, -1, keepdims=True)
+    var = jnp.mean(jnp.square(z - mean), -1, keepdims=True)
+    return (z - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+# ---------------------------------------------------------------------------
+# fused_out_ln: GEMM + bias + dropout + residual + LN, one program
+# ---------------------------------------------------------------------------
+
+def _pick_out_blocks(m_pad: int, din: int, dout: int,
+                     itemsize: int) -> tuple[int, int] | None:
+    """(bm, bk) whose VMEM working set fits: f32 (bm, dout) accumulator
+    + double-buffered a/w/b/res/ln/z/h blocks."""
+    budget = _vmem_budget()
+    bm0 = _row_block(m_pad)
+    for bm in (512, 256, 128):
+        if bm > bm0 or m_pad % bm:
+            continue
+        for bk in (512, 256, 128):
+            if din % bk:
+                continue
+            scratch = bm * dout * 4
+            blocks = 2 * itemsize * (bm * bk        # a block
+                                     + bk * dout    # w block
+                                     + 3 * dout     # bias, ln scale/bias
+                                     + bm * dout    # residual block
+                                     + 2 * bm * dout)  # z + h out blocks
+            if scratch + blocks <= budget:
+                return bm, bk
+    return None
+
+
+def can_use_fused_out_ln(m: int, din: int, dout: int,
+                         itemsize: int = 4) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("PADDLE_TPU_DISABLE_BLOCK_FUSION"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    if din % 128 or dout % 128 or dout > 4096 or m < 1:
+        return False
+    return _pick_out_blocks(_padded_m(m), din, dout, itemsize) is not None
+
+
+def _out_ln_kernel(seed_ref, a_ref, w_ref, b_ref, res_ref, s_ref, lb_ref,
+                   z_ref, h_ref, acc_ref, *, n_k, eps, p):
+    mi = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(a_ref[...], w_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _epilogue():
+        y = acc_ref[...] + b_ref[0].astype(jnp.float32)[None, :]
+        bm, c = y.shape
+        if p > 0.0:
+            rows, cols = _ids(mi, bm, c)
+            keep = _keep(seed_ref, rows, cols, c, p)
+            y = jnp.where(keep, y / (1.0 - p), 0.0)
+        z = y + res_ref[...].astype(jnp.float32)
+        h = _ln_f32(z, s_ref[0].astype(jnp.float32)[None, :],
+                    lb_ref[0].astype(jnp.float32)[None, :], eps)
+        z_ref[...] = z.astype(z_ref.dtype)
+        h_ref[...] = h.astype(h_ref.dtype)
+
+
+def _out_ln_pallas(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps,
+                   bm, bk, m_pad):
+    din, dout = w.shape
+    a2p = _pad_rows(a2, m_pad)
+    resp = _pad_rows(res2, m_pad)
+    n_k = din // bk
+    z, h = pl.pallas_call(
+        functools.partial(_out_ln_kernel, n_k=n_k, eps=eps, p=p),
+        grid=(m_pad // bm, n_k),
+        in_specs=[
+            _seed_spec(),
+            pl.BlockSpec((bm, bk), lambda mi, j: (mi, j)),
+            pl.BlockSpec((bk, dout), lambda mi, j: (j, 0)),
+            pl.BlockSpec((1, dout), lambda mi, j: (0, 0)),
+            pl.BlockSpec((bm, dout), lambda mi, j: (mi, 0)),
+            pl.BlockSpec((1, dout), lambda mi, j: (0, 0)),
+            pl.BlockSpec((1, dout), lambda mi, j: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, dout), lambda mi, j: (mi, 0)),
+            pl.BlockSpec((bm, dout), lambda mi, j: (mi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m_pad, dout), res2.dtype),
+            jax.ShapeDtypeStruct((m_pad, dout), a2.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, dout), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(seed_arr, a2p, w, b.reshape(1, dout), resp,
+      ln_s.reshape(1, dout), ln_b.reshape(1, dout))
+    m = a2.shape[0]
+    return z[:m], h[:m]
+
+
+def out_ln_reference(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps):
+    """Composed-XLA chain with identical semantics (fallback, autobench
+    candidate, and the parity-test reference)."""
+    y = (a2.astype(jnp.float32) @ w.astype(jnp.float32)
+         + b.astype(jnp.float32))
+    if p > 0.0:
+        keep = _keep_full(seed_arr, y.shape[0], y.shape[1], p)
+        y = jnp.where(keep, y / (1.0 - p), 0.0)
+    z = y + res2.astype(jnp.float32)
+    h = _ln_f32(z, ln_s.astype(jnp.float32), ln_b.astype(jnp.float32),
+                eps)
+    return z.astype(res2.dtype), h.astype(a2.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def fused_out_ln(a2, w, b, res2, ln_s, ln_b, seed_arr, p=0.0, eps=1e-5):
+    """a2 (M, Din) @ w (Din, Dout) + b, dropout_p, + res2, layernorm.
+
+    Returns (z, h): z (M, Dout) in res2.dtype is the new residual
+    stream; h in a2.dtype is LN(z)*ln_s + ln_b. seed_arr: (1,) int32
+    (no gradient); p/eps static."""
+    return _out_ln_fwd(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps)[0]
+
+
+def _out_ln_impl(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps):
+    m = a2.shape[0]
+    din, dout = w.shape
+    m_pad = _padded_m(m)
+    blocks = _pick_out_blocks(m_pad, din, dout, a2.dtype.itemsize)
+    if blocks is None:
+        return out_ln_reference(a2, w, b, res2, ln_s, ln_b, seed_arr, p,
+                                eps)
+    return _out_ln_pallas(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps,
+                          *blocks, m_pad)
+
+
+def _out_ln_fwd(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps):
+    zh = _out_ln_impl(a2, w, b, res2, ln_s, ln_b, seed_arr, p, eps)
+    return zh, (a2, w, b, res2, ln_s, ln_b, seed_arr)
+
+
+def _out_ln_bwd(p, eps, saved, cots):
+    a2, w, b, res2, ln_s, ln_b, seed_arr = saved
+    dz, dh = cots
+
+    def chain(a2f, wf, bf, resf, sf, lbf):
+        z, h = out_ln_reference(
+            a2f, wf, bf, resf, sf, lbf, seed_arr, p, eps)
+        return z.astype(jnp.float32), h.astype(jnp.float32)
+
+    _, vjp = jax.vjp(chain, a2.astype(jnp.float32),
+                     w.astype(jnp.float32), b.astype(jnp.float32),
+                     res2.astype(jnp.float32), ln_s.astype(jnp.float32),
+                     ln_b.astype(jnp.float32))
+    da, dw, db, dres, ds, dlb = vjp((dz.astype(jnp.float32),
+                                     dh.astype(jnp.float32)))
+    return (da.astype(a2.dtype), dw.astype(w.dtype), db.astype(b.dtype),
+            dres.astype(res2.dtype), ds.astype(ln_s.dtype),
+            dlb.astype(ln_b.dtype), None)
+
+
+fused_out_ln.defvjp(_out_ln_fwd, _out_ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused_ffn_ln: (pre)norm + GEMM + act + GEMM + bias + dropout +
+# residual (+ postnorm), one program
+# ---------------------------------------------------------------------------
+
+def _pick_ffn_blocks(m_pad: int, h: int, i: int, itemsize: int,
+                     prenorm: bool) -> tuple[int, int] | None:
+    budget = _vmem_budget()
+    bm0 = _row_block(m_pad)
+    for bm in (512, 256, 128):
+        if bm > bm0 or m_pad % bm:
+            continue
+        for bi in (512, 256, 128):
+            if i % bi:
+                continue
+            scratch = bm * h * 4 \
+                + (bm * h * itemsize if prenorm else 0)
+            blocks = 2 * itemsize * (bm * h          # x block
+                                     + h * bi + bi   # W1, b1
+                                     + bi * h + h    # W2, b2
+                                     + bm * h        # residual block
+                                     + 2 * h         # ln scale/bias
+                                     + bm * h)       # out block
+            if scratch + blocks <= budget:
+                return bm, bi
+    return None
+
+
+def can_use_fused_ffn_ln(m: int, h: int, i: int, itemsize: int = 4,
+                         prenorm: bool = False) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if os.environ.get("PADDLE_TPU_DISABLE_BLOCK_FUSION"):
+        return False
+    if not (on_tpu() or os.environ.get("PADDLE_TPU_PALLAS_INTERPRET")):
+        return False
+    if h % 128 or i % 128 or h > 4096 or m < 1:
+        return False
+    return _pick_ffn_blocks(_padded_m(m), h, i, itemsize,
+                            prenorm) is not None
+
+
+def _ffn_ln_kernel(seed_ref, x_ref, w1_ref, b1_ref, w2_ref, b2_ref,
+                   res_ref, s_ref, lb_ref, o_ref, acc_ref, xn_ref, *,
+                   act, n_i, norm, eps, p):
+    mi = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        if norm == "pre":
+            xn = _ln_f32(x_ref[...].astype(jnp.float32),
+                         s_ref[0].astype(jnp.float32)[None, :],
+                         lb_ref[0].astype(jnp.float32)[None, :], eps)
+            xn_ref[...] = xn.astype(xn_ref.dtype)
+
+    src = xn_ref[...] if norm == "pre" else x_ref[...]
+    a = jnp.dot(src, w1_ref[...],
+                preferred_element_type=jnp.float32) + b1_ref[...]
+    hid = act(a).astype(x_ref.dtype)
+    acc_ref[...] += jnp.dot(hid, w2_ref[...],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_i - 1)
+    def _epilogue():
+        y = acc_ref[...] + b2_ref[0].astype(jnp.float32)[None, :]
+        bm, c = y.shape
+        if p > 0.0:
+            rows, cols = _ids(mi, bm, c)
+            keep = _keep(seed_ref, rows, cols, c, p)
+            y = jnp.where(keep, y / (1.0 - p), 0.0)
+        z = y + res_ref[...].astype(jnp.float32)
+        if norm == "post":
+            z = _ln_f32(z, s_ref[0].astype(jnp.float32)[None, :],
+                        lb_ref[0].astype(jnp.float32)[None, :], eps)
+        o_ref[...] = z.astype(o_ref.dtype)
+
+
+def _ffn_ln_pallas(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr, act,
+                   norm, p, eps, bm, bi, m_pad):
+    h = x2.shape[1]
+    i = w1.shape[1]
+    n_i = i // bi
+    x2p = _pad_rows(x2, m_pad)
+    resp = _pad_rows(res2, m_pad)
+    scratch = [pltpu.VMEM((bm, h), jnp.float32)]
+    scratch.append(pltpu.VMEM((bm, h), x2.dtype) if norm == "pre"
+                   else pltpu.VMEM((1, 128), x2.dtype))
+    out = pl.pallas_call(
+        functools.partial(_ffn_ln_kernel, act=_ACTS[act], n_i=n_i,
+                          norm=norm, eps=eps, p=p),
+        grid=(m_pad // bm, n_i),
+        in_specs=[
+            _seed_spec(),
+            pl.BlockSpec((bm, h), lambda mi, j: (mi, 0)),
+            pl.BlockSpec((h, bi), lambda mi, j: (0, j)),
+            pl.BlockSpec((1, bi), lambda mi, j: (0, j)),
+            pl.BlockSpec((bi, h), lambda mi, j: (j, 0)),
+            pl.BlockSpec((1, h), lambda mi, j: (0, 0)),
+            pl.BlockSpec((bm, h), lambda mi, j: (mi, 0)),
+            pl.BlockSpec((1, h), lambda mi, j: (0, 0)),
+            pl.BlockSpec((1, h), lambda mi, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, h), lambda mi, j: (mi, 0)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, h), res2.dtype),
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(seed_arr, x2p, w1, b1.reshape(1, i), w2, b2.reshape(1, h), resp,
+      ln_s.reshape(1, h), ln_b.reshape(1, h))
+    return out[:x2.shape[0]]
+
+
+def ffn_ln_reference(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr,
+                     act, norm, p, eps):
+    """Composed-XLA chain with identical semantics."""
+    sf = ln_s.astype(jnp.float32)
+    lbf = ln_b.astype(jnp.float32)
+    src = x2.astype(jnp.float32)
+    if norm == "pre":
+        src = _ln_f32(src, sf, lbf, eps)
+    hid = _REF_ACTS[act](src @ w1.astype(jnp.float32)
+                         + b1.astype(jnp.float32))
+    y = hid @ w2.astype(jnp.float32) + b2.astype(jnp.float32)
+    if p > 0.0:
+        keep = _keep_full(seed_arr, y.shape[0], y.shape[1], p)
+        y = jnp.where(keep, y / (1.0 - p), 0.0)
+    z = y + res2.astype(jnp.float32)
+    if norm == "post":
+        z = _ln_f32(z, sf, lbf, eps)
+    return z.astype(res2.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12))
+def fused_ffn_ln(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr,
+                 act="gelu", norm="none", p=0.0, eps=1e-5):
+    """The FFN sub-block as one GEMM-epilogue program.
+
+    out = [LN]( res2 + dropout_p( act(x' @ w1 + b1) @ w2 + b2 ) ) with
+    x' = LN(x2) for norm="pre" (pre-LN blocks pass res2 == x2), plain
+    x2 for norm="none"/"post"; norm="post" applies the LN to the summed
+    output (post-LN encoders). ln_s/ln_b are ignored for norm="none"
+    (pass ones/zeros). seed_arr: (1,) int32; act/norm/p/eps static."""
+    return _ffn_ln_fwd(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr,
+                       act, norm, p, eps)[0]
+
+
+def _ffn_ln_impl(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr, act,
+                 norm, p, eps):
+    m, h = x2.shape
+    i = w1.shape[1]
+    m_pad = _padded_m(m)
+    blocks = _pick_ffn_blocks(m_pad, h, i, x2.dtype.itemsize,
+                              norm == "pre")
+    if blocks is None:
+        return ffn_ln_reference(x2, w1, b1, w2, b2, res2, ln_s, ln_b,
+                                seed_arr, act, norm, p, eps)
+    return _ffn_ln_pallas(x2, w1, b1, w2, b2, res2, ln_s, ln_b,
+                          seed_arr, act, norm, p, eps, *blocks, m_pad)
+
+
+def _ffn_ln_fwd(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr, act,
+                norm, p, eps):
+    out = _ffn_ln_impl(x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr,
+                       act, norm, p, eps)
+    return out, (x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr)
+
+
+def _ffn_ln_bwd(act, norm, p, eps, saved, dy):
+    x2, w1, b1, w2, b2, res2, ln_s, ln_b, seed_arr = saved
+
+    def chain(x2f, w1f, b1f, w2f, b2f, resf, sf, lbf):
+        return ffn_ln_reference(x2f, w1f, b1f, w2f, b2f, resf, sf, lbf,
+                                seed_arr, act, norm, p,
+                                eps).astype(jnp.float32)
+
+    _, vjp = jax.vjp(chain, x2.astype(jnp.float32),
+                     w1.astype(jnp.float32), b1.astype(jnp.float32),
+                     w2.astype(jnp.float32), b2.astype(jnp.float32),
+                     res2.astype(jnp.float32), ln_s.astype(jnp.float32),
+                     ln_b.astype(jnp.float32))
+    dx, dw1, db1, dw2, db2, dres, ds, dlb = vjp(dy.astype(jnp.float32))
+    return (dx.astype(x2.dtype), dw1.astype(w1.dtype),
+            db1.astype(b1.dtype), dw2.astype(w2.dtype),
+            db2.astype(b2.dtype), dres.astype(res2.dtype),
+            ds.astype(ln_s.dtype), dlb.astype(ln_b.dtype), None)
+
+
+fused_ffn_ln.defvjp(_ffn_ln_fwd, _ffn_ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# autobench gates + warmers (gate-then-cache flow, docs/KERNELS.md)
+# ---------------------------------------------------------------------------
+
+def _rand2(rng, m, n, dtype):
+    return jnp.asarray(rng.randn(m, n) * 0.05, dtype)
+
+
+def _gate_out_ln(m, din, dout, dtype, p=0.0, eps=1e-5):
+    import numpy as np
+    dtype = jnp.dtype(dtype)
+    key = ("fused_out_ln", m, din, dout, str(dtype), round(p, 4))
+
+    def make_args():
+        rng = np.random.RandomState(0)
+        return (_rand2(rng, m, din, dtype), _rand2(rng, din, dout, dtype),
+                _rand2(rng, 1, dout, dtype)[0], _rand2(rng, m, dout, dtype),
+                jnp.ones((dout,), jnp.float32),
+                jnp.zeros((dout,), jnp.float32),
+                jnp.zeros((1,), jnp.int32))
+
+    cands = {
+        "pallas": lambda *a: fused_out_ln(*a, p, eps),
+        "xla": lambda *a: out_ln_reference(*a, p, eps),
+    }
+    return key, cands, make_args
+
+
+def out_ln_wins(m, din, dout, dtype, p=0.0, eps=1e-5) -> bool:
+    """Autobench gate: on TPU the fused program must beat the composed
+    chain at this shape (decision persisted via the tuning cache);
+    off-TPU the interpret-mode opt-in that passed can_use runs it."""
+    if not on_tpu():
+        return True
+    key, cands, make_args = _gate_out_ln(m, din, dout, dtype, p, eps)
+    return autobench.prefer(key, cands, make_args,
+                            default="pallas") == "pallas"
+
+
+def _gate_ffn_ln(m, h, i, dtype, act, norm, p=0.0, eps=1e-5):
+    import numpy as np
+    dtype = jnp.dtype(dtype)
+    key = ("fused_ffn_ln", m, h, i, str(dtype), act, norm, round(p, 4))
+
+    def make_args():
+        rng = np.random.RandomState(0)
+        return (_rand2(rng, m, h, dtype), _rand2(rng, h, i, dtype),
+                _rand2(rng, 1, i, dtype)[0], _rand2(rng, i, h, dtype),
+                _rand2(rng, 1, h, dtype)[0], _rand2(rng, m, h, dtype),
+                jnp.ones((h,), jnp.float32), jnp.zeros((h,), jnp.float32),
+                jnp.zeros((1,), jnp.int32))
+
+    cands = {
+        "pallas": lambda *a: fused_ffn_ln(*a, act, norm, p, eps),
+        "xla": lambda *a: ffn_ln_reference(*a, act, norm, p, eps),
+    }
+    return key, cands, make_args
+
+
+def ffn_ln_wins(m, h, i, dtype, act, norm, p=0.0, eps=1e-5) -> bool:
+    if not on_tpu():
+        return True
+    key, cands, make_args = _gate_ffn_ln(m, h, i, dtype, act, norm, p,
+                                         eps)
+    return autobench.prefer(key, cands, make_args,
+                            default="pallas") == "pallas"
+
+
+def _warm_out_ln(spec: dict) -> str:
+    key, cands, make_args = _gate_out_ln(
+        int(spec["m"]), int(spec["din"]), int(spec["dout"]),
+        spec.get("dtype", "bfloat16"), float(spec.get("p", 0.0)))
+    return autobench.prefer(key, cands, make_args, default="pallas")
+
+
+def _warm_ffn_ln(spec: dict) -> str:
+    key, cands, make_args = _gate_ffn_ln(
+        int(spec["m"]), int(spec["h"]), int(spec["i"]),
+        spec.get("dtype", "bfloat16"), spec.get("act", "gelu"),
+        spec.get("norm", "none"), float(spec.get("p", 0.0)))
+    return autobench.prefer(key, cands, make_args, default="pallas")
+
+
+autobench.register_warmer("fused_out_ln", _warm_out_ln)
+autobench.register_warmer("fused_ffn_block", _warm_ffn_ln)

@@ -104,8 +104,19 @@ def _gelu_exact(v):
             ).astype(v.dtype)
 
 
+def _gelu_tanh(v):
+    """tanh-approximated gelu (the GPT-2 convention jax.nn.gelu
+    approximate=True uses) — bit-matching formula, so the fused blocks
+    can hold paths that train with the approximate activation."""
+    f = v.astype(jnp.float32)
+    c = 0.7978845608028654  # sqrt(2/pi)
+    return (0.5 * f * (1.0 + jnp.tanh(c * (f + 0.044715 * f * f * f)))
+            ).astype(v.dtype)
+
+
 _ACTS = {
     "gelu": _gelu_exact,
+    "gelu_tanh": _gelu_tanh,
     "relu": jax.nn.relu,
 }
 
@@ -199,3 +210,60 @@ def _fused_ffn_bwd(act_name, res, dy):
 
 
 fused_ffn.defvjp(_fused_ffn_fwd, _fused_ffn_bwd)
+
+
+# ---------------------------------------------------------------------------
+# autobench gate + warmer: the fused FFN must beat the composed chain
+# per shape on TPU (PR-7 satellite: no hand kernel holds a hot path by
+# construction — every Pallas-vs-XLA choice routes through prefer())
+# ---------------------------------------------------------------------------
+
+def _gate_ffn(m, h, i, dtype, act="gelu"):
+    import numpy as np
+    dtype = jnp.dtype(dtype)
+    key = ("fused_ffn", m, h, i, str(dtype), act)
+
+    def mk(rng, r, c):
+        return jnp.asarray(rng.randn(r, c) * 0.05, dtype)
+
+    def make_args():
+        rng = np.random.RandomState(0)
+        return (mk(rng, m, h), mk(rng, h, i), mk(rng, 1, i)[0],
+                mk(rng, i, h), mk(rng, 1, h)[0])
+
+    def xla_chain(x, w1, b1, w2, b2):
+        hid = _ACTS[act](x @ w1 + b1)
+        return (hid.astype(x.dtype) @ w2 + b2).astype(x.dtype)
+
+    cands = {
+        "pallas": lambda *a: fused_ffn(*a, act),
+        "xla": xla_chain,
+    }
+    return key, cands, make_args
+
+
+def ffn_wins(m, h, i, dtype, act="gelu") -> bool:
+    """On TPU: measured per-shape arbitration (persisted via the tuning
+    cache); off-TPU the interpret opt-in that passed can_use runs it."""
+    if not on_tpu():
+        return True
+    from . import autobench
+    key, cands, make_args = _gate_ffn(m, h, i, dtype, act)
+    return autobench.prefer(key, cands, make_args,
+                            default="pallas") == "pallas"
+
+
+def _warm_ffn(spec: dict) -> str:
+    from . import autobench
+    key, cands, make_args = _gate_ffn(
+        int(spec["m"]), int(spec["h"]), int(spec["i"]),
+        spec.get("dtype", "bfloat16"), spec.get("act", "gelu"))
+    return autobench.prefer(key, cands, make_args, default="pallas")
+
+
+def _register_warmer():
+    from . import autobench
+    autobench.register_warmer("fused_ffn", _warm_ffn)
+
+
+_register_warmer()

@@ -202,3 +202,75 @@ def _vjp_bwd(p, eps, res, dy):
 
 
 fused_dropout_add_ln.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ---------------------------------------------------------------------------
+# autobench gate + warmer (PR-7 satellite: this kernel bypassed the
+# measured gate — it now must beat the composed XLA epilogue per shape
+# on TPU, with the decision persisted via the tuning cache)
+# ---------------------------------------------------------------------------
+
+def _composed_ref(x2d, res2d, scale, bias, seed_arr, p, eps):
+    v = x2d.astype(jnp.float32)
+    if p > 0.0:
+        r, c = v.shape
+        rows = jnp.broadcast_to(
+            jnp.arange(r, dtype=jnp.int32)[:, None], (r, c))
+        cols = jnp.broadcast_to(
+            jnp.arange(c, dtype=jnp.int32)[None, :], (r, c))
+        keep = _keep(seed_arr, rows, cols, c, p)
+        v = jnp.where(keep, v / (1.0 - p), 0.0)
+    z = v + res2d.astype(jnp.float32)
+    mean = jnp.mean(z, -1, keepdims=True)
+    var = jnp.mean(jnp.square(z - mean), -1, keepdims=True)
+    zhat = (z - mean) * jax.lax.rsqrt(var + eps)
+    return (zhat * scale + bias).astype(res2d.dtype)
+
+
+def _gate_dropout_add_ln(rows, cols, dtype, p=0.0, eps=1e-5):
+    import numpy as np
+    dtype = jnp.dtype(dtype)
+    key = ("fused_dropout_add_ln", rows, cols, str(dtype), round(p, 4))
+
+    def make_args():
+        rng = np.random.RandomState(0)
+        return (jnp.asarray(rng.randn(rows, cols), dtype),
+                jnp.asarray(rng.randn(rows, cols), dtype),
+                jnp.ones((cols,), jnp.float32),
+                jnp.zeros((cols,), jnp.float32),
+                jnp.zeros((1,), jnp.int32))
+
+    cands = {
+        "pallas": lambda x, r, s, b, sd: fused_dropout_add_ln(
+            x, r, s, b, sd, p, eps),
+        "xla": lambda x, r, s, b, sd: _composed_ref(
+            x, r, s, b, sd, p, eps),
+    }
+    return key, cands, make_args
+
+
+def dropout_add_ln_wins(rows, cols, dtype, p=0.0, eps=1e-5) -> bool:
+    if not on_tpu():
+        return True
+    from . import autobench
+    key, cands, make_args = _gate_dropout_add_ln(rows, cols, dtype, p,
+                                                 eps)
+    return autobench.prefer(key, cands, make_args,
+                            default="pallas") == "pallas"
+
+
+def _warm_dropout_add_ln(spec: dict) -> str:
+    from . import autobench
+    key, cands, make_args = _gate_dropout_add_ln(
+        int(spec["rows"]), int(spec["cols"]),
+        spec.get("dtype", "bfloat16"), float(spec.get("p", 0.0)))
+    return autobench.prefer(key, cands, make_args, default="pallas")
+
+
+def _register_warmer():
+    from . import autobench
+    autobench.register_warmer("fused_dropout_add_ln",
+                              _warm_dropout_add_ln)
+
+
+_register_warmer()

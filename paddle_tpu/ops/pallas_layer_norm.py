@@ -145,3 +145,61 @@ def _ln_bwd(eps, res, cots):
 
 
 fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# autobench gate + warmer (PR-7 satellite: this kernel used to hold the
+# layer_norm op unconditionally wherever can_use_fused_ln passed — now
+# it must beat the composed XLA chain per shape on TPU, with the
+# decision persisted via the tuning cache)
+# ---------------------------------------------------------------------------
+
+def _ln_xla_ref(x2d, scale, bias, eps=1e-5):
+    fp = x2d.astype(jnp.float32)
+    mean = jnp.mean(fp, -1, keepdims=True)
+    var = jnp.var(fp, -1, keepdims=True)
+    y = (fp - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x2d.dtype)
+
+
+def _gate_ln(rows, cols, dtype, eps=1e-5):
+    import numpy as np
+    dtype = jnp.dtype(dtype)
+    key = ("fused_layer_norm", rows, cols, str(dtype))
+
+    def make_args():
+        rng = np.random.RandomState(0)
+        return (jnp.asarray(rng.randn(rows, cols), dtype),
+                jnp.ones((cols,), jnp.float32),
+                jnp.zeros((cols,), jnp.float32))
+
+    cands = {
+        "pallas": lambda x, s, b: fused_layer_norm(x, s, b, eps)[0],
+        "xla": lambda x, s, b: _ln_xla_ref(x, s, b, eps),
+    }
+    return key, cands, make_args
+
+
+def ln_wins(rows, cols, dtype, eps=1e-5) -> bool:
+    if not on_tpu():
+        return True
+    from . import autobench
+    key, cands, make_args = _gate_ln(rows, cols, dtype, eps)
+    return autobench.prefer(key, cands, make_args,
+                            default="pallas") == "pallas"
+
+
+def _warm_ln(spec: dict) -> str:
+    from . import autobench
+    key, cands, make_args = _gate_ln(
+        int(spec["rows"]), int(spec["cols"]),
+        spec.get("dtype", "bfloat16"))
+    return autobench.prefer(key, cands, make_args, default="pallas")
+
+
+def _register_warmer():
+    from . import autobench
+    autobench.register_warmer("fused_layer_norm", _warm_ln)
+
+
+_register_warmer()

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.gpt import (GPTConfig, _causal_attention, _ln,
-                          init_gpt_params)
+                          decoder_tail, init_gpt_params)
 from ..ops.paged_attention import paged_attention_decode
 
 __all__ = ["GPTDecodeModel"]
@@ -155,10 +155,10 @@ class GPTDecodeModel:
         v = h @ p["wv"] + p["bv"]
         return q, k, v
 
-    def _ffn(self, p, x, eps):
-        h = _ln(x, p["ln2_s"], p["ln2_b"], eps)
-        u = jax.nn.gelu(h @ p["w_up"] + p["b_up"], approximate=True)
-        return x + u @ p["w_down"] + p["b_down"]
+    # (the post-attention tail — out-projection + residual + LN2 + FFN —
+    # is models.gpt.decoder_tail: one source of truth with training, and
+    # the serving decode path reuses the same autobench-gated fused
+    # Pallas sub-blocks where they win)
 
     # -- prefill -------------------------------------------------------
     def prefill(self, params, cache, tokens, true_len, page_row):
@@ -186,8 +186,7 @@ class GPTDecodeModel:
             # by construction, not by a hand-mirrored copy
             a = _causal_attention(q[None], k[None], v[None], H,
                                   impl="xla")[0]
-            x = x + (a @ p["wo"] + p["bo"])
-            x = self._ffn(p, x, cfg.layer_norm_eps)
+            x = decoder_tail(p, a, x, cfg)
             return (x, ck, cv), None
 
         L = cfg.num_layers
@@ -230,8 +229,7 @@ class GPTDecodeModel:
             a = paged_attention_decode(
                 q.reshape(S, H, d), ck[l], cv[l], tables, ctx,
                 scale=1.0 / math.sqrt(d), impl=self.attn_impl)
-            x = x + (a.reshape(S, -1) @ p["wo"] + p["bo"])
-            x = self._ffn(p, x, cfg.layer_norm_eps)
+            x = decoder_tail(p, a.reshape(S, -1), x, cfg)
             return (x, ck, cv), None
 
         L = cfg.num_layers

@@ -81,6 +81,14 @@ REQUIRED_METRICS = {
     "paddle_tpu_serving_expired_in_queue_total",
     "paddle_tpu_serving_shed_total",
     "paddle_tpu_serving_quota_rejected_total",
+    # autobench persistent tuning cache (docs/KERNELS.md): whether a
+    # replica is measuring in-process (cold) or adopting pre-warmed
+    # decisions (hit) is the cache's acceptance contract
+    "paddle_tpu_autobench_cache_hits_total",
+    "paddle_tpu_autobench_cache_misses_total",
+    "paddle_tpu_autobench_cache_stale_total",
+    "paddle_tpu_autobench_cache_corrupt_total",
+    "paddle_tpu_autobench_measure_total",
 }
 
 

@@ -1,0 +1,205 @@
+"""Persistent fusion-aware autobench tuning cache (PR 7 tentpole):
+round-trip across processes (second process hits disk with ZERO
+measuring calls), CRC/version/corruption degradation, concurrent
+publishers, the FORCE typo guard, and the list/warm/invalidate CLI."""
+import json
+import os
+import subprocess
+import sys
+import zlib
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import autobench
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def cache_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "autobench.json")
+    monkeypatch.setenv("PADDLE_TPU_AUTOBENCH_CACHE", path)
+    autobench.clear()
+    yield path
+    autobench.clear()
+
+
+def _cands():
+    # "a" (slower: extra work) vs "b"; the winner itself is irrelevant —
+    # the tests assert cache behavior, not timing
+    return {"a": lambda x: (x @ x) + 1.0, "b": lambda x: x + 1.0}
+
+
+def _mk():
+    return (jnp.ones((16, 16), jnp.float32),)
+
+
+def _recrc(rec):
+    body = {k: v for k, v in rec.items() if k != "crc"}
+    return zlib.crc32(json.dumps(
+        body, sort_keys=True, separators=(",", ":")).encode()) & 0xFFFFFFFF
+
+
+def test_decision_published_and_readopted_without_measuring(cache_file):
+    w = autobench.prefer(("cache", 1), _cands(), _mk, reps=1)
+    s = autobench.stats()
+    assert s["measures"] == 1 and s["publishes"] == 1
+    doc = json.load(open(cache_file))
+    assert doc["format"].startswith("paddle-tpu-autobench")
+    (rec,) = doc["records"]
+    assert rec["winner"] == w and rec["crc"] == _recrc(rec)
+    assert rec["kernels"] == autobench.KERNEL_VERSION
+    # simulated fresh process: in-memory state dropped, disk survives
+    autobench.clear()
+    assert autobench.prefer(("cache", 1), _cands(), _mk, reps=1) == w
+    s = autobench.stats()
+    assert s["measures"] == 0 and s["cache_hits"] == 1
+
+
+def test_second_process_hits_disk_zero_measures(cache_file):
+    """The fleet pre-warm contract: a real second PROCESS adopts the
+    published decision with zero in-process measuring calls."""
+    w = autobench.prefer(("proc", 2, "f32"), _cands(), _mk, reps=1)
+    code = (
+        "import json, jax.numpy as jnp\n"
+        "from paddle_tpu.ops import autobench\n"
+        "cands = {'a': lambda x: (x @ x) + 1.0, 'b': lambda x: x + 1.0}\n"
+        "w = autobench.prefer(('proc', 2, 'f32'), cands,\n"
+        "                     lambda: (jnp.ones((16, 16), jnp.float32),),\n"
+        "                     reps=1)\n"
+        "print(json.dumps({'winner': w, **autobench.stats()}))\n")
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    got = json.loads(r.stdout.strip().splitlines()[-1])
+    assert got["winner"] == w
+    assert got["measures"] == 0
+    assert got["cache_hits"] == 1
+
+
+def test_stale_version_record_is_remeasured(cache_file):
+    autobench.prefer(("stale", 3), _cands(), _mk, reps=1)
+    doc = json.load(open(cache_file))
+    doc["records"][0]["kernels"] = autobench.KERNEL_VERSION + 1
+    doc["records"][0]["crc"] = _recrc(doc["records"][0])
+    json.dump(doc, open(cache_file, "w"))
+    autobench.clear()
+    autobench.prefer(("stale", 3), _cands(), _mk, reps=1)
+    s = autobench.stats()
+    assert s["cache_stale"] == 1 and s["measures"] == 1
+    # the remeasured decision was republished with the CURRENT version
+    (rec,) = json.load(open(cache_file))["records"]
+    assert rec["kernels"] == autobench.KERNEL_VERSION
+
+
+def test_corrupt_record_crc_skipped(cache_file):
+    autobench.prefer(("crc", 4), _cands(), _mk, reps=1)
+    doc = json.load(open(cache_file))
+    doc["records"][0]["winner"] = "tampered"  # crc now wrong
+    json.dump(doc, open(cache_file, "w"))
+    autobench.clear()
+    w = autobench.prefer(("crc", 4), _cands(), _mk, reps=1)
+    s = autobench.stats()
+    assert w in ("a", "b")
+    assert s["cache_corrupt"] >= 1 and s["measures"] == 1
+
+
+def test_corrupt_file_degrades_to_measuring(cache_file):
+    with open(cache_file, "w") as f:
+        f.write("{definitely not json")
+    w = autobench.prefer(("corrupt", 5), _cands(), _mk, reps=1)
+    s = autobench.stats()
+    assert w in ("a", "b")
+    assert s["cache_corrupt"] >= 1 and s["measures"] == 1
+    # the next publish overwrote the corrupt file with a valid one
+    doc = json.load(open(cache_file))
+    assert len(doc["records"]) == 1
+
+
+def test_concurrent_publishers_keep_disjoint_keys(cache_file):
+    """read-merge-write: two decisions published from different
+    in-memory states (simulating two processes) both survive."""
+    autobench.prefer(("conc", "k1"), _cands(), _mk, reps=1)
+    autobench.clear()  # second "process"
+    autobench.prefer(("conc", "k2"), _cands(), _mk, reps=1)
+    keys = {r["key"] for r in json.load(open(cache_file))["records"]}
+    assert keys == {str(("conc", "k1")), str(("conc", "k2"))}
+
+
+def test_no_cache_env_keeps_in_process_behavior(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_AUTOBENCH_CACHE", raising=False)
+    autobench.clear()
+    autobench.prefer(("nofile", 6), _cands(), _mk, reps=1)
+    s = autobench.stats()
+    assert s["publishes"] == 0 and s["cache_misses"] == 0
+    autobench.clear()
+
+
+def test_force_unknown_candidate_warns(cache_file, monkeypatch, caplog):
+    """PR-7 satellite: a FORCE name no gate offers used to be silently
+    ignored — it now warns through the paddle_tpu.autobench logger
+    (PR-6 fault-knob typo-guard idiom) and benchmarks normally."""
+    import logging
+    monkeypatch.setenv("PADDLE_TPU_AUTOBENCH_FORCE", "palas")  # typo
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.autobench"):
+        w = autobench.prefer(("force", 7), _cands(), _mk, reps=1)
+    assert w in ("a", "b")
+    assert any("PADDLE_TPU_AUTOBENCH_FORCE" in r.message
+               and "palas" in r.message for r in caplog.records)
+    # a KNOWN name is still honored without measuring
+    autobench.clear()
+    monkeypatch.setenv("PADDLE_TPU_AUTOBENCH_FORCE", "a")
+    assert autobench.prefer(("force", 8), _cands(), _mk, reps=1) == "a"
+    assert autobench.stats()["measures"] == 0
+
+
+def test_cli_list_warm_invalidate(cache_file, tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PADDLE_TPU_PALLAS_INTERPRET": "1"}
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.ops.autobench", *args],
+            capture_output=True, text=True, cwd=REPO, env=env)
+
+    # warm through a spec file (tiny shapes; interpret-mode Pallas so
+    # the kernel candidates run off-TPU — the point is the plumbing,
+    # not the timings)
+    specs = [{"kernel": "fused_layer_norm", "rows": 16, "cols": 128,
+              "dtype": "float32"}]
+    spec_file = tmp_path / "specs.json"
+    spec_file.write_text(json.dumps(specs))
+    r = cli("warm", "--path", cache_file, "--specs", str(spec_file))
+    assert r.returncode == 0, r.stderr
+    assert "warmed 1 specs" in r.stdout
+    recs = json.load(open(cache_file))["records"]
+    assert any("fused_layer_norm" in rec["key"] for rec in recs)
+    # list shows it
+    r = cli("list", "--path", cache_file)
+    assert r.returncode == 0 and "fused_layer_norm" in r.stdout
+    r = cli("list", "--path", cache_file, "--json")
+    assert r.returncode == 0 and json.loads(r.stdout)
+    # invalidate by match, then all
+    r = cli("invalidate", "--path", cache_file, "--match", "layer_norm")
+    assert r.returncode == 0 and "removed 1" in r.stdout
+    r = cli("invalidate", "--path", cache_file, "--all")
+    assert r.returncode == 0
+
+
+def test_unwritable_cache_path_never_blocks_the_gate(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_AUTOBENCH_CACHE",
+                       "/proc/definitely/not/writable/ab.json")
+    autobench.clear()
+    w = autobench.prefer(("rofs", 9), _cands(), _mk, reps=1)
+    assert w in ("a", "b")
+    autobench.clear()
+
+
+def test_warm_presets_are_registered():
+    autobench._import_warmer_modules()
+    for name, specs in autobench.PRESETS.items():
+        for spec in specs:
+            assert spec["kernel"] in autobench._WARMERS, \
+                (name, spec["kernel"])
