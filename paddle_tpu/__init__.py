@@ -17,6 +17,14 @@ __version__ = "0.1.0"
 
 import os as _os
 
+if _os.environ.get("PADDLE_TPU_LOCKCHECK", "") not in ("", "0"):
+    # test-mode runtime lock-order sanitizer (docs/STATIC_ANALYSIS.md):
+    # must install BEFORE any framework module creates its locks, so
+    # every paddle_tpu lock is an order-checked proxy. analysis.* is
+    # stdlib-only, so this costs nothing on the normal import path.
+    from .analysis import lockcheck as _lockcheck
+    _lockcheck.install()
+
 import jax as _jax
 
 if _os.environ.get("PADDLE_TPU_PRNG", "rbg") == "rbg":

@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — unified static-analysis engine + runtime
+lock-order sanitizer.
+
+The codebase is a heavily threaded system (engine step lock, scheduler
+lock, PS apply lock, checkpoint writer, autobench publish lock)
+stitched to a jit-compiled hot path. This package is its correctness
+tooling, replacing the three ad-hoc AST scripts with ONE engine
+(reference analog: PADDLE_ENFORCE-style invariant tooling at every
+tier, PAPER.md L0):
+
+  * ``core``       — one AST parse per file, a rule registry, findings
+                     as file:line JSON + human text, and a per-rule
+                     shrink-only baseline/ratchet file;
+  * ``rules``      — three rule families: concurrency (lock-order
+                     graph, blocking calls under hot locks, opaque
+                     callbacks under locks), jit-hazards (host syncs
+                     and recompile bombs inside jit-reachable code),
+                     and the invariants migrated from the legacy
+                     scripts (wire-pickle, metric-name, env-knob);
+  * ``lockcheck``  — a test-mode runtime sanitizer that wraps
+                     ``threading.Lock/RLock/Condition`` under
+                     ``PADDLE_TPU_LOCKCHECK=1``, records the per-thread
+                     acquisition graph, and fails on lock-order cycles
+                     — the dynamic complement validating the static
+                     lock model.
+
+CLI: ``python -m paddle_tpu.analysis [--rule NAME ...] [--root DIR]
+[--baseline update] [--json]`` (docs/STATIC_ANALYSIS.md).
+
+This package (and everything it imports) is stdlib-only on purpose:
+the legacy ``scripts/check_*.py`` wrappers and the ``PADDLE_TPU_
+LOCKCHECK`` install hook load it WITHOUT importing the jax-heavy
+``paddle_tpu`` parent, and the lockcheck install in
+``paddle_tpu/__init__`` must run before any framework lock exists.
+"""
+# NOTE: keep this module import-light (no submodule imports at package
+# import time) — see the docstring. `from paddle_tpu.analysis import
+# core` / `... import lockcheck` are the entry points.

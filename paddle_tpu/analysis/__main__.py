@@ -1,0 +1,74 @@
+"""CLI: ``python -m paddle_tpu.analysis``.
+
+Runs every registered rule (or a ``--rule`` subset) over paddle_tpu/
+(or ``--root``) in one AST pass per file, applies the shrink-only
+baseline, and prints findings as human text (default) or JSON
+(``--json``). Exit 0 = zero unbaselined findings and a tight baseline;
+1 = findings / stale or unjustified baseline entries; 2 = usage.
+
+``--baseline update`` deletes stale baseline entries (entries whose
+finding no longer exists) — the ONLY automatic mutation; adding an
+entry is always a hand edit with a one-line "why".
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="unified static analysis "
+                    "(docs/STATIC_ANALYSIS.md)")
+    p.add_argument("--root", default=None,
+                   help="directory (or file) to scan "
+                        "[default: <repo>/paddle_tpu]")
+    p.add_argument("--rule", action="append", default=None,
+                   metavar="NAME",
+                   help="run only this rule (repeatable)")
+    p.add_argument("--baseline", choices=("check", "update"),
+                   default="check",
+                   help="'update' deletes stale baseline entries "
+                        "(shrink-only ratchet)")
+    p.add_argument("--baseline-file", default=None,
+                   help="alternate baseline path (tests)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report raw findings, no baseline matching")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print baselined findings")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name, cls in sorted(core.all_rules().items()):
+            print(f"{name:22s} {cls.description}")
+        return 0
+
+    try:
+        run_ = core.run(args.root, args.rule)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if args.no_baseline:
+        run_.new = list(run_.findings)
+    else:
+        core.apply_baseline(run_, update=args.baseline == "update",
+                            path=args.baseline_file)
+        if args.baseline == "update":
+            # the update already pruned the file; report post-update
+            run_.stale = []
+    print(core.render_json(run_) if args.json
+          else core.render_text(run_, verbose=args.verbose))
+    return 1 if run_.failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

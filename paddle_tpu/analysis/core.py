@@ -1,0 +1,371 @@
+"""Static-analysis engine: one AST parse per file, a rule registry,
+findings, and a shrink-only baseline (ratchet).
+
+Execution model
+---------------
+``run(root)`` walks every ``*.py`` under the root, parses each file
+exactly ONCE, and hands the same tree to every selected rule
+(``Rule.visit``). Rules that need the whole tree (duplicate metric
+registrations, env-knob docs coverage, the cross-class lock graph)
+accumulate state per file and emit their findings from
+``Rule.finalize``. The engine never re-parses.
+
+Findings and the baseline
+-------------------------
+A ``Finding`` carries ``rule``, ``path:line``, a human message, and a
+stable ``key`` — the fingerprint used for baseline matching. Keys
+deliberately exclude line numbers (lines drift on every edit); two
+identical findings in one scope get ``#2``/``#3`` suffixes so the
+ratchet can count occurrences.
+
+``baseline.json`` (beside this module) maps rule name -> list of
+``{"key": ..., "why": ...}`` entries. Matching findings are
+suppressed; the "why" is mandatory — a baseline entry without a
+justification is itself a violation. The ratchet is SHRINK-ONLY:
+
+  * a finding not in the baseline fails the run (fix it, or hand-add a
+    justified entry);
+  * a baseline entry with no matching finding ("stale") also fails the
+    run — ``--baseline update`` deletes stale entries and nothing
+    else. The baseline can therefore only ever shrink automatically;
+    growth requires a human writing a justification in the diff.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+__all__ = ["Finding", "FileContext", "Rule", "KeyCounter",
+           "dotted_name", "register", "all_rules", "AnalysisRun",
+           "run", "repo_root", "default_code_root", "baseline_path",
+           "load_baseline", "render_text", "render_json"]
+
+
+def dotted_name(node) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None — the shared
+    callee/receiver resolver for every rule family."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class KeyCounter:
+    """Suffix DUPLICATE keys with #2, #3... — keys stay content-based
+    (stable under unrelated edits and under fixing a sibling finding);
+    only true repeats of the same content get a positional suffix.
+    One instance per (rule, emission pass)."""
+
+    def __init__(self):
+        self._seen: dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        n = self._seen.get(key, 0) + 1
+        self._seen[key] = n
+        return key if n == 1 else f"{key}#{n}"
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_code_root() -> str:
+    return os.path.join(repo_root(), "paddle_tpu")
+
+
+def baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str            # as scanned (absolute or caller-relative)
+    line: int
+    message: str
+    key: str             # stable fingerprint (no line numbers)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.path,
+                "line": self.line, "message": self.message,
+                "key": self.key}
+
+
+@dataclass
+class FileContext:
+    """One parsed file, shared by every rule (one AST pass)."""
+    path: str            # path as scanned
+    relpath: str         # relative to the scan root, '/'-separated
+    tree: ast.AST
+    source: str
+    default_tree: bool   # scanning the whole shipped paddle_tpu/ tree?
+    # '/'-separated path relative to the shipped paddle_tpu/ tree when
+    # this file lives inside it (regardless of the scan root), else
+    # None — subtree-scoped rules (wire-pickle, metric SKIP_FILES)
+    # gate on THIS, so `--root paddle_tpu/fluid` judges files the same
+    # way the full-tree run does
+    tree_rel: str | None = None
+
+
+class Rule:
+    """Base class. Subclasses set ``name``/``description``, implement
+    ``visit`` (per file) and optionally ``finalize`` (after all
+    files). Both may return an iterable of Finding."""
+
+    name: str = ""
+    description: str = ""
+
+    def visit(self, ctx: FileContext):
+        return ()
+
+    def finalize(self, run: "AnalysisRun"):
+        return ()
+
+    # -- helpers --------------------------------------------------------
+    def finding(self, ctx_or_path, line: int, message: str,
+                key: str) -> Finding:
+        path = ctx_or_path.path if isinstance(ctx_or_path, FileContext) \
+            else str(ctx_or_path)
+        return Finding(self.name, path, int(line), message,
+                       f"{self.name}::{key}")
+
+
+_REGISTRY: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.name:
+        raise ValueError(f"{cls.__name__} needs a non-empty .name")
+    if cls.name in _REGISTRY and _REGISTRY[cls.name] is not cls:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_rules() -> dict[str, type[Rule]]:
+    """The registry, loading the built-in rule modules on first use."""
+    from . import rules  # noqa: F401  (registration side effect)
+    return dict(_REGISTRY)
+
+
+@dataclass
+class AnalysisRun:
+    """Everything one engine invocation produced."""
+    root: str
+    rules_run: list = field(default_factory=list)   # rule names
+    default_scan: bool = False   # whole shipped tree was scanned?
+    files: list[FileContext] = field(default_factory=list)
+    findings: list[Finding] = field(default_factory=list)
+    parse_errors: list[Finding] = field(default_factory=list)
+    # populated by apply_baseline():
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    stale: list[tuple[str, str]] = field(default_factory=list)
+    unjustified: list[tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def failures(self) -> int:
+        return (len(self.new) + len(self.stale)
+                + len(self.unjustified) + len(self.parse_errors))
+
+
+def _iter_py_files(root: str):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run(root: str | None = None,
+        rule_names: list[str] | None = None) -> AnalysisRun:
+    """Parse every file under ``root`` once and run the selected rules
+    (default: all) over the shared trees."""
+    registry = all_rules()
+    if rule_names:
+        unknown = sorted(set(rule_names) - set(registry))
+        if unknown:
+            raise KeyError(
+                f"unknown rule(s) {unknown}; have {sorted(registry)}")
+        selected = [registry[n]() for n in rule_names]
+    else:
+        selected = [cls() for _n, cls in sorted(registry.items())]
+    root = os.path.abspath(root if root is not None
+                           else default_code_root())
+    if not os.path.exists(root):
+        # a typo'd --root must FAIL, not report a green 0-file scan —
+        # silently disabling every rule is the exact failure mode this
+        # tooling exists to prevent
+        raise FileNotFoundError(f"scan root does not exist: {root}")
+    code_root = os.path.abspath(default_code_root())
+    default_tree = root == code_root
+    out = AnalysisRun(root=root,
+                      rules_run=[r.name for r in selected],
+                      default_scan=default_tree)
+    for path in _iter_py_files(root):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        rel = os.path.relpath(path, root if os.path.isdir(root)
+                              else os.path.dirname(root))
+        rel = rel.replace(os.sep, "/")
+        tree_rel = None
+        apath = os.path.abspath(path)
+        if apath.startswith(code_root + os.sep):
+            tree_rel = os.path.relpath(apath, code_root) \
+                .replace(os.sep, "/")
+        try:
+            tree = ast.parse(src, path)
+        except SyntaxError as e:
+            out.parse_errors.append(Finding(
+                "parse", path, e.lineno or 0,
+                f"unparseable: {e.msg}", f"parse::{rel}"))
+            continue
+        ctx = FileContext(path, rel, tree, src, default_tree,
+                          tree_rel=tree_rel)
+        out.files.append(ctx)
+        for rule in selected:
+            out.findings.extend(rule.visit(ctx) or ())
+    for rule in selected:
+        out.findings.extend(rule.finalize(out) or ())
+    out.findings.sort(key=lambda f: (f.rule, f.path, f.line))
+    return out
+
+
+# -- baseline / ratchet ------------------------------------------------
+
+def load_baseline(path: str | None = None) -> dict[str, list[dict]]:
+    path = path or baseline_path()
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return doc.get("rules", {})
+
+
+def save_baseline(rules: dict[str, list[dict]],
+                  path: str | None = None) -> str:
+    path = path or baseline_path()
+    doc = {"_comment": [
+        "Shrink-only ratchet for python -m paddle_tpu.analysis "
+        "(docs/STATIC_ANALYSIS.md).",
+        "Every entry needs a one-line 'why'. `--baseline update` only "
+        "DELETES stale entries;",
+        "new findings must be fixed or hand-added here with a "
+        "justification."],
+        "rules": {r: sorted(v, key=lambda e: e["key"])
+                  for r, v in sorted(rules.items()) if v}}
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def apply_baseline(run_: AnalysisRun,
+                   baseline: dict[str, list[dict]] | None = None,
+                   update: bool = False,
+                   path: str | None = None) -> AnalysisRun:
+    """Split findings into new vs baselined, detect stale/unjustified
+    entries; with ``update=True`` rewrite the file with stale entries
+    removed (the only automatic mutation — shrink-only).
+
+    Scoped to what this run could actually have observed: baseline
+    entries for rules that did NOT run are left untouched (never
+    stale, never pruned — ``--rule wire-pickle`` must not judge the
+    lock entries), and staleness is only decided at all on a
+    full-default-tree scan (a ``--root`` subtree cannot prove a
+    finding elsewhere is gone). Matching findings are suppressed
+    either way."""
+    if baseline is None:
+        baseline = load_baseline(path)
+    # occurrence-count the finding keys so N identical sites need N
+    # baseline entries (keys get #2.. suffixes at emit time already)
+    finding_keys = {f.key for f in run_.findings}
+    relevant = set(run_.rules_run)
+    matched: set[str] = set()
+    for rule_name, entries in baseline.items():
+        if rule_name not in relevant:
+            continue
+        for e in entries:
+            key = e.get("key", "")
+            if not str(e.get("why", "")).strip():
+                run_.unjustified.append((rule_name, key))
+            if key in finding_keys:
+                matched.add(key)
+            elif run_.default_scan:
+                run_.stale.append((rule_name, key))
+    for f in run_.findings:
+        (run_.baselined if f.key in matched else run_.new).append(f)
+    if update and run_.stale:
+        stale_keys = {k for _r, k in run_.stale}
+        pruned = {r: [e for e in v if e.get("key") not in stale_keys]
+                  for r, v in baseline.items()}
+        save_baseline(pruned, path)
+    return run_
+
+
+# -- rendering ---------------------------------------------------------
+
+def render_text(run_: AnalysisRun, verbose: bool = False) -> str:
+    lines: list[str] = []
+    for f in run_.parse_errors:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}")
+    for f in run_.new:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}")
+    for rule_name, key in run_.stale:
+        lines.append(
+            f"baseline: [{rule_name}] stale entry {key!r} — the "
+            "finding is gone; run `python -m paddle_tpu.analysis "
+            "--baseline update` to ratchet the baseline down")
+    for rule_name, key in run_.unjustified:
+        lines.append(
+            f"baseline: [{rule_name}] entry {key!r} has no 'why' — "
+            "every baselined finding needs a one-line justification")
+    if verbose:
+        for f in run_.baselined:
+            lines.append(f"{f.location()}: [{f.rule}] (baselined) "
+                         f"{f.message}")
+    n_files = len(run_.files)
+    if run_.failures:
+        lines.append(
+            f"FAIL: {len(run_.new)} unbaselined finding(s), "
+            f"{len(run_.stale)} stale baseline entr(ies), "
+            f"{len(run_.unjustified)} unjustified, "
+            f"{len(run_.parse_errors)} parse error(s) over "
+            f"{n_files} file(s) under {run_.root}")
+    else:
+        lines.append(
+            f"OK: {n_files} file(s) under {run_.root} — "
+            f"{len(run_.baselined)} baselined finding(s), 0 new")
+    return "\n".join(lines)
+
+
+def render_json(run_: AnalysisRun) -> str:
+    return json.dumps({
+        "root": run_.root,
+        "files": len(run_.files),
+        "ok": run_.failures == 0,
+        "new": [f.to_dict() for f in run_.new],
+        "baselined": [f.to_dict() for f in run_.baselined],
+        "stale_baseline": [{"rule": r, "key": k}
+                           for r, k in run_.stale],
+        "unjustified_baseline": [{"rule": r, "key": k}
+                                 for r, k in run_.unjustified],
+        "parse_errors": [f.to_dict() for f in run_.parse_errors],
+    }, indent=1)

@@ -1,0 +1,346 @@
+"""Runtime lock-order sanitizer (test mode).
+
+Under ``PADDLE_TPU_LOCKCHECK=1`` (installed by ``paddle_tpu/__init__``
+before any framework lock exists), ``threading.Lock``/``RLock``/
+``Condition`` created from instrumented modules return checking
+proxies. Every acquisition records per-thread held-lock state and adds
+``held-site -> acquired-site`` edges to a process-global acquisition
+graph; an acquisition that would close a CYCLE in that graph — the
+static ``lock-order`` rule's exact failure shape, observed live —
+raises ``LockOrderError`` before blocking (or warns once per pair with
+``PADDLE_TPU_LOCKCHECK=warn``).
+
+Lock identity is the CREATION SITE (``file:line``), so every
+``Engine`` instance's step lock is one node — the same aggregation the
+static model uses (``ClassName._lock``), which keeps the two reports
+alignable and makes cross-instance inversions of the same two classes
+detectable from a single run. Same-site edges are skipped (an RLock
+re-entry, or hand-over-hand between two instances of one class, is
+not an inversion the site graph can judge).
+
+Scope: only locks created from modules whose ``__name__`` starts with
+an instrumented prefix (default ``paddle_tpu``; extend via
+``PADDLE_TPU_LOCKCHECK_SCOPE=pfx1,pfx2``) are wrapped — stdlib/jax
+internals keep raw primitives, bounding both overhead and proxy-
+compatibility risk. The dynamic graph covers what the static rule
+cannot see (callbacks, locks passed across objects); the static rule
+covers paths no test executes. They meet in tier-1: the instrumented
+test_slo_harness run must hold zero cycles.
+"""
+from __future__ import annotations
+
+import _thread
+import os
+import sys
+import threading
+
+__all__ = ["LockOrderError", "install", "uninstall", "installed",
+           "reset", "graph", "violations", "report",
+           "checked_lock", "checked_rlock", "checked_condition"]
+
+_real_Lock = threading.Lock
+_real_RLock = threading.RLock
+_real_Condition = threading.Condition
+
+_DEFAULT_SCOPE = ("paddle_tpu",)
+
+# process-global state, guarded by a RAW lock (never a proxy)
+_state_lock = _thread.allocate_lock()
+_edges: dict[str, set[str]] = {}          # site -> sites acquired under
+_edge_witness: dict[tuple, str] = {}      # (a, b) -> description
+_violations: list[dict] = []
+_warned_pairs: set[tuple] = set()
+_tls = threading.local()
+_installed = False
+_mode = "raise"
+_scope: tuple = _DEFAULT_SCOPE
+
+
+class LockOrderError(RuntimeError):
+    """An acquisition closed a cycle in the lock-acquisition graph."""
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _site_of_caller() -> str:
+    """file:line of the first frame outside this module and the
+    threading machinery."""
+    f = sys._getframe(2)
+    while f is not None:
+        g = f.f_globals.get("__name__", "")
+        if g not in (__name__, "threading"):
+            fn = f.f_code.co_filename
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _caller_in_scope() -> bool:
+    f = sys._getframe(2)
+    while f is not None:
+        g = f.f_globals.get("__name__", "")
+        if g not in (__name__, "threading"):
+            return g.startswith(_scope)
+        f = f.f_back
+    return False
+
+
+def _find_path(graph_: dict, src: str, dst: str) -> list[str] | None:
+    """DFS path src -> dst in the edge graph (None if unreachable)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in sorted(graph_.get(node, ())):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquired(site: str, inst_id: int):
+    """Record edges held -> site; detect would-be cycles. Runs BEFORE
+    the real acquire so a detected inversion raises without blocking."""
+    held = _held()
+    held_sites = [s for s, _i, _n in held]
+    with _state_lock:
+        for h in held_sites:
+            if h == site:
+                continue
+            # adding h -> site: a path site ->* h means a cycle
+            path = _find_path(_edges, site, h)
+            if path is not None:
+                cycle = [h] + path
+                v = {"cycle": cycle,
+                     "thread": threading.current_thread().name,
+                     "acquiring": site, "holding": held_sites,
+                     # string keys: report() promises JSON-safe
+                     "witness": {f"{a} -> {b}":
+                                 _edge_witness.get((a, b), "")
+                                 for a, b in zip(path, path[1:])}}
+                pair_key = (h, site)
+                _violations.append(v)
+                if _mode == "raise":
+                    raise LockOrderError(
+                        "lock-order cycle: acquiring "
+                        f"{site} while holding {h}, but the "
+                        "acquisition graph already orders "
+                        + " -> ".join(path)
+                        + f" (thread {v['thread']}; see "
+                        "docs/STATIC_ANALYSIS.md lockcheck)")
+                if pair_key not in _warned_pairs:
+                    _warned_pairs.add(pair_key)
+                    print(f"PADDLE_TPU_LOCKCHECK: lock-order cycle "
+                          f"{' -> '.join(cycle)} "
+                          f"(thread {v['thread']})",
+                          file=sys.stderr)
+            _edges.setdefault(h, set()).add(site)
+            _edge_witness.setdefault(
+                (h, site),
+                f"thread {threading.current_thread().name}")
+
+
+class _CheckedLock:
+    """Order-checking proxy over a real Lock/RLock. Tracks per-thread
+    hold counts (RLock re-entry must not re-record), and exposes the
+    RLock internals Condition needs (_release_save/_acquire_restore/
+    _is_owned) with held-state maintenance."""
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+
+    # -- held bookkeeping ------------------------------------------------
+    def _entry(self):
+        for e in _held():
+            if e[1] == id(self):
+                return e
+        return None
+
+    def _push(self):
+        e = self._entry()
+        if e is None:
+            _held().append([self._site, id(self), 1])
+        else:
+            e[2] += 1
+
+    def _pop(self, fully: bool = False):
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == id(self):
+                if fully:
+                    held[i][2] = 0
+                else:
+                    held[i][2] -= 1
+                if held[i][2] <= 0:
+                    del held[i]
+                return
+
+    # -- lock protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # cycle detection (and edge recording) only for UNBOUNDED
+        # blocking acquires: trylock / timed acquires are the classic
+        # deadlock-AVOIDANCE patterns — they cannot deadlock, and
+        # recording their intentional inversions would poison the
+        # graph with false cycles for later blocking acquirers
+        first = self._entry() is None
+        if first and blocking and timeout == -1:
+            _note_acquired(self._site, id(self))
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._push()
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._pop()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockcheck {self._inner!r} @ {self._site}>"
+
+
+class _CheckedRLock(_CheckedLock):
+    # Condition(lock=RLock) integration: these fully release /
+    # re-acquire regardless of recursion depth
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._pop(fully=True)
+        return state
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        # restore the SAVED recursion depth (state is the RLock's
+        # (count, owner)): pushing depth 1 regardless would desync the
+        # held-entry after a Condition.wait at depth >= 2 — the first
+        # release would drop the entry while the lock is still owned,
+        # hiding every subsequent held->acquired edge
+        count = state[0] if isinstance(state, tuple) \
+            and isinstance(state[0], int) else 1
+        held = _held()
+        for e in held:
+            if e[1] == id(self):
+                e[2] += count
+                return
+        held.append([self._site, id(self), count])
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+def checked_lock(site: str | None = None) -> _CheckedLock:
+    return _CheckedLock(_real_Lock(), site or _site_of_caller())
+
+
+def checked_rlock(site: str | None = None) -> _CheckedRLock:
+    return _CheckedRLock(_real_RLock(), site or _site_of_caller())
+
+
+def checked_condition(lock=None, site: str | None = None):
+    if lock is None:
+        lock = checked_rlock(site or _site_of_caller())
+    return _real_Condition(lock)
+
+
+# -- factory patches ---------------------------------------------------
+
+def _lock_factory():
+    if _caller_in_scope():
+        return _CheckedLock(_real_Lock(), _site_of_caller())
+    return _real_Lock()
+
+
+def _rlock_factory():
+    if _caller_in_scope():
+        return _CheckedRLock(_real_RLock(), _site_of_caller())
+    return _real_RLock()
+
+
+def _condition_factory(lock=None):
+    if lock is None and _caller_in_scope():
+        lock = _CheckedRLock(_real_RLock(), _site_of_caller())
+    return _real_Condition(lock)
+
+
+def install(mode: str | None = None, scope=None):
+    """Patch threading.Lock/RLock/Condition. Idempotent. ``mode``:
+    'raise' (default) or 'warn'; default from PADDLE_TPU_LOCKCHECK
+    ('warn' selects warn, any other truthy value raises)."""
+    global _installed, _mode, _scope
+    if mode is None:
+        mode = "warn" if os.environ.get(
+            "PADDLE_TPU_LOCKCHECK", "") == "warn" else "raise"
+    _mode = mode
+    if scope is None:
+        extra = os.environ.get("PADDLE_TPU_LOCKCHECK_SCOPE", "")
+        scope = _DEFAULT_SCOPE + tuple(
+            s.strip() for s in extra.split(",") if s.strip())
+    _scope = tuple(scope)
+    if _installed:
+        return
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _condition_factory
+    _installed = True
+
+
+def uninstall():
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _real_Lock
+    threading.RLock = _real_RLock
+    threading.Condition = _real_Condition
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset():
+    """Clear the recorded graph/violations (between tests)."""
+    with _state_lock:
+        _edges.clear()
+        _edge_witness.clear()
+        _violations.clear()
+        _warned_pairs.clear()
+
+
+def graph() -> dict[str, list[str]]:
+    with _state_lock:
+        return {k: sorted(v) for k, v in sorted(_edges.items())}
+
+
+def violations() -> list[dict]:
+    with _state_lock:
+        return [dict(v) for v in _violations]
+
+
+def report() -> dict:
+    """JSON-safe summary (tests and postmortem tooling)."""
+    with _state_lock:
+        return {"installed": _installed, "mode": _mode,
+                "sites": sorted(set(_edges)
+                                | {s for v in _edges.values()
+                                   for s in v}),
+                "edges": {k: sorted(v)
+                          for k, v in sorted(_edges.items())},
+                "violations": [dict(v) for v in _violations]}

@@ -1,0 +1,658 @@
+"""Concurrency rules: the static lock model.
+
+Built from ``with self._lock:``-style blocks across the whole scanned
+tree in one pass:
+
+  * ``lock-order``    — inconsistent lock-ordering pairs (deadlock
+                        potential): lock A is taken while holding B in
+                        one place and B while holding A in another;
+  * ``lock-blocking-call`` — a call that can block (sleep, socket/file
+                        I/O, ``.result()``, subprocess, checkpoint
+                        restore, registry exposition) executed while a
+                        lock is held, directly or through a resolvable
+                        call chain;
+  * ``lock-callback``  — an OPAQUE stored callback (``self._fn(...)``
+                        where ``_fn`` was assigned from a parameter)
+                        invoked under a lock: its lock-order effects
+                        are unknowable statically, so it can close a
+                        cycle no reviewer can see (the registry gauge
+                        ``set_function`` bug was exactly this shape).
+
+Model
+-----
+Locks are identified by OWNER and attribute: ``ClassName._lock`` for
+``self._lock = threading.Lock()`` and ``module.NAME`` for module-level
+locks; all instances of a class share one lock identity (the same
+aggregation the runtime sanitizer uses, so static and dynamic reports
+line up). Attribute receivers are typed from constructor assignments
+(``self.scheduler = Scheduler(...)`` types ``Engine.scheduler``), which
+resolves cross-object acquisitions like ``with self.scheduler._lock:``
+and cross-object calls like ``self.scheduler.admit()``.
+
+Per function the rule records every acquisition (with the locks held
+at that point) and every call made under a held lock. A fixpoint over
+the resolvable call graph then computes which locks each function MAY
+acquire and whether it MAY block; edges ``held -> acquired`` feed the
+order graph, and may-block callees under a lock feed the blocking
+rule. ``with cond:`` on a Condition is a lock acquisition;
+``cond.wait()`` is NOT a blocking call (it releases the lock).
+
+Known limits (by design, to stay useful instead of noisy): dynamic
+callables (jitted functions, hooks) are opaque; attribute chains
+deeper than ``self.attr.method`` are unresolved; a lock passed across
+objects keeps its creation-site identity only when the attribute type
+is resolvable. The ``PADDLE_TPU_LOCKCHECK=1`` runtime sanitizer
+(analysis/lockcheck.py) is the dynamic complement covering what this
+model cannot see.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from ..core import (FileContext, KeyCounter, Rule, dotted_name,
+                    register)
+
+__all__ = ["LockOrderRule", "BlockingUnderLockRule",
+           "CallbackUnderLockRule", "LOCK_FACTORIES",
+           "BLOCKING_PRIMITIVES"]
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# Call shapes that BLOCK (sleep, wire/file I/O, futures, subprocess,
+# checkpoint restore, metrics exposition). Matched against the dotted
+# tail of the callee: "time.sleep" matches `time.sleep(...)`, "sleep"
+# matches any `*.sleep(...)` or bare `sleep(...)`.
+BLOCKING_PRIMITIVES = {
+    # sleeping / waiting
+    "time.sleep", "sleep",
+    # sockets (the wire framing helpers are this tree's socket I/O)
+    "connect", "create_connection", "recv", "recv_into", "sendall",
+    "accept", "send_frame", "recv_frame",
+    # file I/O
+    "open", "os.open", "os.write", "os.replace", "os.fsync",
+    "np.savez", "np.savez_compressed", "np.load", "savez",
+    # futures / threads / subprocess
+    "result", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output", "communicate", "subprocess.Popen",
+    # checkpoint restore/save entry points (disk behind one name)
+    "load_checkpoint", "save_checkpoint", "load_snapshot", "restore",
+    # registry exposition walks every series and evaluates gauge
+    # callbacks — never under a subsystem lock
+    "prometheus_text", "dump_to_file",
+}
+
+# receivers whose .join() is a thread join, not str.join
+_JOINABLE_HINTS = ("thread", "proc", "worker")
+
+
+_dotted = dotted_name   # shared AST chain resolver (core.py)
+
+
+def _call_tail(name: str) -> list[str]:
+    """Match candidates for a dotted callee: full dotted name and the
+    bare final attribute."""
+    out = [name]
+    if "." in name:
+        out.append(name.rsplit(".", 1)[1])
+    return out
+
+
+def _is_blocking_callee(dotted: str, call: ast.Call) -> bool:
+    tails = _call_tail(dotted)
+    for t in tails:
+        if t in BLOCKING_PRIMITIVES:
+            return True
+    # thread/process join heuristic (str.join is everywhere)
+    if tails[-1] == "join" and "." in dotted:
+        recv = dotted.rsplit(".", 1)[0].lower()
+        if any(h in recv for h in _JOINABLE_HINTS):
+            return True
+    return False
+
+
+# -- per-file model ----------------------------------------------------
+
+@dataclass
+class FuncInfo:
+    """One function/method's lock-relevant behavior."""
+    key: tuple            # (module, class|None, name)
+    path: str
+    # (lock_id, line, tuple(held lock_ids at that point))
+    acquires: list = field(default_factory=list)
+    # (callee descriptor, line, tuple(held), dotted_name)
+    calls: list = field(default_factory=list)
+    # (dotted_name, line, tuple(held)) blocking primitives UNDER a lock
+    blocking: list = field(default_factory=list)
+    # dotted_name -> line: every blocking primitive in the body,
+    # locked or not (seed for interprocedural may-block propagation)
+    blocks_any: dict = field(default_factory=dict)
+    # (attr_name, line, tuple(held)) opaque stored-callback calls
+    callbacks: list = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    module: str
+    name: str
+    path: str
+    bases: list = field(default_factory=list)        # same-file names
+    lock_attrs: set = field(default_factory=set)
+    attr_types: dict = field(default_factory=dict)   # attr -> ClassName
+    # attrs assigned from a plain parameter/lambda somewhere (callback
+    # storage like self._fn = fn)
+    callback_attrs: set = field(default_factory=set)
+    methods: set = field(default_factory=set)
+    lock_owner: dict = field(default_factory=dict)   # attr -> def class
+
+
+class _ModuleScan:
+    """Single pass over one parsed file collecting the lock model."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        # keys must be stable across scan roots: a shipped-tree file
+        # keeps its full-tree-relative path however the scan was
+        # rooted, so subtree runs match the same baseline entries
+        self.keypath = ctx.tree_rel or ctx.relpath
+        self.module = self.keypath[:-3].replace("/", ".")
+        # a package's locks/functions belong to the PACKAGE name —
+        # keying every __init__.py under the basename "__init__"
+        # would merge all packages into one resolution bucket
+        if self.module.endswith(".__init__"):
+            self.module = self.module[:-len(".__init__")]
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_locks: set[str] = set()
+        self.functions: dict[tuple, FuncInfo] = {}
+        self.imports: dict[str, str] = {}   # alias -> module basename
+        self._scan()
+
+    # -- phase 1: discover locks / attr types / imports ---------------
+    def _scan(self):
+        # two passes: collect EVERY class's lock/attr model first (a
+        # subclass method may use a base-class lock defined later in
+        # the file), then walk function bodies
+        for node in self.ctx.tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._scan_import(node)
+            elif isinstance(node, ast.Assign):
+                self._module_assign(node)
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+        self._inherit()
+        for node in self.ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._scan_func(sub, cls=node.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self._scan_func(node, cls=None)
+
+    def _inherit(self):
+        """Fold same-file base-class lock/attr/method models into
+        subclasses (the registry's _Child hierarchy keeps its lock on
+        the base). Lock identity stays the DEFINING class so one base
+        lock is one graph node across all subclasses."""
+        def fold(name, seen):
+            info = self.classes.get(name)
+            if info is None or name in seen:
+                return info
+            seen.add(name)
+            for b in info.bases:
+                binfo = fold(b, seen)
+                if binfo is None:
+                    continue
+                for attr in binfo.lock_attrs:
+                    # keep the base's identity for inherited locks
+                    info.lock_owner.setdefault(attr,
+                                               binfo.lock_owner.get(
+                                                   attr, binfo.name))
+                    info.lock_attrs.add(attr)
+                for k, v in binfo.attr_types.items():
+                    info.attr_types.setdefault(k, v)
+                info.callback_attrs |= binfo.callback_attrs
+                info.methods |= binfo.methods
+            return info
+
+        for name in list(self.classes):
+            fold(name, set())
+
+    def _scan_import(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                self.imports[alias] = a.name.split(".")[-1]
+        else:
+            for a in node.names:
+                # `from ..observability import flight as _flight`
+                # imports the MODULE flight; `from x import func` maps
+                # the name to the source module for function lookup
+                self.imports[a.asname or a.name] = a.name
+
+    def _module_assign(self, node: ast.Assign):
+        if _lock_factory_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.module_locks.add(t.id)
+
+    def _collect_class(self, cnode: ast.ClassDef):
+        info = ClassInfo(self.module, cnode.name, self.ctx.path,
+                         bases=[b.id for b in cnode.bases
+                                if isinstance(b, ast.Name)])
+        self.classes[cnode.name] = info
+        for node in cnode.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                info.methods.add(node.name)
+        for node in ast.walk(cnode):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    if _lock_factory_call(node.value):
+                        info.lock_attrs.add(t.attr)
+                        info.lock_owner[t.attr] = cnode.name
+                    elif isinstance(node.value, ast.Call) \
+                            and isinstance(node.value.func, ast.Name):
+                        info.attr_types[t.attr] = node.value.func.id
+                    elif isinstance(node.value,
+                                    (ast.Name, ast.Lambda)):
+                        info.callback_attrs.add(t.attr)
+
+    # -- phase 2: per-function lock-aware walk -------------------------
+    def _scan_func(self, fnode, cls: str | None):
+        key = (self.module, cls, fnode.name)
+        info = FuncInfo(key, self.ctx.path)
+        self.functions[key] = info
+        self._walk_body(fnode.body, cls, info, held=())
+
+    def _lock_id(self, expr, cls: str | None):
+        """Resolve a `with` context expression to a lock identity, or
+        None. Identities: ('C', attr) for class locks, ('mod:<module>',
+        name) for module-level locks."""
+        d = _dotted(expr)
+        if d is None:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            if parts[0] in self.module_locks:
+                return (f"mod:{self.module}", parts[0])
+            return None
+        if parts[0] == "self" and cls is not None:
+            cinfo = self.classes.get(cls)
+            if cinfo is None:
+                return None
+            if len(parts) == 2 and parts[1] in cinfo.lock_attrs:
+                return (cinfo.lock_owner.get(parts[1], cls), parts[1])
+            if len(parts) == 3:
+                # with self.scheduler._lock: -> (Scheduler, _lock)
+                owner = cinfo.attr_types.get(parts[1])
+                if owner is not None:
+                    oinfo = self.classes.get(owner)
+                    if oinfo is not None:
+                        owner = oinfo.lock_owner.get(parts[2], owner)
+                    return (owner, parts[2])
+            return None
+        return None
+
+    def _callee(self, call: ast.Call, cls: str | None):
+        """(descriptor, dotted) where descriptor resolves the callee:
+        ('method', class, name) / ('func', module_hint, name) / None."""
+        d = _dotted(call.func)
+        if d is None:
+            return None, None
+        parts = d.split(".")
+        if parts[0] == "self" and cls is not None:
+            cinfo = self.classes.get(cls)
+            if len(parts) == 2:
+                if cinfo and parts[1] in cinfo.methods:
+                    return ("method", cls, parts[1]), d
+                if cinfo and parts[1] in cinfo.callback_attrs:
+                    return ("callback", cls, parts[1]), d
+                return None, d
+            if len(parts) == 3 and cinfo:
+                owner = cinfo.attr_types.get(parts[1])
+                if owner is not None:
+                    return ("method", owner, parts[2]), d
+                return None, d
+            return None, d
+        if len(parts) == 1:
+            return ("func", self.module, parts[0]), d
+        if len(parts) == 2 and parts[0] in self.imports:
+            return ("func", self.imports[parts[0]], parts[1]), d
+        if len(parts) == 2:
+            # ClassName.method / unknown-receiver.method
+            return ("maybe_method", parts[0], parts[1]), d
+        return None, d
+
+    def _walk_body(self, body, cls, info: FuncInfo, held: tuple):
+        for stmt in body:
+            self._walk_stmt(stmt, cls, info, held)
+
+    def _walk_stmt(self, stmt, cls, info: FuncInfo, held: tuple):
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in stmt.items:
+                lid = self._lock_id(item.context_expr, cls)
+                if lid is not None:
+                    if lid not in new_held:
+                        info.acquires.append(
+                            (lid, item.context_expr.lineno, new_held))
+                        new_held = new_held + (lid,)
+                else:
+                    # later items of `with self._lock, open(p):` run
+                    # with the earlier items' locks HELD — visit with
+                    # the accumulating set, not the pre-With one
+                    self._visit_expr(item.context_expr, cls, info,
+                                     new_held)
+            self._walk_body(stmt.body, cls, info, new_held)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body runs later, outside our held set
+            self._walk_body(stmt.body, cls, info, ())
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        # every other statement: visit expressions, recurse into
+        # compound bodies with the same held set
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            for expr in _exprs(value):
+                self._visit_expr(expr, cls, info, held)
+        for name in ("body", "orelse", "finalbody"):
+            self._walk_body(getattr(stmt, name, []) or [], cls, info,
+                            held)
+        for h in getattr(stmt, "handlers", []) or []:
+            self._walk_body(h.body, cls, info, held)
+
+    def _visit_expr(self, expr, cls, info: FuncInfo, held: tuple):
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            desc, dotted = self._callee(node, cls)
+            if dotted is None:
+                continue
+            tail = dotted.rsplit(".", 1)[-1]
+            if tail == "wait":
+                continue      # Condition.wait releases its lock
+            if _is_blocking_callee(dotted, node):
+                info.blocks_any.setdefault(dotted, node.lineno)
+                if held:
+                    info.blocking.append((dotted, node.lineno, held))
+                continue
+            if desc is None:
+                continue
+            if desc[0] == "callback":
+                if held:
+                    info.callbacks.append(
+                        (desc[2], node.lineno, held))
+                continue
+            info.calls.append((desc, node.lineno, held, dotted))
+
+
+def _exprs(value):
+    if isinstance(value, ast.AST):
+        yield value
+    elif isinstance(value, list):
+        for v in value:
+            if isinstance(v, ast.AST):
+                yield v
+
+
+def _lock_factory_call(node) -> bool:
+    """threading.Lock() / threading.RLock() / threading.Condition()
+    (or bare Lock()/RLock()/Condition() from `from threading import`)."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    return parts[-1] in LOCK_FACTORIES and \
+        (len(parts) == 1 or parts[-2] == "threading")
+
+
+# -- cross-file analysis ----------------------------------------------
+
+class _Model:
+    """The whole-tree lock model shared by the three concurrency rules
+    (built once per engine invocation, on the shared ASTs)."""
+
+    def __init__(self):
+        self.scans: list[_ModuleScan] = []
+        self._done = False
+        # resolution indexes
+        self.by_class: dict[str, dict[str, FuncInfo]] = {}
+        self.by_module: dict[str, dict[str, FuncInfo]] = {}
+        self.may_acquire: dict[tuple, set] = {}
+        self.may_block: dict[tuple, dict] = {}   # key -> {prim: line}
+
+    def add(self, ctx: FileContext):
+        self.scans.append(_ModuleScan(ctx))
+
+    def resolve(self, desc):
+        kind, owner, name = desc
+        if kind in ("method", "maybe_method"):
+            return self.by_class.get(owner, {}).get(name)
+        if kind == "func":
+            # owner may be a dotted module path or basename
+            base = owner.rsplit(".", 1)[-1]
+            return self.by_module.get(base, {}).get(name)
+        return None
+
+    def finalize(self):
+        if self._done:
+            return
+        self._done = True
+        # cross-file indexes resolve by bare name: names defined in
+        # MORE than one module are ambiguous — resolving them to
+        # whichever registration came last would propagate the wrong
+        # class's lock model through the fixpoint, so ambiguous names
+        # are dropped from resolution entirely (conservative: fewer
+        # edges, never wrong-class edges)
+        class_owner: dict[str, set] = {}
+        module_owner: dict[str, set] = {}
+        for scan in self.scans:
+            for cname in scan.classes:
+                class_owner.setdefault(cname, set()).add(scan.module)
+            module_owner.setdefault(
+                scan.module.rsplit(".", 1)[-1], set()).add(scan.module)
+        for scan in self.scans:
+            base = scan.module.rsplit(".", 1)[-1]
+            for key, fi in scan.functions.items():
+                _module, cls, name = key
+                if cls is not None:
+                    if len(class_owner.get(cls, ())) == 1:
+                        self.by_class.setdefault(cls, {})[name] = fi
+                elif len(module_owner.get(base, ())) == 1:
+                    self.by_module.setdefault(base, {})[name] = fi
+        funcs = [fi for scan in self.scans
+                 for fi in scan.functions.values()]
+        for fi in funcs:
+            self.may_acquire[fi.key] = {l for l, _ln, _h
+                                        in fi.acquires}
+            self.may_block[fi.key] = dict(fi.blocks_any)
+        # fixpoint over the resolvable call graph
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                acq = self.may_acquire[fi.key]
+                blk = self.may_block[fi.key]
+                for desc, _line, _held, _dotted in fi.calls:
+                    callee = self.resolve(desc)
+                    if callee is None:
+                        continue
+                    extra = self.may_acquire.get(callee.key, set()) \
+                        - acq
+                    if extra:
+                        acq |= extra
+                        changed = True
+                    for prim, ln in self.may_block.get(
+                            callee.key, {}).items():
+                        if prim not in blk:
+                            blk[prim] = ln
+                            changed = True
+
+
+def _lock_name(lid) -> str:
+    owner, attr = lid
+    return f"{owner}.{attr}" if not owner.startswith("mod:") \
+        else f"{owner[4:]}.{attr}"
+
+
+def _shared_model(run) -> _Model:
+    """ONE _Model per engine invocation, cached on the AnalysisRun:
+    the module scans and the call-graph fixpoint run once however many
+    concurrency rules are selected."""
+    m = getattr(run, "_concurrency_model", None)
+    if m is None:
+        m = _Model()
+        for ctx in run.files:
+            m.add(ctx)
+        m.finalize()
+        run._concurrency_model = m
+    return m
+
+
+class _ConcurrencyBase(Rule):
+    """Concurrency rules are finalize-only: they read the shared
+    per-run _Model (built lazily from run.files by whichever rule
+    finalizes first)."""
+
+    def visit(self, ctx: FileContext):
+        return ()
+
+
+@register
+class LockOrderRule(_ConcurrencyBase):
+    name = "lock-order"
+    description = ("inconsistent lock-acquisition order between two "
+                   "locks (deadlock potential)")
+
+    def finalize(self, run):
+        m = _shared_model(run)
+        # edge (A -> B): witness line where B is acquired while A held
+        edges: dict[tuple, tuple] = {}
+        for scan in m.scans:
+            for fi in scan.functions.values():
+                for lid, line, held in fi.acquires:
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault((h, lid),
+                                             (fi.path, line, fi.key))
+                for desc, line, held, _dotted in fi.calls:
+                    if not held:
+                        continue
+                    callee = m.resolve(desc)
+                    if callee is None:
+                        continue
+                    for lid in m.may_acquire.get(callee.key, ()):
+                        for h in held:
+                            if h != lid:
+                                edges.setdefault(
+                                    (h, lid),
+                                    (fi.path, line, fi.key))
+        out = []
+        seen_pairs = set()
+        for (a, b), (path, line, key) in sorted(
+                edges.items(), key=lambda kv: (str(kv[0]))):
+            if (b, a) not in edges:
+                continue
+            pair = tuple(sorted((_lock_name(a), _lock_name(b))))
+            if pair in seen_pairs:
+                continue
+            seen_pairs.add(pair)
+            rpath, rline, rkey = edges[(b, a)]
+            out.append(self.finding(
+                path, line,
+                f"inconsistent lock order: {_lock_name(a)} -> "
+                f"{_lock_name(b)} here (in {_fq(key)}), but "
+                f"{_lock_name(b)} -> {_lock_name(a)} at "
+                f"{rpath}:{rline} (in {_fq(rkey)}) — deadlock "
+                f"potential",
+                key=f"{pair[0]}<->{pair[1]}"))
+        return out
+
+
+def _fq(key) -> str:
+    module, cls, name = key
+    return f"{cls}.{name}" if cls else name
+
+
+_KeyCounter = KeyCounter   # shared content-based key convention
+
+
+@register
+class BlockingUnderLockRule(_ConcurrencyBase):
+    name = "lock-blocking-call"
+    description = ("blocking call (sleep / socket / file I/O / "
+                   ".result() / exposition) while holding a lock")
+
+    def finalize(self, run):
+        m = _shared_model(run)
+        out = []
+        dedup = _KeyCounter()
+        for scan in m.scans:
+            for fi in sorted(scan.functions.values(),
+                             key=lambda f: (f.key[0], f.key[1] or "",
+                                            f.key[2])):
+                for dotted, line, held in fi.blocking:
+                    locks = ", ".join(_lock_name(h) for h in held)
+                    out.append(self.finding(
+                        fi.path, line,
+                        f"blocking call {dotted}() while holding "
+                        f"{locks} (in {_fq(fi.key)})",
+                        key=dedup(f"{scan.keypath}::"
+                                  f"{_fq(fi.key)}::{dotted}")))
+                for desc, line, held, dotted in fi.calls:
+                    if not held:
+                        continue
+                    callee = m.resolve(desc)
+                    if callee is None:
+                        continue
+                    blk = m.may_block.get(callee.key, {})
+                    if not blk:
+                        continue
+                    prim = sorted(blk)[0]
+                    locks = ", ".join(_lock_name(h) for h in held)
+                    out.append(self.finding(
+                        fi.path, line,
+                        f"call {dotted}() while holding {locks} "
+                        f"(in {_fq(fi.key)}) reaches blocking "
+                        f"{prim}() via {_fq(callee.key)}",
+                        key=dedup(f"{scan.keypath}::"
+                                  f"{_fq(fi.key)}::{dotted}->"
+                                  f"{prim}")))
+        return out
+
+
+@register
+class CallbackUnderLockRule(_ConcurrencyBase):
+    name = "lock-callback"
+    description = ("opaque stored callback invoked while holding a "
+                   "lock (unknowable lock-order effects)")
+
+    def finalize(self, run):
+        out = []
+        dedup = _KeyCounter()
+        for scan in _shared_model(run).scans:
+            for fi in sorted(scan.functions.values(),
+                             key=lambda f: (f.key[0], f.key[1] or "",
+                                            f.key[2])):
+                for attr, line, held in fi.callbacks:
+                    locks = ", ".join(_lock_name(h) for h in held)
+                    out.append(self.finding(
+                        fi.path, line,
+                        f"opaque callback self.{attr}() invoked while "
+                        f"holding {locks} (in {_fq(fi.key)}) — its "
+                        f"lock-order effects are invisible to this "
+                        f"analysis and can close a deadlock cycle",
+                        key=dedup(f"{scan.keypath}::"
+                                  f"{_fq(fi.key)}::{attr}")))
+        return out

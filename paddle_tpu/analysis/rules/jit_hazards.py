@@ -1,0 +1,344 @@
+"""Jit-hazard rules: host syncs and recompile bombs inside
+jit-reachable functions.
+
+The XLA fusion study (PAPERS.md, arxiv 2301.13062) shows frameworks
+silently lose cycles to host round-trips and recompilation; neither is
+visible in a diff unless something looks for it. These rules find the
+shapes that cause them:
+
+  * ``jit-host-sync``      — ``.item()`` / ``float()/int()/bool()`` on
+                             a traced argument / ``np.asarray``-family
+                             on a traced argument inside a jitted
+                             function: each forces a device->host sync
+                             per call (or worse, per trace);
+  * ``jit-trace-branch``   — Python ``if``/``while`` on a traced
+                             argument: either a TracerBoolConversion
+                             error at runtime or, with shape-dependent
+                             code, one recompile per value seen;
+  * ``jit-nondeterminism`` — wall-clock / ``random`` reads inside a
+                             jitted function: the value is baked in at
+                             TRACE time, so it is stale for every later
+                             call and differs across hosts (the
+                             ``Date``-like hazard class);
+  * ``jit-static-unhashable`` — ``static_argnums/argnames`` naming a
+                             parameter with a mutable (unhashable)
+                             default: jit's cache keying raises
+                             ``TypeError: unhashable`` the first time
+                             the default is actually used.
+
+Jit-reachability: a function is jitted when decorated with
+``jax.jit``/``jit``/``pjit`` (bare or under ``functools.partial``), or
+when its NAME is wrapped anywhere in the same file
+(``self._step = jax.jit(step)``). Reachability propagates through
+bare same-file calls (``helper(x)`` inside a jitted fn marks
+``helper``). Parameters named static (``static_argnums/argnames``) are
+exempt from the tracer-argument checks — branching on a static arg is
+exactly what static args are for. Closure variables are NOT treated as
+tracers (config objects riding a closure are the dominant idiom in
+this tree); only the function's own positional/keyword parameters are.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, dotted_name, register
+
+__all__ = ["JitHostSyncRule", "JitTraceBranchRule",
+           "JitNondeterminismRule", "JitStaticUnhashableRule"]
+
+_JIT_NAMES = {"jit", "pjit"}
+_NP_ALIASES = {"np", "numpy", "onp"}
+_HOST_PULLS = {"asarray", "array", "copy", "ascontiguousarray"}
+_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                "time.time_ns", "datetime.now", "datetime.utcnow",
+                "date.today"}
+_RANDOM_MODS = {"random"}   # python random; np.random handled below
+
+
+_dotted = dotted_name   # shared AST chain resolver (core.py)
+
+
+def _is_jit_callee(node) -> bool:
+    """jax.jit / jit / pjit / functools.partial(jax.jit, ...)"""
+    d = _dotted(node)
+    if d is not None and d.split(".")[-1] in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fd = _dotted(node.func)
+        if fd is not None and fd.split(".")[-1] == "partial" \
+                and node.args:
+            return _is_jit_callee(node.args[0])
+    return False
+
+
+def _static_params(call: ast.Call | None, fnode) -> set[str]:
+    """Parameter names declared static on the jit call/decorator."""
+    if call is None:
+        return set()
+    args = [a.arg for a in fnode.args.posonlyargs + fnode.args.args]
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in _const_elems(kw.value):
+                if isinstance(el, str):
+                    out.add(el)
+        elif kw.arg == "static_argnums":
+            for el in _const_elems(kw.value):
+                if isinstance(el, int) and 0 <= el < len(args):
+                    out.add(args[el])
+    return out
+
+
+def _const_elems(node):
+    if isinstance(node, ast.Constant):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant):
+                yield el.value
+
+
+class _JitIndex:
+    """Per-file: which function defs are jit-reachable, and with which
+    static params."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        # name -> (fnode, jit call node | None)
+        self.jitted: dict[str, tuple] = {}
+        self._defs: dict[str, ast.AST] = {}
+        self._collect()
+
+    def _collect(self):
+        # every def in the file (any nesting), by name (last wins)
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self._defs[node.name] = node
+        # decorated defs
+        for name, fnode in self._defs.items():
+            for dec in getattr(fnode, "decorator_list", ()):
+                if _is_jit_callee(dec):
+                    call = dec if isinstance(dec, ast.Call) else None
+                    # @partial(jax.jit, static_argnums=...) carries the
+                    # kwargs on the partial call itself
+                    self.jitted[name] = (fnode, call)
+        # name-wrapped defs: x = jax.jit(fn, ...) anywhere in the file
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Call) and _is_jit_callee(node.func):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    fname = node.args[0].id
+                    fnode = self._defs.get(fname)
+                    if fnode is not None:
+                        self.jitted[fname] = (fnode, node)
+        # propagate through bare same-file calls from jitted bodies
+        changed = True
+        while changed:
+            changed = False
+            for name, (fnode, _call) in list(self.jitted.items()):
+                for node in ast.walk(fnode):
+                    if isinstance(node, ast.Call) \
+                            and isinstance(node.func, ast.Name):
+                        callee = node.func.id
+                        if callee in self._defs \
+                                and callee not in self.jitted:
+                            self.jitted[callee] = (
+                                self._defs[callee], None)
+                            changed = True
+
+    def each(self):
+        """(fname, fnode, traced param-name set) per jitted fn."""
+        for name, (fnode, call) in sorted(self.jitted.items()):
+            static = _static_params(call, fnode)
+            params = {a.arg for a in (fnode.args.posonlyargs
+                                      + fnode.args.args
+                                      + fnode.args.kwonlyargs)}
+            params.discard("self")
+            yield name, fnode, params - static, call
+
+
+def _own_nodes(fnode):
+    """Walk a function body but NOT into nested defs (they have their
+    own parameter scopes and their own jit-index entries if reachable)."""
+    stack = list(fnode.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _names_in(node) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _JitRuleBase(Rule):
+    def visit(self, ctx: FileContext):
+        idx = _JitIndex(ctx)
+        out = []
+        for fname, fnode, traced, call in idx.each():
+            out.extend(self.check(ctx, fname, fnode, traced, call))
+        return out
+
+    def check(self, ctx, fname, fnode, traced, call):
+        return ()
+
+
+@register
+class JitHostSyncRule(_JitRuleBase):
+    name = "jit-host-sync"
+    description = ("device->host sync (.item() / float() / "
+                   "np.asarray on a tracer) inside a jitted function")
+
+    def check(self, ctx, fname, fnode, traced, call):
+        out = []
+        for node in _own_nodes(fnode):
+            if not isinstance(node, ast.Call):
+                continue
+            # .item() on ANY receiver, incl. call results (x.sum().item())
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                recv = _dotted(node.func.value) \
+                    or ast.unparse(node.func.value)[:40]
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"{recv}.item() in jitted `{fname}`: .item() "
+                    f"forces a device->host sync on every call",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::{recv}.item"))
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if len(parts) == 1 \
+                    and parts[0] in ("float", "int", "bool") \
+                    and len(node.args) == 1 \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in traced:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"{parts[0]}({node.args[0].id}) in jitted "
+                    f"`{fname}`: concretizes a traced argument "
+                    f"(host sync, or TracerConversion error)",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::"
+                        f"{parts[0]}({node.args[0].id})"))
+            elif len(parts) == 2 and parts[0] in _NP_ALIASES \
+                    and parts[1] in _HOST_PULLS and node.args \
+                    and (_names_in(node.args[0]) & traced):
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"{d}(...) on traced argument(s) "
+                    f"{sorted(_names_in(node.args[0]) & traced)} in "
+                    f"jitted `{fname}`: numpy conversion pulls the "
+                    f"value to host (sync) or fails on a tracer",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::{d}"))
+        return out
+
+
+@register
+class JitTraceBranchRule(_JitRuleBase):
+    name = "jit-trace-branch"
+    description = ("Python if/while on a traced argument inside a "
+                   "jitted function (recompile bomb / tracer error)")
+
+    def check(self, ctx, fname, fnode, traced, call):
+        out = []
+        for node in _own_nodes(fnode):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            test = node.test
+            hot: set[str] = set()
+            if isinstance(test, ast.Name) and test.id in traced:
+                hot = {test.id}
+            elif isinstance(test, (ast.Compare, ast.BoolOp,
+                                   ast.UnaryOp)):
+                # only direct Name operands — `cfg.flag > 0` on a
+                # closure config is the dominant legit idiom here
+                hot = {n.id for n in ast.walk(test)
+                       if isinstance(n, ast.Name)} & traced
+            if hot:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"Python `{kw}` on traced argument(s) "
+                    f"{sorted(hot)} in jitted `{fname}`: branch is "
+                    f"resolved at trace time (recompile per value via "
+                    f"static shapes, or TracerBoolConversion) — use "
+                    f"lax.cond/jnp.where or mark the arg static",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::{kw}:"
+                        f"{','.join(sorted(hot))}"))
+        return out
+
+
+@register
+class JitNondeterminismRule(_JitRuleBase):
+    name = "jit-nondeterminism"
+    description = ("wall-clock/random read inside a jitted function "
+                   "(baked in at trace time)")
+
+    def check(self, ctx, fname, fnode, traced, call):
+        out = []
+        for node in _own_nodes(fnode):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            bad = None
+            if d in _CLOCK_CALLS or (len(parts) > 1 and
+                                     ".".join(parts[-2:])
+                                     in _CLOCK_CALLS):
+                bad = "wall-clock read"
+            elif len(parts) >= 2 and parts[0] in _RANDOM_MODS:
+                bad = "python random draw"
+            elif len(parts) >= 3 and parts[0] in _NP_ALIASES \
+                    and parts[1] == "random":
+                bad = "numpy random draw"
+            if bad:
+                out.append(self.finding(
+                    ctx, node.lineno,
+                    f"{d}() in jitted `{fname}`: {bad} executes once "
+                    f"at TRACE time and is a constant thereafter "
+                    f"(stale clocks / identical 'randomness' every "
+                    f"call) — pass values in, or use jax.random keys",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::{d}"))
+        return out
+
+
+@register
+class JitStaticUnhashableRule(_JitRuleBase):
+    name = "jit-static-unhashable"
+    description = ("static_argnums/argnames parameter with a mutable "
+                   "(unhashable) default")
+
+    def check(self, ctx, fname, fnode, traced, call):
+        if call is None:
+            return ()
+        static = _static_params(call, fnode)
+        if not static:
+            return ()
+        out = []
+        args = fnode.args.posonlyargs + fnode.args.args
+        defaults = fnode.args.defaults
+        offset = len(args) - len(defaults)
+        pairs = [(a.arg, d) for a, d in zip(args[offset:], defaults)]
+        pairs += [(a.arg, d) for a, d in
+                  zip(fnode.args.kwonlyargs, fnode.args.kw_defaults)
+                  if d is not None]
+        for pname, dflt in pairs:
+            if pname in static and isinstance(
+                    dflt, (ast.List, ast.Dict, ast.Set)):
+                kind = {ast.List: "list", ast.Dict: "dict",
+                        ast.Set: "set"}[type(dflt)]
+                out.append(self.finding(
+                    ctx, dflt.lineno,
+                    f"static arg `{pname}` of jitted `{fname}` "
+                    f"defaults to a {kind}: jit hashes static args "
+                    f"for its compile cache — unhashable default "
+                    f"raises at the first defaulted call (use a "
+                    f"tuple/frozenset/None)",
+                    key=f"{(ctx.tree_rel or ctx.relpath)}::{fname}::{pname}"))
+        return out

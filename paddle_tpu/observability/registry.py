@@ -148,12 +148,19 @@ class _GaugeChild(_Child):
     @property
     def value(self) -> float:
         with self._lock:
-            if self._fn is not None:
-                try:
-                    return float(self._fn())
-                except Exception:
-                    return float("nan")
-            return self._v
+            fn = self._fn
+            v = self._v
+        if fn is None:
+            return v
+        # evaluate OUTSIDE the series lock: set_function callbacks
+        # take subsystem locks (scheduler queue depth, pool occupancy)
+        # whose holders write metrics — running them under this lock
+        # closes a lock-order cycle (analysis lock-callback rule), and
+        # a callback touching its own series would self-deadlock
+        try:
+            return float(fn())
+        except Exception:
+            return float("nan")
 
 
 class _HistogramChild(_Child):

@@ -275,12 +275,19 @@ class Engine:
         """Swap in weights from a committed checkpoint manifest
         (paddle_tpu.checkpoint) without rebuilding the engine: shapes/
         dtypes must match the current model (the jitted programs and
-        page pools are layout-anchored and stay valid). Call while the
-        engine is idle — weights swap between steps, not inside one."""
+        page pools are layout-anchored and stay valid).
+
+        Two-phase so the swap is zero-downtime: the checkpoint read
+        AND the host->device upload run off the step lock (decode
+        keeps batching on the old weights through both), then the FLIP
+        takes the lock for a single reference swap — weights change
+        between steps, never inside one, and never with disk I/O or a
+        device transfer under the step lock (the lock-blocking-call
+        analysis rule pins the disk half). Models served here provide
+        read_checkpoint/adopt_checkpoint (GPTDecodeModel does)."""
+        prepared = self.model.read_checkpoint(root, step=step)
         with self._lock:
-            # in-place restore against the live model's own tree — no
-            # throwaway random-init model while holding the step lock
-            self.model.load_checkpoint(root, step=step)
+            self.model.adopt_checkpoint(prepared)
         return self
 
     @classmethod

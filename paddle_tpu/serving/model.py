@@ -98,8 +98,27 @@ class GPTDecodeModel:
                     f"cfg= explicitly")
             cfg = GPTConfig(**mcfg)
         model = cls(cfg, attn_impl=attn_impl)
-        model._adopt_params(arrays, root)
+        model.adopt_checkpoint(model._prepare_params(arrays, root))
         return model
+
+    def read_checkpoint(self, root: str, step: int | None = None):
+        """Disk + host->device phase of load_checkpoint: fetch the
+        arrays AND build the complete replacement pytree
+        (device-resident, dtype-cast against the live tree's
+        structure) without touching live params. Engine.warm_start
+        runs this off the step lock so serving overlaps both the read
+        and the upload; the adopt_checkpoint flip is then a pure
+        reference swap."""
+        from ..checkpoint import CheckpointStore
+        arrays, _meta = CheckpointStore(root).restore(step)
+        return self._prepare_params(arrays, root)
+
+    def adopt_checkpoint(self, prepared) -> "GPTDecodeModel":
+        """Flip phase: adopt a pytree built by read_checkpoint /
+        _prepare_params. One reference assignment — O(1) under the
+        engine step lock, no disk, no host->device transfer."""
+        self.params = prepared
+        return self
 
     def load_checkpoint(self, root: str, step: int | None = None) \
             -> "GPTDecodeModel":
@@ -107,14 +126,13 @@ class GPTDecodeModel:
         manifest (same structure required) — no throwaway model init,
         which matters when warm-starting a live engine on big
         configs."""
-        from ..checkpoint import CheckpointStore
-        arrays, _meta = CheckpointStore(root).restore(step)
-        self._adopt_params(arrays, root)
-        return self
+        return self.adopt_checkpoint(self.read_checkpoint(root, step))
 
-    def _adopt_params(self, arrays: dict, root: str):
-        """Rebuild the param pytree from tree-path-keyed arrays using
-        the CURRENT params as structural template."""
+    def _prepare_params(self, arrays: dict, root: str):
+        """The replacement param pytree from tree-path-keyed arrays,
+        using the CURRENT params as structural template (read-only;
+        safe concurrent with a live engine decoding on the old
+        tree)."""
         template, treedef = jax.tree_util.tree_flatten_with_path(
             self.params)
         leaves = []
@@ -125,7 +143,7 @@ class GPTDecodeModel:
                                f"param {key}")
             leaves.append(jnp.asarray(arrays[key],
                                       dtype=tmpl.dtype))
-        self.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # -- cache ---------------------------------------------------------
     def init_cache(self, num_pages: int, page_size: int):

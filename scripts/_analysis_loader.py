@@ -1,0 +1,38 @@
+"""Load paddle_tpu.analysis WITHOUT importing the jax-heavy
+paddle_tpu package.
+
+The check_* scripts are subprocess-invoked by the test suite with
+tight timeouts and no framework on sys.path; paddle_tpu/analysis is
+stdlib-only by contract (see its __init__ docstring), so it can be
+loaded standalone as the top-level package ``pt_analysis`` straight
+from its directory."""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_analysis():
+    """The ``paddle_tpu.analysis`` package, as ``pt_analysis``."""
+    if "paddle_tpu.analysis" in sys.modules:
+        return sys.modules["paddle_tpu.analysis"]
+    if "pt_analysis" not in sys.modules:
+        pkgdir = os.path.join(REPO, "paddle_tpu", "analysis")
+        spec = importlib.util.spec_from_file_location(
+            "pt_analysis", os.path.join(pkgdir, "__init__.py"),
+            submodule_search_locations=[pkgdir])
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["pt_analysis"] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["pt_analysis"]
+
+
+def load_invariants():
+    """The invariants rule module (shared logic of the check_*
+    scripts)."""
+    pkg = load_analysis()
+    return importlib.import_module(f"{pkg.__name__}.rules.invariants")

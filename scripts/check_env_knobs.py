@@ -1,118 +1,38 @@
 #!/usr/bin/env python
 """Static check: every env knob read under paddle_tpu/ is documented.
 
-The runtime grows knobs faster than anyone updates the docs; an
-undocumented `PADDLE_TPU_*`/`PADDLE_PS_*` var is effectively a secret
-switch — invisible to operators tuning a production job and to the
-chaos drills that compose fault knobs by name (a misspelled knob is
-caught at runtime by fault_injection's typo guard, but only if the
-real spelling is discoverable somewhere). This AST pass:
+THIN WRAPPER over the unified static-analysis engine — the detection
+logic lives in paddle_tpu/analysis/rules/invariants.py (the
+``env-knobs`` rule; see docs/STATIC_ANALYSIS.md) and this entry point
+keeps the legacy argv/stdout/exit-code contract the test suite wires
+against (tests/test_slo_harness.py).
 
-  * collects every string literal in paddle_tpu/ matching
-    ``PADDLE_(TPU|PS)_<UPPER_SNAKE>`` (the shape of every knob the
-    tree reads via os.environ / os.getenv, or writes into a child's
-    env in launch.py);
-  * collects every such name mentioned in docs/*.md (+ README.md);
-  * fails listing any knob the code knows but the docs do not.
-
-docs/ENV_KNOBS.md is the master index (one row per knob); subsystem
-docs carry the detailed semantics. Run by the test suite
-(tests/test_slo_harness.py), like check_metric_names.py.
+An undocumented ``PADDLE_TPU_*``/``PADDLE_PS_*`` string literal is
+effectively a secret switch — invisible to operators and to the chaos
+drills that compose fault knobs by name. docs/ENV_KNOBS.md is the
+master index; subsystem docs carry detailed semantics.
 
 Usage: check_env_knobs.py [code_root [docs_dir]]
 (defaults: <repo>/paddle_tpu, <repo>/docs + <repo>/README.md).
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-# full uppercase-snake knob names only: the trailing-underscore prefix
-# literals the typo guard scans with ("PADDLE_PS_FAULT_") are not knobs
-KNOB_RE = re.compile(r"^PADDLE_(?:TPU|PS)_[A-Z0-9]+(?:_[A-Z0-9]+)*$")
-FIND_RE = re.compile(r"PADDLE_(?:TPU|PS)_[A-Z0-9_]*[A-Z0-9]")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO, load_invariants  # noqa: E402
 
+_inv = load_invariants()
 
-def _names_in(text: str):
-    for m in FIND_RE.finditer(text):
-        # a match the text continues with "_" is a prefix literal
-        # ("PADDLE_PS_FAULT_" in the typo guard, "PADDLE_PS_FAULT_*"
-        # in prose), not a knob name
-        if m.end() < len(text) and text[m.end()] == "_":
-            continue
-        if KNOB_RE.match(m.group(0)):
-            yield m.group(0)
-
-
-def knobs_in_file(path: str) -> dict[str, str]:
-    """knob name -> first `file:line` site, from string literals."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError:
-        return {}
-    out: dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            for name in _names_in(node.value):
-                out.setdefault(name, f"{path}:{node.lineno}")
-    return out
-
-
-def knobs_in_code(root: str) -> dict[str, str]:
-    sites: dict[str, str] = {}
-    for dirpath, _dirs, files in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in sorted(files):
-            if fn.endswith(".py"):
-                for name, site in knobs_in_file(
-                        os.path.join(dirpath, fn)).items():
-                    sites.setdefault(name, site)
-    return sites
-
-
-def knobs_in_docs(paths: list[str]) -> set[str]:
-    found: set[str] = set()
-    for path in paths:
-        if not os.path.isfile(path):
-            continue
-        with open(path, encoding="utf-8") as f:
-            text = f.read()
-        found.update(_names_in(text))
-    return found
+# re-exports for callers that import the script module directly
+KNOB_RE = _inv.KNOB_RE
+FIND_RE = _inv.FIND_RE
+knobs_in_docs = _inv.knobs_in_docs
 
 
 def main(argv: list[str]) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    code_root = argv[1] if len(argv) > 1 else os.path.join(repo,
-                                                           "paddle_tpu")
-    if len(argv) > 2:
-        docs_paths = [os.path.join(argv[2], f)
-                      for f in sorted(os.listdir(argv[2]))
-                      if f.endswith(".md")]
-    else:
-        docs_dir = os.path.join(repo, "docs")
-        docs_paths = [os.path.join(docs_dir, f)
-                      for f in sorted(os.listdir(docs_dir))
-                      if f.endswith(".md")]
-        docs_paths.append(os.path.join(repo, "README.md"))
-    code = knobs_in_code(code_root)
-    documented = knobs_in_docs(docs_paths)
-    missing = sorted(set(code) - documented)
-    if missing:
-        print(f"undocumented env knobs under {code_root} "
-              "(add them to a docs/ table — docs/ENV_KNOBS.md is the "
-              "master index):")
-        for name in missing:
-            print(f"  {name}  (first read at {code[name]})")
-        return 1
-    print(f"OK: {len(code)} env knobs under {code_root} are all "
-          f"documented across {len(docs_paths)} docs files")
-    return 0
+    return _inv.env_main(argv, REPO)
 
 
 if __name__ == "__main__":

@@ -2,180 +2,41 @@
 """Static check: every metric registered under paddle_tpu/ has a
 well-formed name and exactly one registration site.
 
-The telemetry registry (paddle_tpu/observability/registry.py) enforces
-naming at runtime, but only for code paths a test actually imports; a
-misnamed metric in a rarely-exercised tier would ship silently. This
-AST pass finds every ``counter("…")`` / ``gauge("…")`` /
-``histogram("…")`` call (bare name, attribute form like
-``_obs.counter`` / ``REGISTRY.gauge``, any alias) whose first argument
-is a string literal and enforces:
+THIN WRAPPER over the unified static-analysis engine — the detection
+logic lives in paddle_tpu/analysis/rules/invariants.py (the
+``metric-names`` rule; see docs/STATIC_ANALYSIS.md) and this entry
+point keeps the legacy argv/stdout/exit-code contract the test suite
+wires against (tests/test_observability.py,
+tests/test_debug_postmortem.py imports REQUIRED_METRICS from here).
 
-  * names are snake_case with a ``paddle_tpu_`` prefix
-    (``^paddle_tpu_[a-z][a-z0-9_]*$``);
-  * no duplicate registrations — a metric name is declared at exactly
-    ONE site in the tree, so two modules can never fight over the same
-    series with different help strings/labels (the runtime registry
-    would raise only if the kinds/labels conflict; the static rule is
-    stricter on purpose);
-  * REQUIRED_METRICS must each have a registration site — the
-    checkpoint tier's instrumentation (save seconds, bytes written,
-    chunk dedup hits, WAL rows) is part of its acceptance contract
-    (docs/CHECKPOINT.md), so deleting it fails this check instead of
-    shipping silently unobservable saves.
+Enforced: snake_case ``paddle_tpu_`` prefix, exactly ONE registration
+site per name, and the REQUIRED_METRICS ratchet (contractual
+instrumentation must have a registration site or the check fails
+instead of shipping silently unobservable tiers).
 
 Usage: check_metric_names.py [root_dir]   (default:
-<repo>/paddle_tpu). Exits 1 listing offending file:line sites. Run by
-the test suite (tests/test_observability.py), like
-check_no_wire_pickle.py.
+<repo>/paddle_tpu). Exits 1 listing offending file:line sites.
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
-REGISTER_FUNCS = {"counter", "gauge", "histogram"}
-NAME_RE = re.compile(r"^paddle_tpu_[a-z][a-z0-9_]*$")
-# the registry's own implementation/docs mention registration calls in
-# prose/examples; skip only files that themselves DEFINE the helpers
-SKIP_FILES = {os.path.join("observability", "registry.py"),
-              os.path.join("observability", "__init__.py")}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO, load_invariants  # noqa: E402
 
-# metric families whose presence is contractual (docs/CHECKPOINT.md,
-# docs/DEBUGGING.md): a registration site must exist for each, or the
-# check fails
-REQUIRED_METRICS = {
-    "paddle_tpu_ckpt_save_seconds",
-    "paddle_tpu_ckpt_restore_seconds",
-    "paddle_tpu_ckpt_bytes_written_total",
-    "paddle_tpu_ckpt_chunks_written_total",
-    "paddle_tpu_ckpt_chunks_dedup_hits_total",
-    "paddle_tpu_ckpt_wal_rows_appended_total",
-    "paddle_tpu_ckpt_wal_compactions_total",
-    "paddle_tpu_ckpt_manifests_committed_total",
-    # checkpoint async-writer queue (docs/DEBUGGING.md): a rising depth
-    # means the save cadence is outrunning the writer
-    "paddle_tpu_ckpt_writer_queue_depth",
-    "paddle_tpu_ckpt_writer_pending_bytes",
-    "paddle_tpu_ckpt_inflight_save_seconds",
-    # stall watchdog + flight recorder (docs/DEBUGGING.md): the
-    # postmortem tier's own observability is part of its acceptance
-    # contract — deleting it would ship silent hang detection
-    "paddle_tpu_watchdog_checks_total",
-    "paddle_tpu_watchdog_stalls_total",
-    "paddle_tpu_watchdog_stalled",
-    "paddle_tpu_watchdog_progress_age_seconds",
-    "paddle_tpu_flight_events_total",
-    "paddle_tpu_flight_dropped_total",
-    # SLO harness (docs/SERVING.md production traffic harness): the
-    # load generator's attainment/goodput surface and the scheduler's
-    # admission-control decisions are acceptance-contractual — the
-    # chaos drills assert against these exact names
-    "paddle_tpu_slo_ttft_seconds",
-    "paddle_tpu_slo_inter_token_seconds",
-    "paddle_tpu_slo_deadline_met_total",
-    "paddle_tpu_slo_deadline_missed_total",
-    "paddle_tpu_slo_goodput_tokens_total",
-    "paddle_tpu_slo_attainment_ratio",
-    "paddle_tpu_serving_expired_in_queue_total",
-    "paddle_tpu_serving_shed_total",
-    "paddle_tpu_serving_quota_rejected_total",
-    # autobench persistent tuning cache (docs/KERNELS.md): whether a
-    # replica is measuring in-process (cold) or adopting pre-warmed
-    # decisions (hit) is the cache's acceptance contract
-    "paddle_tpu_autobench_cache_hits_total",
-    "paddle_tpu_autobench_cache_misses_total",
-    "paddle_tpu_autobench_cache_stale_total",
-    "paddle_tpu_autobench_cache_corrupt_total",
-    "paddle_tpu_autobench_measure_total",
-}
+_inv = load_invariants()
 
-
-def _call_name(node: ast.Call) -> str | None:
-    f = node.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
-
-
-def check_file(path: str) -> tuple[list[tuple[int, str]],
-                                   list[tuple[str, int]]]:
-    """(violations, registrations): violations are (line, message);
-    registrations are (metric_name, line) for the duplicate pass."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"unparseable: {e.msg}")], []
-    bad: list[tuple[int, str]] = []
-    regs: list[tuple[str, int]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        if _call_name(node) not in REGISTER_FUNCS:
-            continue
-        if not node.args:
-            continue
-        first = node.args[0]
-        if not (isinstance(first, ast.Constant)
-                and isinstance(first.value, str)):
-            continue
-        name = first.value
-        if not NAME_RE.match(name):
-            bad.append((node.lineno,
-                        f"metric name {name!r} must match "
-                        f"{NAME_RE.pattern}"))
-        else:
-            regs.append((name, node.lineno))
-    return bad, regs
+# re-exports (tests/test_debug_postmortem.py ratchets against this set)
+REQUIRED_METRICS = _inv.REQUIRED_METRICS
+REGISTER_FUNCS = _inv.REGISTER_FUNCS
+NAME_RE = _inv.NAME_RE
+SKIP_FILES = _inv.SKIP_FILES
+check_file = _inv._metric_check_path
 
 
 def main(argv: list[str]) -> int:
-    default_root = len(argv) <= 1
-    if not default_root:
-        root = argv[1]
-    else:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(
-            __file__)))
-        root = os.path.join(repo, "paddle_tpu")
-    violations: list[str] = []
-    sites: dict[str, list[str]] = {}
-    for dirpath, _dirs, files in os.walk(root):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            if rel in SKIP_FILES:
-                continue
-            bad, regs = check_file(path)
-            for lineno, what in bad:
-                violations.append(f"{path}:{lineno}: {what}")
-            for name, lineno in regs:
-                sites.setdefault(name, []).append(f"{path}:{lineno}")
-    for name, where in sorted(sites.items()):
-        if len(where) > 1:
-            violations.append(
-                f"duplicate registration of {name!r} at "
-                + ", ".join(where))
-    if default_root:  # an explicit root is a partial tree by design
-        for name in sorted(REQUIRED_METRICS - set(sites)):
-            violations.append(
-                f"required metric {name!r} has no registration site "
-                "(checkpoint-tier instrumentation is contractual — "
-                "docs/CHECKPOINT.md)")
-    if violations:
-        print(f"metric naming violations under {root} "
-              "(see docs/OBSERVABILITY.md naming scheme):")
-        print("\n".join(violations))
-        return 1
-    print(f"OK: {sum(len(w) for w in sites.values())} metric "
-          f"registrations under {root} are well-named and unique")
-    return 0
+    return _inv.metric_main(argv, REPO)
 
 
 if __name__ == "__main__":

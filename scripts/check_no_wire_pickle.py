@@ -2,117 +2,42 @@
 """Static check: no pickle deserialization anywhere under
 paddle_tpu/distributed/ or paddle_tpu/checkpoint/.
 
+THIN WRAPPER over the unified static-analysis engine — the detection
+logic lives in paddle_tpu/analysis/rules/invariants.py (the
+``wire-pickle`` rule; see docs/STATIC_ANALYSIS.md) and this entry
+point keeps the legacy argv/stdout/exit-code contract the test suite
+wires against (tests/test_ps_fault_tolerance.py,
+tests/test_checkpoint.py).
+
 The PS/heter transport used to be length-prefixed pickle over TCP —
 remote code execution if ever bound beyond localhost (ADVICE). The
-rebuilt wire format (runtime/rpc.py) is data-only, and disk
-serialization in that tree moved to npz with allow_pickle=False. Any
+rebuilt wire format (runtime/rpc.py) is data-only; any
 `pickle.load`/`pickle.loads`/`pickle.Unpickler` (or np.load with
-allow_pickle=True) reappearing under distributed/ is treated as a wire
-hazard: in a transport package the line between "trusted disk" and
-"network bytes" is one refactor away from disappearing, so the whole
-tree is held to the data-only rule.
-
-paddle_tpu/checkpoint/ is held to the same rule for its RESTORE paths
-(docs/CHECKPOINT.md threat model): checkpoints are routinely copied
-between machines/object stores, so restoring one must never execute
-bytes — manifests are CRC'd JSON, chunks are hash-verified raw bytes,
-WAL records are CRC'd struct+JSON.
+allow_pickle=True) reappearing under distributed/ or a checkpoint
+RESTORE path is treated as a wire hazard.
 
 Usage: check_no_wire_pickle.py [root_dir ...]   (default:
 <repo>/paddle_tpu/distributed AND <repo>/paddle_tpu/checkpoint).
-Exits 1 listing offending file:line sites. Run by the test suite
-(tests/test_ps_fault_tolerance.py, tests/test_checkpoint.py).
+Exits 1 listing offending file:line sites.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-BANNED_PICKLE_ATTRS = {"load", "loads", "Unpickler"}
-PICKLE_MODULES = {"pickle", "cPickle", "_pickle", "dill"}
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO, load_invariants  # noqa: E402
 
+_inv = load_invariants()
 
-def _pickle_aliases(tree: ast.AST) -> set[str]:
-    """Names that refer to a pickle module or its load/loads in this
-    module (import pickle / import pickle as p / from pickle import
-    loads as x)."""
-    mods, funcs = set(), set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name.split(".")[0] in PICKLE_MODULES:
-                    mods.add(a.asname or a.name.split(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            if node.module and node.module.split(".")[0] \
-                    in PICKLE_MODULES:
-                for a in node.names:
-                    if a.name in BANNED_PICKLE_ATTRS:
-                        funcs.add(a.asname or a.name)
-    return mods | funcs
-
-
-def check_file(path: str) -> list[tuple[int, str]]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"unparseable: {e.msg}")]
-    aliases = _pickle_aliases(tree)
-    hits = []
-    for node in ast.walk(tree):
-        # pickle.load(...)/pickle.loads(...)/pickle.Unpickler(...)
-        if isinstance(node, ast.Attribute) \
-                and node.attr in BANNED_PICKLE_ATTRS \
-                and isinstance(node.value, ast.Name) \
-                and node.value.id in aliases:
-            hits.append((node.lineno,
-                         f"{node.value.id}.{node.attr}"))
-        # from pickle import loads; loads(...)
-        elif isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Name) \
-                and node.func.id in aliases:
-            hits.append((node.lineno, f"{node.func.id}(...)"))
-        # np.load(..., allow_pickle=True)
-        elif isinstance(node, ast.Call) \
-                and isinstance(node.func, ast.Attribute) \
-                and node.func.attr == "load":
-            for kw in node.keywords:
-                if kw.arg == "allow_pickle" \
-                        and isinstance(kw.value, ast.Constant) \
-                        and kw.value.value is True:
-                    hits.append((node.lineno,
-                                 "np.load(allow_pickle=True)"))
-    return hits
+# re-exports for callers that import the script module directly
+check_file = _inv._wire_check_path
+BANNED_PICKLE_ATTRS = _inv.BANNED_PICKLE_ATTRS
+PICKLE_MODULES = _inv.PICKLE_MODULES
 
 
 def main(argv: list[str]) -> int:
-    if len(argv) > 1:
-        roots = argv[1:]
-    else:
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(
-            __file__)))
-        roots = [os.path.join(repo, "paddle_tpu", "distributed"),
-                 os.path.join(repo, "paddle_tpu", "checkpoint")]
-    bad = []
-    for root in roots:
-        for dirpath, _dirs, files in os.walk(root):
-            for fn in sorted(files):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                for lineno, what in check_file(path):
-                    bad.append(f"{path}:{lineno}: {what}")
-    shown = ", ".join(roots)
-    if bad:
-        print("pickle deserialization is banned under "
-              f"{shown} (wire-safety, see docs/PS_WIRE_PROTOCOL.md "
-              "and docs/CHECKPOINT.md):")
-        print("\n".join(bad))
-        return 1
-    print(f"OK: no pickle deserialization under {shown}")
-    return 0
+    return _inv.wire_main(argv, REPO)
 
 
 if __name__ == "__main__":

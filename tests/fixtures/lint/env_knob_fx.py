@@ -1,0 +1,10 @@
+"""Lint fixture: undocumented env knob (env-knobs rule) — the knob
+below appears in no docs table. Line numbers are asserted by
+tests/test_static_analysis.py; edit with care.
+"""
+import os
+
+SECRET_SWITCH = os.environ.get(
+    "PADDLE_TPU_UNDOCUMENTED_FIXTURE_KNOB", "0")   # line 8
+# prefix literals (typo-guard scans) are NOT knobs: no finding
+PREFIXES = [k for k in os.environ if k.startswith("PADDLE_PS_FAULT_")]

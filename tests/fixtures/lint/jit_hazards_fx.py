@@ -1,0 +1,52 @@
+"""Lint fixture: jit-hazard rules. Line numbers are asserted by
+tests/test_static_analysis.py; edit with care.
+
+(Not imported at test time — jax/numpy names only need to parse.)
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import time
+
+
+@jax.jit
+def bad_host_sync(x):
+    s = x.sum().item()                    # line 16: .item() host sync
+    return x / s
+
+
+@jax.jit
+def bad_branch(x, flag):
+    if flag:                              # line 22: branch on tracer
+        return x + 1
+    return float(x)                       # line 24: float(tracer)
+
+
+@jax.jit
+def bad_clock(x):
+    t = time.time()                       # line 29: trace-baked clock
+    return x * t
+
+
+@partial(jax.jit, static_argnames=("dims",))
+def bad_static(x, dims=[1, 2]):           # line 34: unhashable default
+    return jnp.sum(x, axis=tuple(dims))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def ok_static_branch(x, mode):
+    # branching on a STATIC arg is what static args are for: no finding
+    if mode:
+        return x + 1
+    return x - 1
+
+
+def helper(x):
+    return np.asarray(x)                  # line 48: via jitted caller
+
+
+@jax.jit
+def bad_np_pull(x):
+    return helper(x) + 1                  # helper is jit-reachable

@@ -1,0 +1,26 @@
+"""Lint fixture: inconsistent lock ordering (lock-order rule).
+
+transfer() takes _accounts then _audit; report() takes _audit then
+_accounts — classic ABBA deadlock. Line numbers are asserted by
+tests/test_static_analysis.py; edit with care.
+"""
+import threading
+
+
+class Bank:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.log = []
+
+    def transfer(self, n):
+        with self._accounts:          # A then B
+            with self._audit:         # line 19: edge accounts->audit
+                self.balance += n
+                self.log.append(n)
+
+    def report(self):
+        with self._audit:             # B then A
+            with self._accounts:      # line 25: edge audit->accounts
+                return self.balance, list(self.log)

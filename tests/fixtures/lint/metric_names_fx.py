@@ -1,0 +1,10 @@
+"""Lint fixture: metric naming violations (metric-names rule). Line
+numbers are asserted by tests/test_static_analysis.py; edit with
+care. (Never imported — counter/gauge only need to parse.)
+"""
+from paddle_tpu.observability import counter, gauge
+
+A = counter("my_unprefixed_total", "x")       # line 7: no prefix
+B = gauge("paddle_tpu_BadCase", "x")          # line 8: not snake_case
+C = counter("paddle_tpu_lint_dup_total", "x")  # line 9: dup site 1
+D = counter("paddle_tpu_lint_dup_total", "x")  # line 10: dup site 2

@@ -1,0 +1,40 @@
+"""Lint fixture: blocking calls under a held lock
+(lock-blocking-call rule) and an opaque callback under a lock
+(lock-callback rule). Line numbers are asserted by
+tests/test_static_analysis.py; edit with care.
+"""
+import threading
+import time
+
+
+class HotPath:
+    def __init__(self, on_step):
+        self._lock = threading.Lock()
+        self._on_step = on_step
+        self.steps = 0
+
+    def step(self):
+        with self._lock:
+            time.sleep(0.01)              # line 18: sleep under lock
+            self.steps += 1
+
+    def flush(self, fut):
+        with self._lock:
+            return fut.result()           # line 23: .result under lock
+
+    def notify(self):
+        with self._lock:
+            self._on_step(self.steps)     # line 27: opaque callback
+
+    def _read_disk(self, path):
+        with open(path) as f:             # no lock held: NOT a finding
+            return f.read()
+
+    def chained(self, path):
+        with self._lock:
+            return self._read_disk(path)  # line 35: blocking via chain
+
+    def combined(self, path):
+        # later items of one `with` run with the earlier lock HELD
+        with self._lock, open(path) as f:  # line 39: same-with open
+            return f.read()

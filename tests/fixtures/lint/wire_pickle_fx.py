@@ -1,0 +1,20 @@
+"""Lint fixture: pickle deserialization (wire-pickle rule). Line
+numbers are asserted by tests/test_static_analysis.py; edit with
+care. (Never imported — the bytes below would be a wire hazard.)
+"""
+import pickle as pkl
+from pickle import loads as L
+
+import numpy as np
+
+
+def recv(sock):
+    return pkl.loads(sock.recv(100))      # line 12: pkl.loads
+
+
+def recv2(b):
+    return L(b)                           # line 16: aliased loads
+
+
+def recv3(f):
+    return np.load(f, allow_pickle=True)  # line 20: np allow_pickle

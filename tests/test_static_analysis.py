@@ -1,0 +1,612 @@
+"""Unified static-analysis engine + runtime lock-order sanitizer
+(paddle_tpu/analysis, docs/STATIC_ANALYSIS.md).
+
+Covers: the clean-tree contract (`python -m paddle_tpu.analysis` exits
+0 — this test IS the tier-1 wiring, like check_metric_names before
+it), exact file:line detection of every seeded fixture violation under
+tests/fixtures/lint/, the one-parse-per-file engine contract, the
+shrink-only baseline ratchet, the legacy script wrappers, the
+PADDLE_TPU_LOCKCHECK runtime sanitizer (unit + intentionally-cycled
+fixture + instrumented threaded-module run), and targeted regressions
+for the two concurrency findings the rules surfaced and this PR FIXED
+(Engine.warm_start disk I/O off the step lock; registry gauge
+callbacks outside the series lock).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_FIXTURES = os.path.join(REPO, "tests", "fixtures", "lint")
+
+
+def _run_cli(*args, env=None, timeout=120):
+    e = dict(os.environ, JAX_PLATFORMS="cpu")
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+        env=e)
+
+
+# ---------------------------------------------------------------------------
+# engine: clean tree (tier-1 wiring), fixtures, one-parse contract
+# ---------------------------------------------------------------------------
+
+def test_shipped_tree_is_clean_via_cli():
+    """`python -m paddle_tpu.analysis` over paddle_tpu/: zero
+    unbaselined findings, zero stale/unjustified baseline entries."""
+    res = _run_cli()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 new" in res.stdout
+
+
+# every seeded violation, pinned to its exact (rule, file, line)
+EXPECTED_FIXTURE_FINDINGS = {
+    ("lock-order", "lock_order_cycle.py", 19),
+    ("lock-blocking-call", "sleep_under_lock.py", 18),
+    ("lock-blocking-call", "sleep_under_lock.py", 23),
+    ("lock-callback", "sleep_under_lock.py", 27),
+    ("lock-blocking-call", "sleep_under_lock.py", 35),
+    ("lock-blocking-call", "sleep_under_lock.py", 39),
+    ("jit-host-sync", "jit_hazards_fx.py", 16),
+    ("jit-trace-branch", "jit_hazards_fx.py", 22),
+    ("jit-host-sync", "jit_hazards_fx.py", 24),
+    ("jit-nondeterminism", "jit_hazards_fx.py", 29),
+    ("jit-static-unhashable", "jit_hazards_fx.py", 34),
+    ("jit-host-sync", "jit_hazards_fx.py", 47),
+    ("env-knobs", "env_knob_fx.py", 8),
+    ("metric-names", "metric_names_fx.py", 7),
+    ("metric-names", "metric_names_fx.py", 8),
+    ("metric-names", "metric_names_fx.py", 9),
+    ("wire-pickle", "wire_pickle_fx.py", 12),
+    ("wire-pickle", "wire_pickle_fx.py", 16),
+    ("wire-pickle", "wire_pickle_fx.py", 20),
+}
+
+
+def test_fixture_violations_found_at_exact_lines():
+    from paddle_tpu.analysis import core
+    run = core.run(LINT_FIXTURES)
+    got = {(f.rule, os.path.basename(f.path), f.line)
+           for f in run.findings}
+    assert got == EXPECTED_FIXTURE_FINDINGS, (
+        f"missing={EXPECTED_FIXTURE_FINDINGS - got} "
+        f"unexpected={got - EXPECTED_FIXTURE_FINDINGS}")
+
+
+def test_engine_parses_each_file_exactly_once():
+    """All rules share ONE ast.parse per file (the acceptance
+    contract); rules never re-parse."""
+    import ast as ast_mod
+
+    from paddle_tpu.analysis import core
+    counts = {}
+    real = ast_mod.parse
+
+    def counting(src, filename="<unknown>", *a, **kw):
+        counts[filename] = counts.get(filename, 0) + 1
+        return real(src, filename, *a, **kw)
+
+    ast_mod.parse = counting
+    try:
+        core.run(LINT_FIXTURES)   # every rule selected
+    finally:
+        ast_mod.parse = real
+    fixture_counts = {os.path.basename(p): n for p, n in counts.items()
+                      if p.startswith(LINT_FIXTURES)}
+    assert fixture_counts and \
+        set(fixture_counts.values()) == {1}, fixture_counts
+
+
+def test_rule_subset_selection():
+    from paddle_tpu.analysis import core
+    run = core.run(LINT_FIXTURES, rule_names=["wire-pickle"])
+    assert {f.rule for f in run.findings} == {"wire-pickle"}
+    assert len(run.findings) == 3
+    with pytest.raises(KeyError):
+        core.run(LINT_FIXTURES, rule_names=["no-such-rule"])
+
+
+def test_finding_keys_are_content_based_not_positional():
+    """Baseline keys must survive fixing a SIBLING finding in the same
+    file: content-based, with #2.. suffixes only for true repeats —
+    never a positional index over all hits."""
+    from paddle_tpu.analysis import core
+    run = core.run(LINT_FIXTURES, rule_names=["wire-pickle"])
+    assert sorted(f.key for f in run.findings) == [
+        "wire-pickle::wire_pickle_fx.py::L(...)",
+        "wire-pickle::wire_pickle_fx.py::np.load(allow_pickle=True)",
+        "wire-pickle::wire_pickle_fx.py::pkl.loads",
+    ]
+
+
+def test_subtree_scan_matches_full_tree_baseline_keys():
+    """Keys embed the file's FULL-TREE-relative path whatever the scan
+    root, so a `--root paddle_tpu/distributed` run matches the same
+    baseline entries as the full run (pre-fix every baselined finding
+    there re-surfaced as new under a shifted key)."""
+    from paddle_tpu.analysis import core
+    run = core.run(os.path.join(REPO, "paddle_tpu", "distributed"))
+    core.apply_baseline(run)
+    assert run.new == [], [f.key for f in run.new]
+    assert run.stale == []      # a subtree can't prove staleness
+    assert run.baselined        # rpc/PS entries matched under the
+    #                             same keys the full-tree run uses
+
+
+def test_nonexistent_root_errors_instead_of_green_zero_file_scan():
+    from paddle_tpu.analysis import core
+    with pytest.raises(FileNotFoundError):
+        core.run("/nonexistent-analysis-root")
+    res = _run_cli("--root", "/nonexistent-analysis-root")
+    assert res.returncode == 2
+    assert "does not exist" in res.stderr
+
+
+def test_cli_json_output_on_fixtures():
+    res = _run_cli("--root", LINT_FIXTURES, "--no-baseline", "--json")
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["ok"] is False
+    got = {(f["rule"], os.path.basename(f["file"]), f["line"])
+           for f in doc["new"]}
+    assert got == EXPECTED_FIXTURE_FINDINGS
+    # findings carry file:line + stable keys
+    assert all(f["key"].startswith(f["rule"] + "::")
+               for f in doc["new"])
+
+
+# ---------------------------------------------------------------------------
+# baseline: shrink-only ratchet
+# ---------------------------------------------------------------------------
+
+def _fixture_run():
+    from paddle_tpu.analysis import core
+    return core, core.run(LINT_FIXTURES,
+                          rule_names=["lock-blocking-call"])
+
+
+def test_baseline_suppresses_justified_findings(tmp_path):
+    core, run = _fixture_run()
+    keys = sorted(f.key for f in run.findings)
+    assert len(keys) == 4
+    bl = {"lock-blocking-call": [
+        {"key": keys[0], "why": "fixture: accepted for the test"}]}
+    core.apply_baseline(run, baseline=bl)
+    assert len(run.baselined) == 1
+    assert len(run.new) == 3 and run.failures == 3
+
+
+def test_baseline_unjustified_entry_fails(tmp_path):
+    core, run = _fixture_run()
+    key = run.findings[0].key
+    bl = {"lock-blocking-call": [{"key": key, "why": "  "}]}
+    core.apply_baseline(run, baseline=bl)
+    assert ("lock-blocking-call", key) in run.unjustified
+    assert run.failures > 0
+    assert "no 'why'" in core.render_text(run)
+
+
+def test_baseline_update_is_shrink_only(tmp_path):
+    """--baseline update deletes STALE entries and nothing else: it
+    never adds entries for new findings and never touches rules that
+    did not run (staleness is only decided on a full default-tree
+    scan — a subtree/rule-subset run cannot prove a finding gone)."""
+    from paddle_tpu.analysis import core
+    run = core.run(rule_names=["lock-blocking-call"])  # default tree
+    keys = sorted(f.key for f in run.findings)
+    assert keys, "expected the baselined lock findings on the tree"
+    path = str(tmp_path / "baseline.json")
+    core.save_baseline({
+        "lock-blocking-call": [
+            {"key": keys[0], "why": "kept: finding still present"},
+            {"key": "lock-blocking-call::gone.py::f::open",
+             "why": "stale: was fixed"}],
+        "lock-callback": [
+            {"key": "lock-callback::other.py::f::cb",
+             "why": "rule not run: must survive the update"}]}, path)
+    core.apply_baseline(run, baseline=core.load_baseline(path),
+                        update=True, path=path)
+    assert ("lock-blocking-call",
+            "lock-blocking-call::gone.py::f::open") in run.stale
+    after = core.load_baseline(path)
+    kept = [e["key"] for e in after["lock-blocking-call"]]
+    assert kept == [keys[0]]        # stale deleted, live kept
+    # the not-run rule's entry was NOT judged or pruned
+    assert [e["key"] for e in after["lock-callback"]] == \
+        ["lock-callback::other.py::f::cb"]
+    # still-unbaselined findings were NOT auto-added
+    assert len(run.new) == len(keys) - 1
+
+
+def test_rule_subset_does_not_stale_other_rules_baseline():
+    """`--rule wire-pickle` on the clean tree must exit 0: the lock
+    rules' baseline entries are out of scope, not stale (pre-fix this
+    reported every other rule's entry stale and `--baseline update`
+    would have deleted them all)."""
+    res = _run_cli("--rule", "wire-pickle")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "stale" not in res.stdout
+
+
+def test_subtree_scan_keeps_shipped_tree_exemptions():
+    """`--root paddle_tpu/<subtree>` judges files by their position
+    in the SHIPPED tree: fluid's legacy disk-archive pickle stays
+    exempt from the wire rule, registry.py stays exempt from the
+    metric-name scan, and REQUIRED_METRICS is not enforced against a
+    partial view."""
+    from paddle_tpu.analysis import core
+    run = core.run(os.path.join(REPO, "paddle_tpu", "fluid"),
+                   rule_names=["wire-pickle"])
+    assert run.findings == [], [f.location() for f in run.findings]
+    run2 = core.run(os.path.join(REPO, "paddle_tpu", "observability"),
+                    rule_names=["metric-names"])
+    assert run2.findings == [], [f.location() for f in run2.findings]
+
+
+# ---------------------------------------------------------------------------
+# legacy script wrappers (identical behavior; logic lives in the engine)
+# ---------------------------------------------------------------------------
+
+def test_script_wrappers_share_engine_logic_and_stay_green():
+    for script in ("check_no_wire_pickle.py", "check_metric_names.py",
+                   "check_env_knobs.py"):
+        path = os.path.join(REPO, "scripts", script)
+        src = open(path, encoding="utf-8").read()
+        assert "load_invariants" in src, f"{script} is not a wrapper"
+        res = subprocess.run([sys.executable, path],
+                             capture_output=True, text=True,
+                             timeout=60)
+        assert res.returncode == 0, (script, res.stdout, res.stderr)
+        assert res.stdout.startswith("OK:"), (script, res.stdout)
+
+
+def test_wrapper_and_engine_agree_on_wire_fixture():
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_no_wire_pickle.py"),
+         LINT_FIXTURES],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 1
+    for line in (12, 16, 20):
+        assert f"wire_pickle_fx.py:{line}" in res.stdout
+
+
+def test_required_metrics_importable_from_wrapper():
+    # tests/test_debug_postmortem.py ratchets against this surface
+    from scripts.check_metric_names import REQUIRED_METRICS
+    assert "paddle_tpu_watchdog_stalls_total" in REQUIRED_METRICS
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order sanitizer (analysis/lockcheck.py)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lockcheck():
+    from paddle_tpu.analysis import lockcheck as lc
+    lc.reset()
+    yield lc
+    lc.uninstall()
+    lc.reset()
+
+
+def test_lockcheck_catches_abba_cycle(lockcheck):
+    a = lockcheck.checked_lock("fx:a")
+    b = lockcheck.checked_lock("fx:b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockcheck.LockOrderError) as ei:
+        with b:
+            with a:
+                pass
+    assert "fx:a" in str(ei.value) and "fx:b" in str(ei.value)
+    assert lockcheck.violations()[0]["cycle"]
+
+
+def test_lockcheck_consistent_order_and_reentry_are_clean(lockcheck):
+    a = lockcheck.checked_lock("fx2:a")
+    b = lockcheck.checked_lock("fx2:b")
+    r = lockcheck.checked_rlock("fx2:r")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    with r:
+        with r:             # RLock re-entry: no self-edge
+            with a:
+                pass
+    assert lockcheck.violations() == []
+    g = lockcheck.graph()
+    assert "fx2:b" in g["fx2:a"] and "fx2:a" in g["fx2:r"]
+
+
+def test_lockcheck_condition_wait_releases(lockcheck):
+    r = lockcheck.checked_rlock("fxc:r")
+    cond = lockcheck.checked_condition(r)
+    woke = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=2)
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:             # acquirable => wait() released the lock
+        cond.notify_all()
+    t.join(3)
+    assert woke.is_set() and lockcheck.violations() == []
+
+
+def test_lockcheck_trylock_inversion_is_not_a_cycle(lockcheck):
+    """Trylock / timed acquires are deadlock-AVOIDANCE patterns: they
+    must neither raise nor poison the graph with their intentional
+    inversions."""
+    a = lockcheck.checked_lock("fxt:a")
+    b = lockcheck.checked_lock("fxt:b")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(False)       # opposite order, non-blocking
+        a.release()
+        assert a.acquire(timeout=0.2)  # opposite order, bounded
+        a.release()
+    assert lockcheck.violations() == []
+    assert "fxt:a" not in lockcheck.graph().get("fxt:b", [])
+    with a:                            # original order still clean
+        with b:
+            pass
+    assert lockcheck.violations() == []
+
+
+def test_lockcheck_condition_wait_at_depth_two_keeps_tracking(
+        lockcheck):
+    """Condition.wait under RLock recursion depth 2: the restored
+    held-entry must carry the SAVED depth, so releasing one level
+    keeps the lock tracked and later edges are still recorded."""
+    r = lockcheck.checked_rlock("fxd:r")
+    other = lockcheck.checked_lock("fxd:o")
+    cond = lockcheck.checked_condition(r)
+    woke = threading.Event()
+
+    def waiter():
+        with cond:                 # depth 1
+            with cond:             # depth 2
+                cond.wait(timeout=2)
+            with other:            # r still held: edge r -> o
+                pass
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with cond:
+        cond.notify_all()
+    t.join(3)
+    assert woke.is_set() and lockcheck.violations() == []
+    assert "fxd:o" in lockcheck.graph().get("fxd:r", [])
+
+
+def test_lockcheck_warn_mode_records_without_raising(lockcheck,
+                                                     capsys):
+    lockcheck.install(mode="warn", scope=("nothing_matches",))
+    a = lockcheck.checked_lock("fxw:a")
+    b = lockcheck.checked_lock("fxw:b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:            # inversion: recorded, not raised
+            pass
+    assert len(lockcheck.violations()) == 1
+    rep = lockcheck.report()
+    assert rep["mode"] == "warn" and rep["violations"]
+    json.dumps(rep)    # the JSON-safe contract holds WITH a violation
+    assert "lock-order cycle" in capsys.readouterr().err
+
+
+def test_lockcheck_sanitizer_catches_cycled_fixture(lockcheck):
+    """The intentionally-cycled lint fixture (the static lock-order
+    rule's seed) deadlock-trips the RUNTIME sanitizer too: static and
+    dynamic models agree on the same code."""
+    import importlib.util
+    lockcheck.install(scope=("lint_fixture_",))
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "lint_fixture_lock_cycle",
+            os.path.join(LINT_FIXTURES, "lock_order_cycle.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        bank = mod.Bank()
+        assert "lockcheck" in repr(bank._accounts)  # instrumented
+        bank.transfer(5)                 # accounts -> audit
+        with pytest.raises(lockcheck.LockOrderError):
+            bank.report()                # audit -> accounts: cycle
+    finally:
+        lockcheck.uninstall()
+        sys.modules.pop("lint_fixture_lock_cycle", None)
+
+
+def test_lockcheck_env_install_wraps_only_scoped_locks():
+    """PADDLE_TPU_LOCKCHECK=1: paddle_tpu/__init__ installs the
+    sanitizer before any framework lock exists — framework locks are
+    proxies, out-of-scope (user/stdlib) locks stay raw."""
+    code = (
+        "import os, threading\n"
+        "import paddle_tpu\n"
+        "from paddle_tpu.analysis import lockcheck\n"
+        "assert lockcheck.installed()\n"
+        "raw = threading.Lock()\n"                 # __main__: no scope
+        "assert 'lockcheck' not in repr(raw)\n"
+        "from paddle_tpu.serving.kv_cache import PagePool\n"
+        "p = PagePool(4, 2)\n"
+        "assert 'lockcheck' in repr(p._lock), repr(p._lock)\n"
+        "p.alloc_table(4)\n"                        # exercises acquire
+        "from paddle_tpu.observability import registry as obs\n"
+        "obs.prometheus_text()\n"
+        "assert lockcheck.violations() == []\n"
+        "print('LOCKCHECK_OK')\n")
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=180, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "LOCKCHECK_OK" in res.stdout
+
+
+def test_threaded_module_clean_under_lockcheck():
+    """Tier-1 dynamic validation: the representative threaded serving
+    module (SLO harness: engine + scheduler + frontend + PS chaos
+    drills) runs green with every paddle_tpu lock order-checked. A
+    cycle anywhere raises LockOrderError and fails the inner run."""
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "test_slo_harness.py"),
+         "-q", "-x", "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# the two concurrency findings this PR FIXED (regression pins)
+# ---------------------------------------------------------------------------
+
+def test_warm_start_reads_checkpoint_off_the_step_lock(tmp_path):
+    """lock-blocking-call fix: Engine.warm_start used to run the whole
+    checkpoint restore (disk I/O) under the engine step lock. Now the
+    read phase runs off-lock — the engine keeps serving while the read
+    is in flight — and only the in-memory adopt takes the lock."""
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving.engine import Engine
+    from paddle_tpu.serving.model import GPTDecodeModel
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64)
+    src = GPTDecodeModel(cfg, seed=3)
+    root = str(tmp_path / "gpt")
+    src.save_checkpoint(root)
+
+    live = GPTDecodeModel(cfg, seed=9)
+    eng = Engine(live, num_slots=2, num_pages=16, page_size=4)
+    prompt = np.array([1, 2, 3], np.int32)
+    with eng:
+        baseline = eng.generate(prompt, 8)
+
+    in_read, release = threading.Event(), threading.Event()
+    orig_read = live.read_checkpoint
+
+    def gated_read(r, step=None):
+        in_read.set()
+        assert release.wait(10), "warm_start never released"
+        return orig_read(r, step=step)
+
+    live.read_checkpoint = gated_read
+    t = threading.Thread(target=eng.warm_start, args=(root,))
+    t.start()
+    try:
+        assert in_read.wait(10)
+        # the step lock must be FREE during the whole disk phase...
+        assert eng._lock.acquire(timeout=2), \
+            "step lock held during checkpoint read"
+        eng._lock.release()
+        # ...so the engine can still serve end-to-end (this drives
+        # step() -> the lock is taken and released repeatedly)
+        req = eng.submit(prompt, max_new_tokens=4)
+        eng.run_until_idle()
+        assert req.status == "done"
+        np.testing.assert_array_equal(np.asarray(req.generated),
+                                      baseline[:4])  # old weights
+    finally:
+        release.set()
+        t.join(10)
+    assert not t.is_alive()
+    # the flip DID land: serving now matches the checkpointed model
+    with eng:
+        warmed = eng.generate(prompt, 8)
+    eref = Engine(GPTDecodeModel(cfg, seed=3), num_slots=2,
+                  num_pages=16, page_size=4)
+    with eref:
+        expect = eref.generate(prompt, 8)
+    np.testing.assert_array_equal(warmed, expect)
+
+
+def test_gauge_set_function_runs_outside_series_lock():
+    """lock-callback fix: gauge set_function callbacks used to run
+    under the series lock — a callback taking any lock whose holder
+    writes metrics closed a deadlock cycle. Deterministic repro: the
+    writer holds L and sets the gauge; the reader's callback waits for
+    L. Pre-fix this deadlocked (reader held the series lock the
+    writer's set() needed); post-fix both finish."""
+    from paddle_tpu.observability import registry as obs
+
+    g = obs.REGISTRY.gauge("paddle_tpu_test_gauge_fn_outside_lock",
+                           "regression pin for the callback fix")
+    L = threading.Lock()
+    in_fn = threading.Event()
+
+    def fn():
+        in_fn.set()
+        with L:
+            return 7.0
+
+    g.set_function(fn)
+    got = {}
+
+    def writer():
+        with L:
+            assert in_fn.wait(5)
+            g.set(3.0)          # pre-fix: blocks on the series lock
+
+    def reader():
+        got["v"] = g.value      # evaluates fn()
+
+    wt = threading.Thread(target=writer)
+    rt = threading.Thread(target=reader)
+    wt.start()
+    time.sleep(0.05)            # let the writer take L first
+    rt.start()
+    rt.join(5)
+    wt.join(5)
+    assert not rt.is_alive() and not wt.is_alive(), \
+        "gauge callback deadlocked against a metric writer"
+    assert got["v"] == 7.0
+
+
+def test_static_rules_would_recatch_the_fixed_patterns(tmp_path):
+    """The two fixed findings stay fixed: re-introduce each shape in a
+    scratch file and assert the rules flag it (so the fix + rule pair
+    is a real ratchet, not a one-off)."""
+    from paddle_tpu.analysis import core
+    bad = tmp_path / "relapse.py"
+    bad.write_text(
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self, model, fn):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.model = model\n"
+        "        self._fn = fn\n"
+        "    def warm_start(self, root):\n"
+        "        with self._lock:\n"
+        "            self.model.load_checkpoint(root)\n"
+        "    def value(self):\n"
+        "        with self._lock:\n"
+        "            return self._fn()\n")
+    run = core.run(str(tmp_path))
+    rules = {(f.rule, f.line) for f in run.findings}
+    assert ("lock-blocking-call", 9) in rules   # load under lock
+    assert ("lock-callback", 12) in rules       # callback under lock
