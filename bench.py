@@ -38,9 +38,13 @@ dedup ratio on a 1%-mutated state, async-vs-sync save step overhead,
 <5% bar) | slo (open-loop traffic replay against the serving tier:
 SLO attainment, goodput, p99 TTFT/ITL) | chaos (same seeded traffic +
 a serving_decode stall mid-run: watchdog detection + recovery seconds
-and post-recovery SLO delta vs the fault-free baseline) | kernels
-(per-kernel fused-vs-unfused speedups for the epilogue-fused decoder
-sub-blocks + autobench tuning-cache cold/warm first-call latency).
+and post-recovery SLO delta vs the fault-free baseline) | router
+(replicated fleet behind the fault-tolerant router: one replica killed
+mid-run under wire traffic — failover detect + respawn recovery
+seconds, post-recovery attainment delta, wire TTFT via streaming) |
+kernels (per-kernel fused-vs-unfused speedups for the epilogue-fused
+decoder sub-blocks + autobench tuning-cache cold/warm first-call
+latency).
 """
 from __future__ import annotations
 
@@ -832,6 +836,129 @@ def bench_chaos(duration=8.0, rate=25.0, seed=7, stall_s=0.8,
             "offered_rate_rps": rate, "duration_s": duration}
 
 
+def bench_router(duration=8.0, rate=25.0, seed=7, kill_at=2.5):
+    """BENCH_CONFIG=router (docs/SERVING.md replicated serving): the
+    SAME seeded traffic replayed twice over the WIRE through the
+    fault-tolerant router fronting two replicas — fault-free baseline,
+    then with one replica killed mid-run (listener + live connections
+    severed, decode loop halted). Reports failover detect seconds
+    (kill -> replica out of rotation), recovery seconds (kill ->
+    respawned-from-checkpoint replica healthy again), post-recovery
+    attainment delta vs the baseline's identical traffic slice, and
+    wire TTFT (streaming generate), mirroring BENCH_CONFIG=chaos."""
+    import tempfile
+    import threading
+
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving import (GPTDecodeModel, InProcessReplica,
+                                    LoadGenerator, Router,
+                                    ServingClient, slo_report)
+
+    root = os.path.join(tempfile.mkdtemp(prefix="bench_router_"), "gpt")
+    cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                    max_position_embeddings=256, vocab_size=4096)
+    GPTDecodeModel(cfg, seed=0).save_checkpoint(root)
+    engine_kw = dict(num_slots=8, num_pages=128, page_size=8,
+                     max_seq_len=96)
+
+    def fleet():
+        reps = []
+        for i in range(2):
+            r = InProcessReplica(root, name=f"rep{i}",
+                                 engine_kw=engine_kw)
+            r.start()
+            for plen in (4, 8, 16, 32):   # compile outside the window
+                r.engine.submit(np.full((plen,), 1, np.int32), 2)
+            r.engine.run_until_idle()
+            reps.append(r)
+        router = Router("127.0.0.1:0",
+                        replicas=[r.spec() for r in reps],
+                        ping_interval=0.2, ping_timeout=1.0,
+                        suspect_after=1, dead_after=2, token_stall=5.0,
+                        respawn_cooldown=0.5)
+        return router, reps
+
+    mk_gen = lambda name: LoadGenerator(
+        _slo_traffic(duration, rate, seed), name=name)
+
+    router_a, reps_a = fleet()
+    with router_a:
+        cli = ServingClient(router_a.endpoint)
+        res_a = mk_gen("router_base").run_client(cli, timeout=120)
+        res_a.wait(300)
+        cli.close()
+    for r in reps_a:
+        r.stop()
+    base = slo_report(res_a)
+
+    router_b, reps_b = fleet()
+    detect_s = recovery_s = None
+    t_kill = None
+    with router_b:
+        cli = ServingClient(router_b.endpoint)
+        box = []
+        runner = threading.Thread(
+            target=lambda: box.append(
+                mk_gen("router_fault").run_client(cli, timeout=120)),
+            daemon=True)
+        runner.start()
+        time.sleep(kill_at)
+        t_kill = time.monotonic()
+        reps_b[1].kill()
+        while time.monotonic() - t_kill < 60 \
+                and (detect_s is None or recovery_s is None):
+            state = router_b.stats()["replicas"]["rep1"]["state"]
+            if detect_s is None and state != "healthy":
+                detect_s = time.monotonic() - t_kill
+            if detect_s is not None and state == "healthy":
+                recovery_s = time.monotonic() - t_kill
+            time.sleep(0.05)
+        runner.join(300)
+        res_b = box[0] if box else None
+        if res_b is not None:
+            res_b.wait(300)
+        cli.close()
+    for r in reps_b:
+        r.stop()
+    faulted = slo_report(res_b) if res_b is not None else None
+    fo = REGISTRY.get("paddle_tpu_router_failovers_total")
+    failovers = sum(s.value for lv, s in fo._series()
+                    if lv[0] == router_b.router_id)
+    post = post_base = None
+    if res_b is not None and recovery_s is not None:
+        rec_off = (t_kill + recovery_s) - res_b.started_at
+        if rec_off < duration - 0.5:
+            post = slo_report(res_b, window=(rec_off, float("inf")),
+                              gen="router_post")
+            post_base = slo_report(res_a,
+                                   window=(rec_off, float("inf")),
+                                   gen="router_post_base")
+    delta = None
+    if post is not None and post_base is not None \
+            and post_base["attainment"] is not None:
+        delta = round(post_base["attainment"] - post["attainment"], 4)
+    return {"metric": "serving_router_slo_delta", "value": delta,
+            "unit": "attainment_drop_post_recovery",
+            "fault": f"replica kill @ {kill_at}s of {duration}s",
+            "detect_s": None if detect_s is None
+            else round(detect_s, 3),
+            "recovery_s": None if recovery_s is None
+            else round(recovery_s, 3),
+            "failovers": int(failovers),
+            "baseline_attainment": base["attainment"],
+            "faulted_attainment": None if faulted is None
+            else faulted["attainment"],
+            "post_recovery_attainment": None if post is None
+            else post["attainment"],
+            "post_recovery_baseline": None if post_base is None
+            else post_base["attainment"],
+            "wire_ttft_ms_p50": base["ttft_ms_p50"],
+            "wire_ttft_ms_p99": base["ttft_ms_p99"],
+            "wire_itl_ms_p99": base["itl_ms_p99"],
+            "offered_rate_rps": rate, "duration_s": duration}
+
+
 def _bench_serving_toggle_overhead(set_enabled, metric_name, steps=200,
                                    hidden=256, layers=4, heads=4,
                                    slots=4, seed=0):
@@ -1279,6 +1406,8 @@ def main():
         rec = bench_slo()
     elif which == "chaos":
         rec = bench_chaos()
+    elif which == "router":
+        rec = bench_router()
     elif which == "metrics_overhead":
         rec = bench_metrics_overhead()
     elif which == "flight_overhead":

@@ -199,6 +199,16 @@ REQUIRED_METRICS = {
     "paddle_tpu_serving_expired_in_queue_total",
     "paddle_tpu_serving_shed_total",
     "paddle_tpu_serving_quota_rejected_total",
+    # serving router (docs/SERVING.md replicated serving): failover,
+    # replica health and respawn visibility is the fleet's acceptance
+    # contract — the chaos drills assert against these exact names
+    "paddle_tpu_router_requests_total",
+    "paddle_tpu_router_dispatch_total",
+    "paddle_tpu_router_failovers_total",
+    "paddle_tpu_router_replica_state",
+    "paddle_tpu_router_respawns_total",
+    "paddle_tpu_router_stream_stalls_total",
+    "paddle_tpu_router_inflight",
     # autobench persistent tuning cache (docs/KERNELS.md): whether a
     # replica is measuring in-process (cold) or adopting pre-warmed
     # decisions (hit) is the cache's acceptance contract

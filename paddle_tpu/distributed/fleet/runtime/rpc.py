@@ -30,7 +30,19 @@ Client semantics (`RpcClient.call`):
   * per-request deadline + per-attempt timeout,
   * exponential backoff with jitter, bounded retries/reconnects,
   * a stable request id across retries; the server dedups mutating ops
-    by id, so a retried gradient push is applied exactly once.
+    by id, so a retried gradient push is applied exactly once. Callers
+    that own failover across SERVERS (the serving router) can pin the
+    id themselves via ``req_id=`` so a replay on whichever replica —
+    original or survivor — carries the same identity.
+
+Server-push streaming: a dispatch function may return a GENERATOR.
+`serve_connection` then sends every yielded object as an ``F_STREAM``
+frame (same request id) and the generator's return value as the normal
+final reply — which is what the dedup cache memoises, so a retried
+streamed op is answered with the final frame only. Clients consume the
+pushed frames via ``call(..., on_stream=fn)``; the per-attempt socket
+timeout bounds the INTER-FRAME gap, which is how the serving router
+detects a replica wedged mid-generation (docs/SERVING.md).
 """
 from __future__ import annotations
 
@@ -44,6 +56,7 @@ import socket
 import struct
 import threading
 import time
+import types
 import zlib
 
 import numpy as np
@@ -56,7 +69,7 @@ __all__ = [
     "WireError", "PSAuthError", "PSRemoteError", "PSDeadlineError",
     "encode_body", "decode_body", "send_frame", "recv_frame",
     "TransportStats", "RpcClient", "DedupCache", "RpcServerState",
-    "serve_connection", "PROTOCOL_VERSION", "TRACE_KEY",
+    "serve_connection", "PROTOCOL_VERSION", "TRACE_KEY", "F_STREAM",
 ]
 
 PROTOCOL_VERSION = 1
@@ -91,6 +104,7 @@ _HDR = struct.Struct("<HBBQIQ")      # magic, ver, flags, req_id, crc, len
 HEADER_SIZE = _HDR.size
 F_ERROR = 1
 F_HANDSHAKE = 2
+F_STREAM = 4                         # server-push frame; more follow
 _MAX_BODY = 1 << 31                  # sanity bound on a length field
 
 _ND_KEY = "__nd__"
@@ -390,6 +404,7 @@ class RpcClient:
         # namespaces the 32-bit sequence
         self._token = int.from_bytes(os.urandom(4), "little")
         self._seq = 0
+        self._streaming = False      # call_stream exclusivity guard
 
     def _next_id(self) -> int:
         self._seq = (self._seq + 1) & 0xFFFFFFFF
@@ -419,11 +434,21 @@ class RpcClient:
             self._sock = None
 
     def call(self, req, timeout: float | None = None,
-             deadline: float | None = None):
+             deadline: float | None = None, on_stream=None,
+             req_id: int | None = None):
         """One request/reply round-trip; retried with the same request
         id until success, the deadline, or the retry bound. The span's
         trace id rides in the skeleton (TRACE_KEY) so the server side
-        of this call joins the same trace."""
+        of this call joins the same trace.
+
+        ``on_stream`` receives every F_STREAM frame the server pushes
+        before the final reply (streamed ops); the per-attempt timeout
+        then bounds the INTER-FRAME gap, not the whole call. Pushed
+        frames are advisory progress — on a retry the final reply is
+        the authoritative result (a dedup hit replays no stream
+        frames). ``req_id`` pins the wire request id (serving-router
+        failover: the SAME id must ride the replay on a surviving
+        replica so a later retry against the original still dedups)."""
         op = req.get("op") if isinstance(req, dict) else None
         with _tracing.span("rpc.client", op=op or "?",
                            endpoint=self.endpoint) as sp:
@@ -431,7 +456,9 @@ class RpcClient:
                 req = {**req, TRACE_KEY: sp.trace_id}
             t_call = time.monotonic()
             try:
-                rep = self._call_locked(req, timeout, deadline)
+                rep = self._call_locked(req, timeout, deadline,
+                                        on_stream=on_stream,
+                                        req_id=req_id)
             except Exception as e:
                 _flight.record("rpc", "client_error",
                                trace_id=sp.trace_id, op=op or "?",
@@ -445,11 +472,11 @@ class RpcClient:
                            seconds=round(dt, 6))
             return rep
 
-    def _call_locked(self, req, timeout, deadline):
+    def _call_locked(self, req, timeout, deadline, on_stream=None,
+                     req_id=None):
         per_attempt = timeout if timeout is not None else self.timeout
         deadline_ts = time.monotonic() + (
             deadline if deadline is not None else self.deadline)
-        req_id = None
         attempt = 0
         last: Exception | None = None
         with self._lock:
@@ -470,11 +497,23 @@ class RpcClient:
                     s.settimeout(min(per_attempt, max(remaining, 0.1)))
                     n_out = send_frame(s, req, req_id=req_id,
                                        side="client")
-                    rep, rid, flags, n_in = recv_frame(s, side="client")
-                    self.stats.add_bytes(n_out, n_in)
-                    if rid != req_id:
-                        raise WireError(
-                            f"reply id {rid:#x} != request {req_id:#x}")
+                    while True:
+                        rep, rid, flags, n_in = recv_frame(
+                            s, side="client")
+                        self.stats.add_bytes(n_out, n_in)
+                        n_out = 0
+                        if rid != req_id:
+                            raise WireError(
+                                f"reply id {rid:#x} != "
+                                f"request {req_id:#x}")
+                        if not flags & F_STREAM:
+                            break
+                        # pushed progress frame: hand to the consumer,
+                        # keep the attempt open. The socket timeout set
+                        # above bounds the gap to the NEXT frame — a
+                        # wedged streamer surfaces as socket.timeout.
+                        if on_stream is not None:
+                            on_stream(rep)
                     if flags & F_ERROR:
                         self.stats.add("remote_errors")
                         msg = rep.get("error", "remote error") \
@@ -500,6 +539,76 @@ class RpcClient:
                 pause = min(self.backoff * (2 ** (attempt - 1)),
                             self.backoff_max)
                 time.sleep(pause * (0.5 + random.random()))
+
+    def call_stream(self, req, req_id: int | None = None,
+                    timeout: float | None = None,
+                    stream_timeout: float | None = None):
+        """Single-attempt streaming call: a GENERATOR yielding each
+        F_STREAM frame the server pushes, returning the final reply as
+        its StopIteration value. No internal retry — the caller owns
+        failover (the serving router replays on a different replica
+        with the SAME ``req_id`` so dedup still holds; docs/SERVING.md).
+
+        ``timeout`` bounds the wait for the FIRST frame (queueing +
+        prefill happen before any token); ``stream_timeout`` bounds
+        every later INTER-FRAME gap — a replica wedged mid-generation
+        surfaces as socket.timeout here, which is the router's
+        mid-stream stall signal. Transport errors propagate raw; the
+        connection is dropped on any abnormal exit (including an
+        abandoned generator) because a half-consumed stream desyncs it.
+
+        The caller must own this client exclusively for the stream's
+        lifetime (the router's per-replica pool guarantees it); unlike
+        ``call()`` no channel lock is held across the yields, so
+        concurrent use is a caller bug — guarded by a busy flag."""
+        if self._streaming:
+            raise RuntimeError("call_stream: client already streaming")
+        op = req.get("op") if isinstance(req, dict) else None
+        first_t = timeout if timeout is not None else self.timeout
+        gap_t = stream_timeout if stream_timeout is not None else first_t
+        self._streaming = True
+        ok = False
+        try:
+            with _tracing.span("rpc.client_stream", op=op or "?",
+                               endpoint=self.endpoint) as sp:
+                if isinstance(req, dict) and TRACE_KEY not in req:
+                    req = {**req, TRACE_KEY: sp.trace_id}
+                self.stats.add("requests")
+                if self._sock is None:
+                    self._connect(min(5.0, first_t))
+                rid = req_id if req_id is not None else self._next_id()
+                s = self._sock
+                s.settimeout(first_t)
+                n_out = send_frame(s, req, req_id=rid, side="client")
+                first = True
+                while True:
+                    try:
+                        rep, r_rid, flags, n_in = recv_frame(
+                            s, side="client")
+                    except socket.timeout:
+                        self.stats.add("timeouts")
+                        raise
+                    self.stats.add_bytes(n_out, n_in)
+                    n_out = 0
+                    if r_rid != rid:
+                        raise WireError(f"reply id {r_rid:#x} != "
+                                        f"request {rid:#x}")
+                    if flags & F_ERROR:
+                        self.stats.add("remote_errors")
+                        msg = rep.get("error", "remote error") \
+                            if isinstance(rep, dict) else str(rep)
+                        raise PSRemoteError(msg)
+                    if not flags & F_STREAM:
+                        ok = True
+                        return rep
+                    if first:
+                        first = False
+                        s.settimeout(gap_t)
+                    yield rep
+        finally:
+            self._streaming = False
+            if not ok:
+                self._drop()
 
     def close(self):
         with self._lock:
@@ -636,8 +745,14 @@ class RpcServerState:
 
     def __init__(self, read_ops=frozenset(), secret: str | None = None,
                  dedup_capacity: int = 65536, after_commit=None,
-                 commit_scope=None, after_retry=None):
+                 commit_scope=None, after_retry=None,
+                 expose_req_id: bool = False):
         self.read_ops = frozenset(read_ops)
+        # inject the wire request id into the skeleton as "_req_id"
+        # before dispatch: the serving router pins its DOWNSTREAM call
+        # ids to the upstream id so a failover replay carries the same
+        # identity on whichever replica serves it
+        self.expose_req_id = bool(expose_req_id)
         self.secret = secret if secret is not None \
             else os.environ.get("PADDLE_PS_SECRET")
         self.dedup = DedupCache(dedup_capacity)
@@ -667,15 +782,39 @@ class RpcServerState:
         self.journal = None
 
 
+def _drain_stream(sock: socket.socket, gen, req_id: int):
+    """Send every object a generator dispatch yields as an F_STREAM
+    frame; its return value is the final reply. A dead client surfaces
+    as a ConnectionError from the frame send — the generator is closed
+    (GeneratorExit at its yield point lets the dispatcher cancel
+    whatever produced the stream) and the error propagates like any
+    dispatch failure."""
+    try:
+        while True:
+            try:
+                item = next(gen)
+            except StopIteration as stop:
+                return stop.value if stop.value is not None else {}
+            send_frame(sock, item, req_id=req_id, flags=F_STREAM,
+                       side="server")
+    finally:
+        gen.close()
+
+
 def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
     """One connection's request loop. Application errors become error
     frames; transport errors end the connection (the client's retry
-    path owns recovery)."""
-    inj = injector()
+    path owns recovery). A dispatch that returns a GENERATOR streams:
+    yielded objects go out as F_STREAM frames, the generator's return
+    value is the final (dedup-memoised) reply."""
     try:
         server_handshake(sock, state.secret)
         while True:
             req, req_id, _flags, _n = recv_frame(sock, side="server")
+            # re-read the injector each request: a chaos drill that
+            # (re)arms the knobs mid-run must hit connections that
+            # were already open (send_frame reads it per frame too)
+            inj = injector()
             armed = inj.count_request() if inj.active else False
             if inj.active:
                 inj.maybe_kill("recv", armed)
@@ -685,6 +824,8 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
             # context so handler-side spans join the caller's trace
             wire_tid = req.pop(TRACE_KEY, None) \
                 if isinstance(req, dict) else None
+            if state.expose_req_id and isinstance(req, dict):
+                req["_req_id"] = req_id
             _SERVER_REQS.labels(op=op or "?").inc()
             _flight.record("rpc", "server_request", trace_id=wire_tid,
                            op=op or "?", req_id=req_id)
@@ -709,6 +850,8 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
                                        trace_id=wire_tid,
                                        op=op or "?"):
                         rep = dispatch(req)
+                        if isinstance(rep, types.GeneratorType):
+                            rep = _drain_stream(sock, rep, req_id)
                 except Exception as e:
                     # application/dispatch failure (including barrier
                     # timeouts): report as an error frame instead of

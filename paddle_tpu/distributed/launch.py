@@ -39,6 +39,18 @@ def _parse(argv):
                    help="PS mode: comma-separated server endpoints")
     p.add_argument("--workers", type=str, default="",
                    help="PS mode: comma-separated worker endpoints")
+    p.add_argument("--serving_replicas", type=str, default="",
+                   help="serving mode: comma-separated replica "
+                        "endpoints; spawns one child per endpoint with "
+                        "PADDLE_TPU_REPLICA_ENDPOINT / "
+                        "PADDLE_TPU_REPLICA_ID set (the script builds "
+                        "Engine.from_checkpoint + ServingServer on that "
+                        "endpoint; tests/fixtures/serving_replica.py is "
+                        "the reference). With --max_restarts > 0 a dead "
+                        "replica is respawned ALONE — its state lives "
+                        "in the engine checkpoint, and the serving "
+                        "router fails in-flight requests over to the "
+                        "surviving replicas meanwhile (docs/SERVING.md)")
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: restart the whole job up to N times "
@@ -138,11 +150,12 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
     (rc, needs_restart): the elastic loop in `launch` respawns when the
     manager still has restarts left.
 
-    Graceful PS degradation: when `specs` carries a snapshot dir for a
-    dead `server.*` child and the manager still has server-restart
-    budget, ONLY that shard is respawned — it restores from its
-    snapshot and the workers' transport retry loops reconnect, so one
-    dead PS server no longer costs a whole-job restart."""
+    Graceful degradation: when `specs` carries a respawnable child —
+    a `server.*` PS shard (restores from its snapshot) or a
+    `replica.*` serving replica (rebuilds from its engine checkpoint;
+    the router fails its in-flight work over meanwhile) — and the
+    manager still has single-child restart budget, ONLY that child is
+    respawned instead of the whole job."""
     specs = specs or {}
     try:
         while True:
@@ -155,12 +168,16 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
                 elif rc != 0:
                     spec = specs.get(name)
                     if spec is not None and manager is not None \
-                            and name.startswith("server.") \
+                            and (name.startswith("server.")
+                                 or name.startswith("replica.")) \
                             and manager.should_restart_server():
                         manager.record_server_restart()
+                        what = "it from snapshot" \
+                            if name.startswith("server.") \
+                            else "it alone from its engine checkpoint"
                         sys.stderr.write(
-                            f"[launch] PS {name} exited with code {rc}; "
-                            f"restarting it from snapshot "
+                            f"[launch] {name} exited with code {rc}; "
+                            f"restarting {what} "
                             f"({manager.server_restart_count}/"
                             f"{manager.max_server_restarts})\n")
                         if fh:
@@ -180,7 +197,8 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
             # worker/trainer child finished cleanly (reference fleetrun
             # tears servers down once trainers exit)
             worker_rcs = [p.poll() for name, p, _ in procs
-                          if not name.startswith("server.")]
+                          if not name.startswith("server.")
+                          and not name.startswith("replica.")]
             if worker_rcs and all(rc == 0 for rc in worker_rcs) \
                     and any(name.startswith("server.")
                             for name, _, _ in procs):
@@ -246,6 +264,16 @@ def launch(argv=None):
                                   servers=args.servers,
                                   workers=args.workers)
             specs.append((f"worker.{i}", env, script))
+    elif args.serving_replicas:
+        # serving fleet: one replica child per endpoint, identity via
+        # env (the script builds Engine.from_checkpoint + ServingServer
+        # on PADDLE_TPU_REPLICA_ENDPOINT); the router process is the
+        # operator's own (paddle_tpu.serving.Router)
+        for i, ep in enumerate(e for e in args.serving_replicas.split(",")
+                               if e):
+            specs.append((f"replica.{i}",
+                          {"PADDLE_TPU_REPLICA_ENDPOINT": ep,
+                           "PADDLE_TPU_REPLICA_ID": str(i)}, script))
     else:
         if args.trainer_endpoints:
             endpoints = args.trainer_endpoints.split(",")
@@ -295,6 +323,13 @@ def launch(argv=None):
                 env["PADDLE_PS_SNAPSHOT_DIR"] = snap_dir
                 env["PADDLE_PS_SNAPSHOT_EVERY"] = \
                     str(args.ps_snapshot_every)
+                server_specs[name] = (env, argv)
+    if args.serving_replicas and args.max_restarts > 0:
+        # serving replicas respawn ALONE like PS shards: their state is
+        # the engine checkpoint the child script restores from, and the
+        # router redispatches around the gap
+        for name, env, argv in specs:
+            if name.startswith("replica."):
                 server_specs[name] = (env, argv)
     manager = ElasticManager(
         max_restarts=args.max_restarts,

@@ -22,6 +22,11 @@ Network mode (PS wire format, see serving/frontend.py):
     from paddle_tpu.serving import ServingServer, ServingClient
     srv = ServingServer(engine).start()          # engine-owned thread
     out = ServingClient(srv.endpoint).generate([1, 2, 3], 16)
+
+Replicated fleet (serving/router.py, docs/SERVING.md): a Router fronts
+N replicas with least-loaded dispatch, session affinity, streaming
+token frames, exactly-once failover, draining, and elastic respawn
+from engine checkpoints — the same ServingClient talks to it.
 """
 from .kv_cache import PagePool, PageTable, defrag_plan, pages_needed
 from .scheduler import (QueueFull, QuotaExceeded, Request, Scheduler,
@@ -31,6 +36,7 @@ from .engine import Engine
 from .frontend import ServingClient, ServingServer
 from .loadgen import (Arrival, LoadGenerator, LoadResult, TrafficConfig,
                       slo_report)
+from .router import InProcessReplica, Replica, ReplicaSpec, Router
 
 __all__ = [
     "PagePool", "PageTable", "pages_needed", "defrag_plan",
@@ -38,4 +44,5 @@ __all__ = [
     "GPTDecodeModel", "Engine", "ServingServer", "ServingClient",
     "Arrival", "LoadGenerator", "LoadResult", "TrafficConfig",
     "slo_report",
+    "Router", "ReplicaSpec", "Replica", "InProcessReplica",
 ]

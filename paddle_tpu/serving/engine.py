@@ -411,6 +411,21 @@ class Engine:
             self._note_done(r)
         self.cache = self.model.init_cache(self.num_pages, self.page_size)
 
+    def drain(self) -> "Engine":
+        """Graceful removal from a serving fleet: stop admitting new
+        requests (submit raises QueueFull("draining")), let everything
+        queued or running finish. `stats()["draining"]` and the
+        frontend's `ping` report it so a router stops routing here;
+        `run_until_idle`/the scheduler thread empty the queue, then the
+        process can exit with nothing lost."""
+        self.scheduler.drain()
+        self._wake.set()
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
     def cancel(self, req: Request) -> bool:
         """Abandon a request (frontend timeout, client gone): dequeue or
         preempt it, freeing its pages. False if it already finished."""

@@ -9,10 +9,18 @@ drop is served from the dedup cache instead of decoding twice.
 Ops:
   {"op": "generate", "prompt": <int ndarray>, "max_new_tokens": n,
    "deadline": seconds|None, "timeout": seconds,
-   "priority": tier (0 = highest, default 1), "tenant": str}
+   "priority": tier (0 = highest, default 1), "tenant": str,
+   "stream": bool}
       -> {"status": "done"|"deadline"|"timeout"|"rejected"|"shed"|
                     "error",
           "tokens": <int32 ndarray>, ...}
+    With "stream": true the server pushes F_STREAM frames
+    {"tokens": <int32 ndarray>, "index": i} as tokens are decoded,
+    then the normal final reply (whose "tokens" is the AUTHORITATIVE
+    full list — stream frames are progress, the final frame is the
+    dedup-cached result a retry sees). Streaming is what makes TTFT
+    observable on the wire and lets a router detect a replica wedged
+    mid-generation by the inter-frame gap (docs/SERVING.md).
     Backpressure AND tenant-quota rejections reply status="rejected";
     a queued request shed for a higher-priority submit replies
     status="shed" (docs/SERVING.md admission control).
@@ -32,7 +40,14 @@ Ops:
     (metrics + trace ring + flight rings + in-flight requests,
     docs/DEBUGGING.md), optionally persisted into the server's own
     PADDLE_TPU_DEBUG_DIR (never a wire-chosen path)
-  {"op": "ping"}  -> True
+  {"op": "drain"} -> {"draining": true, ...}  Graceful removal: stop
+    admitting (submits reply "rejected"), finish everything queued or
+    running; `ping`/`stats` report draining=true so a router routes
+    around this replica. {"wait": true, "timeout": s} blocks until the
+    queue ran dry (reply carries "idle").
+  {"op": "ping"}  -> {"ok": true, "draining": bool, "queue_depth": n,
+    "active_slots": n, "occupancy": f}  — the router's health/load
+    probe (cheap: no latency sorting, two lock-free gauge reads)
 
 In-process use (tests, co-located workers) needs none of this — call
 `Engine.submit` / `Engine.generate` directly.
@@ -41,6 +56,7 @@ from __future__ import annotations
 
 import socketserver
 import threading
+import time
 
 import numpy as np
 
@@ -76,6 +92,17 @@ class ServingServer(socketserver.ThreadingTCPServer):
         super().__init__((host, int(port)), Handler)
         self.endpoint = f"{host}:{self.server_address[1]}"
         self._thread: threading.Thread | None = None
+        self._conns: set = set()     # live handler sockets (kill())
+
+    # connection tracking so kill() can sever live streams the way a
+    # process death would (chaos drills; docs/SERVING.md)
+    def process_request(self, request, client_address):
+        self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        self._conns.discard(request)
+        super().shutdown_request(request)
 
     def start(self):
         self.engine.start()
@@ -93,6 +120,29 @@ class ServingServer(socketserver.ThreadingTCPServer):
             self._thread.join(timeout=10)
             self._thread = None
 
+    def kill(self):
+        """Crash, don't drain (chaos drills): close the listener AND
+        every live connection — in-flight streamed replies die
+        mid-frame, exactly what a replica process death looks like to
+        the router — and halt the serve thread. The engine is left to
+        the caller (a real kill takes it down with the process)."""
+        import socket as _socket
+        self.shutdown()
+        self.server_close()
+        for s in list(self._conns):
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._conns.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
     def __enter__(self):
         return self.start()
 
@@ -102,7 +152,29 @@ class ServingServer(socketserver.ThreadingTCPServer):
     def _dispatch(self, req: dict):
         op = req.get("op")
         if op == "ping":
-            return True
+            # the router's combined health + load probe: queue depth and
+            # occupancy WITHOUT engine.stats()'s latency sort, so a
+            # sub-second ping cadence costs nothing measurable
+            sched = self.engine.scheduler
+            return {"ok": True, "draining": bool(sched.draining),
+                    "queue_depth": sched.queue_depth,
+                    "active_slots": len(sched.active_requests()),
+                    "occupancy": float(self.engine.pool.occupancy)}
+        if op == "drain":
+            self.engine.drain()
+            idle = None
+            if req.get("wait"):
+                deadline = time.monotonic() \
+                    + float(req.get("timeout") or self.default_timeout)
+                while not self.engine.scheduler.idle \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                idle = self.engine.scheduler.idle
+            rep = {"draining": True,
+                   "queue_depth": self.engine.scheduler.queue_depth}
+            if idle is not None:
+                rep["idle"] = bool(idle)
+            return rep
         if op == "stats":
             return self.engine.stats()
         if op == "metrics":
@@ -134,6 +206,12 @@ class ServingServer(socketserver.ThreadingTCPServer):
                 except ValueError as e:
                     sp.attrs["status"] = "error"
                     return {"status": "error", "error": str(e)}
+                if req.get("stream"):
+                    # generator reply: serve_connection pushes each
+                    # yielded frame as F_STREAM, then the returned dict
+                    # as the final (dedup-cached) reply
+                    sp.attrs["status"] = "stream"
+                    return self._stream_result(req, h)
                 out = self._await_result(req, h)
                 sp.attrs["status"] = out.get("status")
                 return out
@@ -143,22 +221,66 @@ class ServingServer(socketserver.ThreadingTCPServer):
     def _await_result(self, req: dict, h):
         timeout = float(req.get("timeout") or self.default_timeout)
         if not h.wait(timeout):
-            # the reply gets dedup-cached, so the request must not
-            # keep decoding tokens nobody can ever retrieve: cancel
-            # it (frees slot+pages) and return the partial output.
-            # cancel() can lose the race to completion — fall
-            # through to the finished result in that case.
-            if self.engine.cancel(h):
-                return {"status": "timeout",
-                        "tokens": np.asarray(h.generated, np.int32),
-                        "error": f"not finished within {timeout}s; "
-                                 "request cancelled"}
+            return self._timeout_reply(h, timeout)
+        return self._finished_reply(h)
+
+    def _timeout_reply(self, h, timeout: float):
+        # the reply gets dedup-cached, so the request must not
+        # keep decoding tokens nobody can ever retrieve: cancel
+        # it (frees slot+pages) and return the partial output.
+        # cancel() can lose the race to completion — fall
+        # through to the finished result in that case.
+        if self.engine.cancel(h):
+            return {"status": "timeout",
+                    "tokens": np.asarray(h.generated, np.int32),
+                    "error": f"not finished within {timeout}s; "
+                             "request cancelled"}
+        return self._finished_reply(h)
+
+    def _finished_reply(self, h):
         if h.status == "error":
             return {"status": "error", "error": h.error or "failed"}
         return {"status": h.status,
                 "tokens": np.asarray(h.generated, np.int32),
                 "prompt_len": int(h.prompt.size),
                 "latency_ms": round((h.latency() or 0.0) * 1e3, 3)}
+
+    def _stream_result(self, req: dict, h):
+        """Push tokens as they decode, finish with the normal reply.
+        The final frame's "tokens" is the authoritative full list —
+        stream frames are incremental progress (TTFT/ITL on the wire,
+        mid-generation stall detection for the router). The span opens
+        at first next(), not at dispatch — a returned generator
+        outlives the dispatch call, and the span must cover the
+        stream's real duration and final status."""
+        with _tracing.span("frontend.stream", request=h.id,
+                           prompt_len=int(h.prompt.size)) as sp:
+            out = yield from self._stream_body(req, h)
+            sp.attrs["status"] = out.get("status")
+            return out
+
+    def _stream_body(self, req: dict, h):
+        timeout = float(req.get("timeout") or self.default_timeout)
+        deadline = time.monotonic() + timeout
+        sent = 0
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._timeout_reply(h, timeout)
+                toks, done = h.next_tokens(sent, timeout=remaining)
+                if toks:
+                    yield {"tokens": np.asarray(toks, np.int32),
+                           "index": sent}
+                    sent += len(toks)
+                if done:
+                    return self._finished_reply(h)
+        finally:
+            # GeneratorExit: the client connection died mid-stream —
+            # nobody can ever fetch this request's reply (it is NOT in
+            # the dedup cache yet), so stop burning decode steps on it
+            if not h.done():
+                self.engine.cancel(h)
 
 
 class ServingClient:
@@ -171,7 +293,27 @@ class ServingClient:
                               else 150.0)
 
     def ping(self) -> bool:
-        return bool(self._rpc.call({"op": "ping"}))
+        rep = self._rpc.call({"op": "ping"})
+        return bool(rep.get("ok")) if isinstance(rep, dict) \
+            else bool(rep)
+
+    def ping_info(self) -> dict:
+        """Full health/load probe: draining flag, queue depth, active
+        slots, page occupancy (what the router's least-loaded dispatch
+        and health state machine read)."""
+        rep = self._rpc.call({"op": "ping"})
+        return rep if isinstance(rep, dict) else {"ok": bool(rep)}
+
+    def drain(self, wait: bool = False,
+              timeout: float | None = None) -> dict:
+        """Graceful removal: stop admitting, finish the queue.
+        ``wait=True`` blocks until the server ran dry (reply carries
+        "idle")."""
+        req = {"op": "drain", "wait": bool(wait)}
+        if timeout is not None:
+            req["timeout"] = float(timeout)
+        wire_t = (timeout or 120.0) + 30.0
+        return self._rpc.call(req, timeout=wire_t, deadline=wire_t + 30)
 
     def stats(self) -> dict:
         return self._rpc.call({"op": "stats"})
@@ -192,13 +334,47 @@ class ServingClient:
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,
                  timeout: float = 120.0, priority: int = 1,
-                 tenant: str = "default") -> dict:
-        return self._rpc.call(
-            {"op": "generate", "prompt": np.asarray(prompt, np.int32),
-             "max_new_tokens": int(max_new_tokens),
-             "deadline": deadline, "timeout": timeout,
-             "priority": int(priority), "tenant": str(tenant)},
-            timeout=timeout + 30.0, deadline=timeout + 60.0)
+                 tenant: str = "default", session: str | None = None,
+                 stream: bool = False, on_token=None) -> dict:
+        """One generation round-trip. ``stream=True`` asks the server
+        to push tokens as they decode; ``on_token(tokens, index)`` is
+        called per pushed frame on this thread and delivers every token
+        EXACTLY ONCE in order (a mid-stream transport retry re-streams
+        from index 0 — the client forwards only the unseen tail, the
+        same dedup the router's failover relay applies). The returned
+        final reply's "tokens" is the authoritative full list (a
+        dedup-hit retry replays no frames — on_token may see nothing).
+        ``session`` is the router's affinity key (ignored by a bare
+        ServingServer)."""
+        req = {"op": "generate",
+               "prompt": np.asarray(prompt, np.int32),
+               "max_new_tokens": int(max_new_tokens),
+               "deadline": deadline, "timeout": timeout,
+               "priority": int(priority), "tenant": str(tenant)}
+        if session is not None:
+            req["session"] = str(session)
+        if not stream:
+            return self._rpc.call(req, timeout=timeout + 30.0,
+                                  deadline=timeout + 60.0)
+        req["stream"] = True
+        seen = 0
+
+        def _on(frame):
+            nonlocal seen
+            if on_token is None or not isinstance(frame, dict) \
+                    or frame.get("tokens") is None:
+                return
+            toks = [int(t) for t in
+                    np.asarray(frame["tokens"]).ravel()]
+            new = int(frame.get("index", 0)) + len(toks) - seen
+            if new > 0:
+                on_token(toks[len(toks) - new:], seen)
+                seen += new
+
+        # streamed: the per-attempt timeout bounds the INTER-FRAME gap,
+        # the deadline bounds the whole call
+        return self._rpc.call(req, timeout=timeout + 30.0,
+                              deadline=timeout + 60.0, on_stream=_on)
 
     def close(self):
         self._rpc.close()

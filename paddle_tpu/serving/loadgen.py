@@ -283,16 +283,19 @@ class LoadGenerator:
         return self.run(submit, **kw)
 
     def run_client(self, client, timeout: float = 120.0,
-                   **kw) -> LoadResult:
-        """Replay over the wire (serving/frontend.py ServingClient).
-        The blocking `generate` calls run on their own threads so the
-        arrival process stays open-loop; each handle mimics Request
-        enough for slo_report (wait/status/generated/deadline...).
-        The wire `generate` is one-shot (no streaming), so a wire
-        handle cannot observe first/inter-token times: slo_report over
-        a run_client result carries attainment + goodput but
-        ttft/itl percentiles are None (in-process run_engine reports
-        the full surface)."""
+                   stream: bool = True, **kw) -> LoadResult:
+        """Replay over the wire (serving/frontend.py ServingClient or
+        a router). The blocking `generate` calls run on their own
+        threads so the arrival process stays open-loop; each handle
+        mimics Request enough for slo_report
+        (wait/status/generated/deadline...). With ``stream=True`` (the
+        default) each call rides the streaming wire generate: token
+        frames stamp first/last-token times as they ARRIVE, so
+        slo_report over a wire run carries real end-to-end TTFT and
+        inter-token percentiles — including every network and router
+        hop, which the in-process run_engine numbers can never see.
+        ``stream=False`` restores the one-shot wire call (attainment +
+        goodput only, ttft/itl percentiles None)."""
         threads: list[threading.Thread] = []
 
         class _WireHandle:
@@ -306,16 +309,33 @@ class LoadGenerator:
                 self.finished_at = None
                 self.first_token_at = None
                 self.last_token_at = None
+                self._streamed = 0
                 self._done = threading.Event()
 
             def wait(self, t=None):
                 return self._done.wait(t)
 
+            def on_tokens(self, toks, idx):
+                # ARRIVAL time of a pushed frame — the wire-true SLO
+                # clock (includes queueing, prefill, network, router)
+                t = time.monotonic()
+                if self.first_token_at is None:
+                    self.first_token_at = t
+                self.last_token_at = t
+                self._streamed = max(self._streamed, idx + len(toks))
+
             def ttft(self):
-                return None
+                if self.first_token_at is None:
+                    return None
+                return self.first_token_at - self._queued_at
 
             def inter_token(self):
-                return None
+                if self.first_token_at is None \
+                        or self.last_token_at is None \
+                        or self._streamed < 2:
+                    return None
+                return (self.last_token_at - self.first_token_at) \
+                    / (self._streamed - 1)
 
         def submit(arr: Arrival):
             h = _WireHandle(arr, time.monotonic())
@@ -325,7 +345,9 @@ class LoadGenerator:
                     rep = client.generate(
                         arr.prompt, arr.max_new_tokens,
                         deadline=arr.deadline, timeout=timeout,
-                        priority=arr.tier, tenant=arr.tenant)
+                        priority=arr.tier, tenant=arr.tenant,
+                        stream=stream,
+                        on_token=h.on_tokens if stream else None)
                     h.status = rep.get("status", "error")
                     h.generated = list(np.asarray(
                         rep.get("tokens", ())).ravel())

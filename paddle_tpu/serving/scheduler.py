@@ -182,6 +182,10 @@ class Request:
         self.last_token_at: float | None = None
         self._finished = False       # set once, under the scheduler lock
         self._done = threading.Event()
+        # token-progress condition for streaming consumers: notified on
+        # every recorded token and on finish. A leaf lock — holders
+        # never take the scheduler or engine step lock under it.
+        self._progress = threading.Condition()
 
     # -- results -------------------------------------------------------
     def done(self) -> bool:
@@ -198,6 +202,29 @@ class Request:
         if self.status == "error":
             raise RuntimeError(self.error or "request failed")
         return np.asarray(self.generated, np.int32)
+
+    def _notify_progress(self):
+        with self._progress:
+            self._progress.notify_all()
+
+    def next_tokens(self, start: int, timeout: float | None = None) \
+            -> tuple[list[int], bool]:
+        """Block until tokens beyond index `start` exist or the request
+        finished; returns (new_tokens, done). The streaming frontends
+        poll this from their handler threads — `generated` is only ever
+        appended, so the slice is safe to read concurrently (a token
+        appended between wakeup and slice just arrives early)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._progress:
+            while len(self.generated) <= start \
+                    and not self._done.is_set():
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                self._progress.wait(remaining)
+        return list(self.generated[start:]), self._done.is_set()
 
     @property
     def total_tokens(self) -> int:
@@ -248,6 +275,9 @@ class Scheduler:
         self.slots: list[Request | None] = [None] * num_slots
         self.queue: deque[Request] = deque()
         self.quotas: dict[str, TokenBucket] = {}
+        # graceful drain: True = admit nothing new, finish what's here
+        # (the router stops routing to a draining replica; docs/SERVING.md)
+        self.draining = False
         self._lock = threading.Lock()
         # counters (engine /stats) — registry-backed, labeled per
         # instance (`inst` lets the Engine align the label with its own)
@@ -319,6 +349,16 @@ class Scheduler:
                 f"max_seq_len {self.max_seq_len}")
         victim: Request | None = None
         with self._lock:
+            if self.draining:
+                # drain semantics: every in-flight/queued request
+                # finishes, nothing new is admitted — the standard
+                # backpressure reply ("rejected") tells well-behaved
+                # clients and the router to go elsewhere
+                self._m_rejected.inc()
+                _flight.record("serving", "reject",
+                               trace_id=req.trace_id, inst=self.inst,
+                               request=req.id, reason="draining")
+                raise QueueFull("draining: not admitting new requests")
             t = self.now()
             req._queued_at = t
             bucket = self.quotas.get(req.tenant)
@@ -478,6 +518,7 @@ class Scheduler:
                 or len(req.generated) >= req.max_new_tokens:
             self.evict(req, "done")
             return True
+        req._notify_progress()       # streaming consumers wake per token
         return False
 
     def cancel(self, req: Request) -> bool:
@@ -529,12 +570,23 @@ class Scheduler:
                        reason=reason or status,
                        generated=len(req.generated))
         req._done.set()
+        req._notify_progress()
         return True
+
+    def drain(self):
+        """Stop admitting (submit raises QueueFull); queued + running
+        requests finish normally. One-way for this scheduler's life —
+        a drained replica is retired or respawned, never un-drained."""
+        with self._lock:
+            self.draining = True
+        _flight.record("serving", "drain", inst=self.inst,
+                       queue_depth=len(self.queue))
 
     def stats(self) -> dict:
         return {"queue_depth": self.queue_depth,
                 "active_slots": len(self.active_requests()),
                 "num_slots": self.num_slots,
+                "draining": self.draining,
                 "admitted": self.admitted,
                 "completed": self.completed,
                 "preemptions": self.preemptions,

@@ -1,0 +1,70 @@
+"""Killable serving replica for the router chaos drills.
+
+Builds an Engine from a checkpoint manifest (the elastic-respawn path:
+`Engine.from_checkpoint`) and serves it on a fixed endpoint — the
+launch.py `--serving_replicas` child contract.
+
+Env:
+  PADDLE_TPU_REPLICA_ENDPOINT  where to listen (required)
+  REPLICA_CKPT                 checkpoint root (required)
+  REPLICA_ENGINE_KW            JSON dict of Engine kwargs (optional)
+  REPLICA_ARM_FAULT_FILE       optional path: the PADDLE_PS_FAULT_*
+      knobs in the spawn env are STASHED at startup (so a drill can
+      arm them mid-run, not at import); when this file appears, the
+      knobs are restored and the injector re-armed from them — e.g.
+      KILL_AFTER=1 dies on the next request, STALL/serving_decode
+      wedges the decode step while pings keep answering.
+  REPLICA_KEEP_FAULTS          optional comma list of PADDLE_PS_FAULT_*
+      names exempt from the stash — live from the first request (e.g.
+      DELAY throttles every frame send so a streamed generate stays
+      in flight long enough for a mid-stream kill to land).
+
+Prints one READY JSON line ({"endpoint", "pid"}), then serves until
+killed.
+"""
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# stash fault knobs BEFORE any paddle_tpu import can arm the injector
+_KEEP = {k for k in
+         (os.environ.get("REPLICA_KEEP_FAULTS") or "").split(",") if k}
+_STASHED = {k: os.environ.pop(k) for k in list(os.environ)
+            if k.startswith("PADDLE_PS_FAULT_") and k not in _KEEP}
+
+from paddle_tpu.distributed.fleet.runtime import (  # noqa: E402
+    fault_injection as fi)
+from paddle_tpu.serving import Engine, ServingServer  # noqa: E402
+
+
+def main():
+    engine_kw = json.loads(os.environ.get("REPLICA_ENGINE_KW") or "{}")
+    engine = Engine.from_checkpoint(os.environ["REPLICA_CKPT"],
+                                    **engine_kw)
+    server = ServingServer(engine,
+                           os.environ["PADDLE_TPU_REPLICA_ENDPOINT"])
+    server.start()
+    print(json.dumps({"endpoint": server.endpoint,
+                      "pid": os.getpid()}), flush=True)
+    arm_file = os.environ.get("REPLICA_ARM_FAULT_FILE")
+    armed = False
+    if arm_file is None and _STASHED:
+        # no delayed arming requested: the knobs apply from the start
+        # (but still only AFTER the engine built and READY printed —
+        # a KILL_AFTER must count serving requests, not imports)
+        os.environ.update(_STASHED)
+        fi.reset_injector(None)
+        armed = True
+    while True:
+        if arm_file and not armed and os.path.exists(arm_file):
+            os.environ.update(_STASHED)
+            fi.reset_injector(None)      # re-read env: knobs now live
+            armed = True
+            print(json.dumps({"armed": sorted(_STASHED)}), flush=True)
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

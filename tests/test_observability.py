@@ -292,7 +292,9 @@ ENGINE_STATS_KEYS = {
     "completed_seen", "compiles",
     # PR-6 admission control: every PR-2 key above is unchanged; the
     # scheduler's new decision counters ride along
-    "expired_in_queue", "shed", "quota_rejected"}
+    "expired_in_queue", "shed", "quota_rejected",
+    # PR-9 graceful drain: the router reads it from ping/stats
+    "draining"}
 POOL_STATS_KEYS = {
     "num_pages", "page_size", "free_pages", "used_pages", "occupancy",
     "alloc_count", "free_count", "alloc_failures"}

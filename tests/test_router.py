@@ -1,0 +1,770 @@
+"""Replicated serving (ISSUE 9): wire streaming, graceful drain, and
+the fault-tolerant router — least-loaded dispatch, session affinity,
+health state machine, exactly-once failover, stream-stall detection,
+elastic respawn from an engine checkpoint. The whole module re-runs
+under PADDLE_TPU_LOCKCHECK=1 (router dispatch + health + streaming is
+exactly the multi-lock shape the sanitizer polices)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.runtime import fault_injection as fi
+from paddle_tpu.distributed.fleet.runtime.rpc import RpcClient
+from paddle_tpu.serving import (Engine, GPTDecodeModel, InProcessReplica,
+                                PagePool, QueueFull, ReplicaSpec, Request,
+                                Router, Scheduler, ServingClient,
+                                ServingServer)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENGINE_KW = dict(num_slots=4, num_pages=64, page_size=4, max_seq_len=48)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset_injector(fi.FaultInjector())
+    yield
+    fi.reset_injector(fi.FaultInjector())
+
+
+@pytest.fixture(scope="module")
+def ckpt_root(tmp_path_factory):
+    from paddle_tpu.models.gpt import GPTConfig
+    root = str(tmp_path_factory.mktemp("fleet") / "gpt")
+    GPTDecodeModel(GPTConfig.tiny(num_layers=1), seed=0) \
+        .save_checkpoint(root)
+    return root
+
+
+@pytest.fixture(scope="module")
+def expected_tokens(ckpt_root):
+    """Reference greedy outputs from a local engine on the same
+    checkpoint — every replica must produce exactly these."""
+    eng = Engine.from_checkpoint(ckpt_root, **ENGINE_KW)
+    out = {}
+    with eng:
+        for key, (prompt, mnt) in {"short": ([1, 2, 3], 8),
+                                   "long": ([7, 8], 30)}.items():
+            out[key] = eng.generate(prompt, mnt, timeout=60).tolist()
+    return out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _slow_decode(engine, seconds: float):
+    """Wrap the compiled decode so every step dawdles (host-side wrap:
+    jit already traced; keeps requests in flight for kill windows)."""
+    orig = engine._decode
+
+    def slow(*a):
+        time.sleep(seconds)
+        return orig(*a)
+
+    engine._decode = slow
+
+
+# ---------------------------------------------------------------------------
+# wire streaming (single replica, no router)
+# ---------------------------------------------------------------------------
+
+def test_stream_matches_oneshot_and_ttft_before_final(ckpt_root,
+                                                      expected_tokens):
+    eng = Engine.from_checkpoint(ckpt_root, **ENGINE_KW)
+    with eng, ServingServer(eng, "127.0.0.1:0") as srv:
+        cli = ServingClient(srv.endpoint)
+        try:
+            frames = []
+            rep = cli.generate(
+                [1, 2, 3], 8, timeout=60, stream=True,
+                on_token=lambda t, i: frames.append(
+                    (i, list(t), time.monotonic())))
+            done_at = time.monotonic()
+            assert rep["status"] == "done"
+            final = np.asarray(rep["tokens"]).tolist()
+            assert final == expected_tokens["short"]
+            # stream frames reassemble exactly the final reply: indices
+            # contiguous, no dup, no gap
+            streamed = []
+            for idx, toks, _ in frames:
+                assert idx == len(streamed)
+                streamed.extend(int(t) for t in toks)
+            assert streamed == final
+            assert len(frames) >= 2          # actually incremental
+            # TTFT is observable ON THE WIRE: the first token frame
+            # lands strictly before the call finished
+            assert frames[0][2] < done_at
+            one_shot = cli.generate([1, 2, 3], 8, timeout=60)
+            assert np.asarray(one_shot["tokens"]).tolist() == final
+        finally:
+            cli.close()
+
+
+def test_stream_dedup_retry_replays_final_only(ckpt_root):
+    """A retried streamed generate (same wire request id) is answered
+    from the dedup cache: final frame only, token-identical — the
+    exactly-once contract the router's failover leans on."""
+    eng = Engine.from_checkpoint(ckpt_root, **ENGINE_KW)
+    with eng, ServingServer(eng, "127.0.0.1:0") as srv:
+        rpc = RpcClient(srv.endpoint)
+        try:
+            req = {"op": "generate", "prompt": np.asarray([1, 2, 3],
+                                                          np.int32),
+                   "max_new_tokens": 6, "timeout": 60, "stream": True}
+            rid = 0xA110_0001
+            first_frames, retry_frames = [], []
+            rep1 = rpc.call(req, timeout=60, req_id=rid,
+                            on_stream=first_frames.append)
+            rep2 = rpc.call(req, timeout=60, req_id=rid,
+                            on_stream=retry_frames.append)
+            assert len(first_frames) >= 2
+            assert retry_frames == []        # dedup hit: final only
+            assert np.asarray(rep1["tokens"]).tolist() \
+                == np.asarray(rep2["tokens"]).tolist()
+            # the engine decoded ONCE: one completed request
+            assert eng.stats()["completed"] == 1
+        finally:
+            rpc.close()
+
+
+def test_client_on_token_dedups_replayed_frames():
+    """Review regression: a mid-stream transport retry re-streams from
+    index 0 — ServingClient.generate forwards each token to on_token
+    exactly once (index-based tail dedup), so naive frame-appending
+    consumers cannot double-count."""
+    cli = ServingClient.__new__(ServingClient)   # no real connection
+
+    class _FakeRpc:
+        def call(self, req, timeout=None, deadline=None,
+                 on_stream=None):
+            frames = (
+                {"tokens": np.asarray([1, 2], np.int32), "index": 0},
+                # retry replays from scratch, one token further along
+                {"tokens": np.asarray([1, 2, 3], np.int32), "index": 0},
+                {"tokens": np.asarray([4], np.int32), "index": 3},
+            )
+            for fr in frames:
+                on_stream(fr)
+            return {"status": "done",
+                    "tokens": np.asarray([1, 2, 3, 4], np.int32)}
+
+    cli._rpc = _FakeRpc()
+    got = []
+    rep = cli.generate([9], 4, stream=True,
+                       on_token=lambda t, i: got.append((i, list(t))))
+    assert got == [(0, [1, 2]), (2, [3]), (3, [4])]
+    assert np.asarray(rep["tokens"]).tolist() == [1, 2, 3, 4]
+
+
+def test_request_next_tokens_streams_incrementally():
+    pool = PagePool(16, 4)
+    s = Scheduler(pool, 1, max_seq_len=64)
+    r = s.submit(Request([1, 2], 3))
+    got, = s.admit()
+    assert got is r
+    toks, done = r.next_tokens(0, timeout=0.01)
+    assert toks == [] and not done           # nothing yet; no block
+    s.record_token(r, 7)
+    toks, done = r.next_tokens(0, timeout=1.0)
+    assert toks == [7] and not done
+    s.record_token(r, 8)
+    s.record_token(r, 9)                     # finishes (max_new=3)
+    toks, done = r.next_tokens(1, timeout=1.0)
+    assert toks == [8, 9] and done
+
+
+# ---------------------------------------------------------------------------
+# graceful drain
+# ---------------------------------------------------------------------------
+
+def test_scheduler_drain_rejects_new_keeps_queue():
+    pool = PagePool(16, 4)
+    s = Scheduler(pool, 1, max_seq_len=64)
+    queued = s.submit(Request([1], 2))
+    s.drain()
+    with pytest.raises(QueueFull):
+        s.submit(Request([1], 2))
+    assert s.stats()["draining"] is True
+    assert s.stats()["rejected"] == 1
+    # the queue still drains to completion
+    got, = s.admit()
+    assert got is queued
+    s.record_token(got, 1)
+    s.record_token(got, 2)
+    assert queued.status == "done"
+
+
+def test_drained_replica_finishes_queue_before_exit(ckpt_root):
+    """Satellite regression: drain stops ADMISSION, not the queue —
+    every request accepted before the drain verb completes."""
+    eng = Engine.from_checkpoint(ckpt_root, **ENGINE_KW)
+    _slow_decode(eng, 0.02)
+    with eng, ServingServer(eng, "127.0.0.1:0") as srv:
+        cli = ServingClient(srv.endpoint)
+        try:
+            handles = [eng.submit([1, 2], 6) for _ in range(5)]
+            assert cli.ping_info()["draining"] is False
+            rep = cli.drain(wait=True, timeout=60)
+            assert rep["draining"] and rep["idle"]
+            assert all(h.status == "done" and len(h.generated) == 6
+                       for h in handles)
+            assert cli.ping_info()["draining"] is True
+            post = cli.generate([3], 2, timeout=30)
+            assert post["status"] == "rejected"
+            assert "draining" in post["error"]
+        finally:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# router policy units (no replicas contacted: _pick is pure in-memory)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def bare_router():
+    r = Router("127.0.0.1:0", ping_interval=3600, max_inflight=4)
+    yield r
+    r.server_close()
+
+
+def _fake_replicas(router, n):
+    reps = [router.add_replica(ReplicaSpec(f"r{i}", f"127.0.0.1:{i+1}"))
+            for i in range(n)]
+    for r in reps:
+        # a replica is born UNCONFIRMED (respawning): confirm it the
+        # way the health loop would
+        assert r.state == "respawning"
+        router._note_alive(r, {"ok": True})
+        assert r.state == "healthy"
+        assert r.capacity == r.max_inflight   # first join: no ramp
+    return reps
+
+
+def test_pick_least_loaded_and_reservation(bare_router):
+    a, b, c = _fake_replicas(bare_router, 3)
+    a.last_info = {"queue_depth": 5, "active_slots": 2}
+    b.last_info = {"queue_depth": 0, "active_slots": 1}
+    c.last_info = {"queue_depth": 0, "active_slots": 1,
+                   "occupancy": 0.9}
+    b.last_info["occupancy"] = 0.1
+    got = bare_router._pick(None, set())
+    assert got is b                          # ties break on occupancy
+    # the reservation counts as load for the next pick
+    b.last_info = {"queue_depth": 0, "active_slots": 0}
+    c.last_info = {"queue_depth": 0, "active_slots": 0}
+    for _ in range(4):
+        bare_router._pick(None, set())
+    st = bare_router.stats()
+    assert st["replicas"]["r0"]["inflight"] == 0
+    assert st["replicas"]["r1"]["inflight"] \
+        + st["replicas"]["r2"]["inflight"] == 5
+
+
+def test_pick_respects_state_capacity_and_exclusion(bare_router):
+    a, b = _fake_replicas(bare_router, 2)
+    a.state = "suspect"
+    got = bare_router._pick(None, set())
+    assert got is b
+    bare_router._release(b, True)
+    got = bare_router._pick(None, {"r1"})
+    assert got is None                       # b excluded, a suspect
+    a.state = "healthy"
+    a.inflight = a.max_inflight              # saturated
+    assert bare_router._pick(None, {"r1"}) is None
+    a.inflight = 0
+    assert bare_router._pick(None, {"r1"}) is a
+
+
+def test_session_affinity_sticks_until_unroutable(bare_router):
+    a, b = _fake_replicas(bare_router, 2)
+    first = bare_router._pick("sess", set())
+    bare_router._release(first, True)
+    # heavy load elsewhere must not move the session
+    other = a if first is b else b
+    other.last_info = {}
+    first.last_info = {"queue_depth": 50}
+    again = bare_router._pick("sess", set())
+    assert again is first
+    bare_router._release(again, True)
+    # transient saturation: THIS request spills sideways, but the
+    # session does NOT remap — locality returns with the capacity
+    first.last_info = {}
+    first.inflight = first.max_inflight
+    spill = bare_router._pick("sess", set())
+    assert spill is other
+    bare_router._release(spill, True)
+    first.inflight = 0
+    back = bare_router._pick("sess", set())
+    assert back is first
+    bare_router._release(back, True)
+    # unroutable owner: the session remaps
+    first.state = "dead"
+    moved = bare_router._pick("sess", set())
+    assert moved is other
+    bare_router._release(moved, True)
+    # and STAYS remapped
+    first.state = "healthy"
+    assert bare_router._pick("sess", set()) is other
+
+
+def test_relay_rejects_when_no_capacity(bare_router):
+    (a,) = _fake_replicas(bare_router, 1)
+    a.state = "dead"
+    gen = bare_router._relay({"prompt": np.asarray([1], np.int32),
+                              "max_new_tokens": 2, "timeout": 5}, None)
+    with pytest.raises(StopIteration) as stop:
+        next(gen)
+    rep = stop.value.value
+    assert rep["status"] == "rejected"
+    assert "no routable replica" in rep["error"]
+
+
+def test_slow_start_ramp_after_respawn(bare_router):
+    (a,) = _fake_replicas(bare_router, 1)
+    for _ in range(3):                       # real path to DEAD
+        bare_router._note_failure(a, "ping")
+    assert a.state == "dead"
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "healthy"
+    assert a.capacity == 1                   # warm-start re-admission
+    got = bare_router._pick(None, set())
+    assert got is a
+    assert bare_router._pick(None, set()) is None   # cap honoured
+    bare_router._release(a, True)            # success doubles the cap
+    assert a.capacity == 2
+    bare_router._release(a, True)
+    assert a.capacity == 4 == a.max_inflight
+
+
+def test_health_transitions_and_draining_retires(bare_router):
+    a, b = _fake_replicas(bare_router, 2)
+    bare_router._note_failure(a, "ping")
+    assert a.state == "suspect"              # suspect_after=1
+    bare_router._note_failure(a, "ping")
+    bare_router._note_failure(a, "ping")
+    assert a.state == "dead"                 # dead_after=3
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "healthy"
+    # a draining replica that goes dark RETIRES — never respawned
+    bare_router._note_alive(b, {"ok": True, "draining": True})
+    assert b.state == "draining"
+    for _ in range(3):
+        bare_router._note_failure(b, "ping")
+    assert b.state == "retired"
+    # stale-epoch failures (pre-respawn incarnation) are ignored
+    bare_router._note_failure(a, "transport", epoch=a.epoch - 1)
+    assert a.state == "healthy" and a.consecutive_errors == 0
+
+
+def test_stall_suspicion_survives_green_pings(bare_router):
+    """A wedged decode step answers pings: inside the stall hold a
+    successful probe must NOT flip the replica back to healthy — and a
+    PERMANENTLY wedged replica still escalates to dead (and respawn)
+    because green pings cannot reset the stall ledger; only a
+    completed forward can."""
+    (a,) = _fake_replicas(bare_router, 1)
+    bare_router._note_failure(a, "stall")
+    assert a.state == "suspect"
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "suspect"              # held
+    a.suspect_until = 0.0                    # hold expires
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "healthy"
+    assert a.stall_errors == 1               # ping did NOT clear it
+    # flap cycle repeats: the ledger accumulates to dead_after=3
+    bare_router._note_failure(a, "stall")    # ledger: 2
+    a.suspect_until = 0.0
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "healthy"
+    bare_router._note_failure(a, "stall")    # ledger: 3 -> dead
+    assert a.state == "dead" and a.cold
+    # readmission resets the ledger; a later SUCCESSFUL forward is the
+    # other (and only) reset path
+    a.suspect_until = 0.0                    # hold expires
+    bare_router._note_alive(a, {"ok": True})
+    assert a.state == "healthy" and a.stall_errors == 0
+    bare_router._note_failure(a, "stall")
+    a.inflight = 1
+    bare_router._release(a, True)            # forward completed
+    assert a.stall_errors == 0
+
+
+def test_router_required_metric_names_registered():
+    from paddle_tpu.observability import REGISTRY
+    for name in ("paddle_tpu_router_requests_total",
+                 "paddle_tpu_router_dispatch_total",
+                 "paddle_tpu_router_failovers_total",
+                 "paddle_tpu_router_replica_state",
+                 "paddle_tpu_router_respawns_total",
+                 "paddle_tpu_router_stream_stalls_total",
+                 "paddle_tpu_router_inflight"):
+        assert REGISTRY.get(name) is not None, name
+
+
+# ---------------------------------------------------------------------------
+# router end-to-end (in-process replicas)
+# ---------------------------------------------------------------------------
+
+def _fleet(ckpt_root, n=2, **router_kw):
+    reps = []
+    for i in range(n):
+        r = InProcessReplica(ckpt_root, name=f"rep{i}",
+                             engine_kw=ENGINE_KW)
+        r.start()
+        reps.append(r)
+    kw = dict(ping_interval=0.1, ping_timeout=1.0, suspect_after=1,
+              dead_after=2, token_stall=5.0, respawn_cooldown=0.2)
+    kw.update(router_kw)
+    router = Router("127.0.0.1:0", replicas=[r.spec() for r in reps],
+                    **kw)
+    return router, reps
+
+
+def test_router_generate_stream_and_watchdog_tokens(ckpt_root,
+                                                    expected_tokens):
+    from paddle_tpu.observability.watchdog import WATCHDOG
+    router, reps = _fleet(ckpt_root)
+    try:
+        with router:
+            # one watchdog health token per replica
+            toks = WATCHDOG.tokens()
+            for r in reps:
+                assert f"serving.router.{router.router_id}." \
+                       f"{r.name}" in toks
+            cli = ServingClient(router.endpoint)
+            try:
+                rep = cli.generate([1, 2, 3], 8, timeout=60)
+                assert rep["status"] == "done"
+                assert np.asarray(rep["tokens"]).tolist() \
+                    == expected_tokens["short"]
+                frames = []
+                rep2 = cli.generate([1, 2, 3], 8, timeout=60,
+                                    stream=True,
+                                    on_token=lambda t, i:
+                                    frames.append((i, list(t))))
+                streamed = [int(t) for _, ts in frames for t in ts]
+                assert streamed == expected_tokens["short"]
+                assert np.asarray(rep2["tokens"]).tolist() == streamed
+                # session affinity end-to-end: all four land on ONE
+                # engine
+                before = [r.engine.stats()["admitted"] for r in reps]
+                for _ in range(4):
+                    assert cli.generate([4, 5], 2, timeout=60,
+                                        session="chat-1")["status"] \
+                        == "done"
+                deltas = [r.engine.stats()["admitted"] - b
+                          for r, b in zip(reps, before)]
+                assert sorted(deltas) == [0, 4]
+                st = router.stats()
+                assert st["healthy_replicas"] == 2
+            finally:
+                cli.close()
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_failover_on_replica_kill_exactly_once(ckpt_root,
+                                               expected_tokens):
+    """Kill a replica with streams in flight: the router replays them
+    on the survivor with the same wire ids; every client sees exactly
+    one complete, duplicate-free token sequence; the dead replica
+    respawns from its checkpoint and rejoins."""
+    from paddle_tpu.observability import REGISTRY
+    router, reps = _fleet(ckpt_root)
+    try:
+        with router:
+            for r in reps:
+                _slow_decode(r.engine, 0.03)
+            results, frame_logs = [], []
+
+            def long_gen():
+                c = ServingClient(router.endpoint)
+                frames = []
+                rep = c.generate([7, 8], 30, timeout=120, stream=True,
+                                 on_token=lambda t, i:
+                                 frames.append((i, list(t))))
+                c.close()
+                results.append(rep)
+                frame_logs.append(frames)
+
+            ths = [threading.Thread(target=long_gen) for _ in range(4)]
+            for t in ths:
+                t.start()
+            time.sleep(0.4)                  # streams mid-flight
+            reps[1].kill()                   # crash, no drain
+            for t in ths:
+                t.join(180)
+            assert len(results) == 4
+            for rep, frames in zip(results, frame_logs):
+                assert rep["status"] == "done", rep
+                final = np.asarray(rep["tokens"]).tolist()
+                assert final == expected_tokens["long"]
+                # relayed stream is contiguous across the failover:
+                # no dropped and no duplicated tokens
+                streamed = []
+                for idx, toks, in frames:
+                    assert idx == len(streamed)
+                    streamed.extend(int(t) for t in toks)
+                assert streamed == final
+            fo = REGISTRY.get("paddle_tpu_router_failovers_total")
+            fo_n = sum(s.value for _, s in fo._series()
+                       if _[0] == router.router_id)
+            assert fo_n >= 1
+            # elastic respawn: rep1 rebuilt from its checkpoint,
+            # readmitted after ready pings, epoch bumped
+            t0 = time.monotonic()
+            st = router.stats()
+            while time.monotonic() - t0 < 30:
+                st = router.stats()
+                if st["replicas"]["rep1"]["state"] == "healthy":
+                    break
+                time.sleep(0.1)
+            assert st["replicas"]["rep1"]["state"] == "healthy", st
+            assert st["replicas"]["rep1"]["epoch"] >= 1
+            # and it actually serves again (slow-start caps respect)
+            cli = ServingClient(router.endpoint)
+            try:
+                for _ in range(3):
+                    assert cli.generate([1, 2, 3], 4, timeout=60)[
+                        "status"] == "done"
+            finally:
+                cli.close()
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_upstream_death_mid_stream_releases_reservation(ckpt_root):
+    """Review regression: a client that vanishes mid-stream THROUGH the
+    router must not leak the replica's in-flight reservation (capacity
+    would shrink forever) — and the replica-side request is cancelled
+    (its reply could never be fetched)."""
+    router, reps = _fleet(ckpt_root, n=1)
+    try:
+        with router:
+            _slow_decode(reps[0].engine, 0.03)
+            rpc = RpcClient(router.endpoint)
+            gen = rpc.call_stream(
+                {"op": "generate",
+                 "prompt": np.asarray([7, 8], np.int32),
+                 "max_new_tokens": 30, "timeout": 60, "stream": True},
+                timeout=30)
+            next(gen)                        # stream established
+            gen.close()                      # upstream dies mid-stream
+            rpc.close()
+            t0 = time.monotonic()
+            ok = False
+            while time.monotonic() - t0 < 20:
+                if router.stats()["replicas"]["rep0"]["inflight"] == 0 \
+                        and reps[0].engine.scheduler.idle:
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok, (router.stats(), reps[0].engine.stats())
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_drain_replica_via_router(ckpt_root):
+    router, reps = _fleet(ckpt_root)
+    try:
+        with router:
+            rpc = RpcClient(router.endpoint)
+            cli = ServingClient(router.endpoint)
+            try:
+                out = rpc.call({"op": "drain_replica",
+                                "replica": "rep0", "wait": True},
+                               timeout=90, deadline=120)
+                assert out["draining"] and out["idle"]
+                assert reps[0].engine.draining
+                # drained replica out of rotation; traffic still flows
+                for _ in range(3):
+                    assert cli.generate([1, 2], 3, timeout=60)[
+                        "status"] == "done"
+                assert reps[0].engine.stats()["admitted"] == 0
+                st = router.stats()
+                assert st["replicas"]["rep0"]["state"] == "draining"
+            finally:
+                cli.close()
+                rpc.close()
+    finally:
+        for r in reps:
+            r.stop()
+
+
+def test_stream_stall_knob_fails_over_subprocess(ckpt_root,
+                                                 expected_tokens):
+    """PADDLE_PS_FAULT_STALL @ serving_decode wedges a subprocess
+    replica's decode INSIDE its step lock — its frontend still answers
+    pings, so only the router's inter-frame stall timeout can catch
+    it mid-generation and fail the stream over to the survivor."""
+    from paddle_tpu.observability import REGISTRY
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({"PADDLE_TPU_REPLICA_ENDPOINT": f"127.0.0.1:{port}",
+                "REPLICA_CKPT": ckpt_root,
+                "REPLICA_ENGINE_KW": json.dumps(ENGINE_KW),
+                "PADDLE_PS_FAULT_STALL": "60",
+                "PADDLE_PS_FAULT_STALL_POINT": "serving_decode"})
+    proc = subprocess.Popen(
+        [sys.executable,
+         os.path.join(REPO, "tests", "fixtures", "serving_replica.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    survivor = InProcessReplica(ckpt_root, name="good",
+                                engine_kw=ENGINE_KW)
+    survivor.start()
+    try:
+        ready = json.loads(proc.stdout.readline())
+        router = Router(
+            "127.0.0.1:0",
+            replicas=[ReplicaSpec("wedged", ready["endpoint"]),
+                      survivor.spec()],
+            ping_interval=0.1, ping_timeout=1.0, token_stall=1.0,
+            suspect_hold=30.0, dead_after=10)
+        with router:
+            # both replicas confirmed (replicas are born unconfirmed)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60 \
+                    and router.stats()["healthy_replicas"] < 2:
+                time.sleep(0.05)
+            assert router.stats()["healthy_replicas"] == 2
+            # pin the stream onto the wedged replica
+            with router._lock:
+                router._sessions["s"] = "wedged"
+            cli = ServingClient(router.endpoint)
+            try:
+                t0 = time.monotonic()
+                rep = cli.generate([7, 8], 30, timeout=90, stream=True,
+                                   session="s")
+                took = time.monotonic() - t0
+            finally:
+                cli.close()
+            assert rep["status"] == "done"
+            assert np.asarray(rep["tokens"]).tolist() \
+                == expected_tokens["long"]
+            # detection was the TOKEN stall (≈1s), nowhere near the
+            # 90s one-shot timeout the old wire format needed
+            assert took < 30, took
+            stalls = REGISTRY.get(
+                "paddle_tpu_router_stream_stalls_total")
+            n = sum(s.value for lv, s in stalls._series()
+                    if lv[0] == router.router_id)
+            assert n >= 1
+            st = router.stats()
+            assert st["replicas"]["wedged"]["state"] in ("suspect",
+                                                         "dead")
+            # green pings did NOT clear the held suspicion
+            time.sleep(0.5)
+            st = router.stats()
+            assert st["replicas"]["wedged"]["state"] != "healthy"
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+        survivor.stop()
+
+
+def test_launch_respawns_replica_alone_subprocess(ckpt_root, tmp_path):
+    """launch.py --serving_replicas: a replica child that dies (kill
+    knob) is respawned ALONE from its engine checkpoint under
+    --max_restarts, and serves again on the same endpoint."""
+    port = _free_port()
+    arm = str(tmp_path / "arm_kill")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({"REPLICA_CKPT": ckpt_root,
+                "REPLICA_ENGINE_KW": json.dumps(ENGINE_KW),
+                "REPLICA_ARM_FAULT_FILE": arm,
+                "PADDLE_PS_FAULT_KILL_AFTER": "1",
+                "PADDLE_PS_FAULT_KILL_POINT": "recv",
+                "JAX_PLATFORMS": "cpu"})
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--serving_replicas", f"127.0.0.1:{port}",
+         "--max_restarts", "1",
+         os.path.join(REPO, "tests", "fixtures", "serving_replica.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    def try_generate() -> bool:
+        """One bounded attempt — no client-side retry storms while the
+        replica is down or mid-respawn."""
+        rc = RpcClient(f"127.0.0.1:{port}", timeout=10, deadline=10,
+                       max_retries=0)
+        try:
+            rep = rc.call({"op": "generate",
+                           "prompt": np.asarray([1, 2], np.int32),
+                           "max_new_tokens": 3, "timeout": 10},
+                          timeout=10, deadline=10)
+            return rep.get("status") == "done"
+        except Exception:
+            return False
+        finally:
+            rc.close()
+
+    try:
+        deadline = time.monotonic() + 120
+        up = False
+        while time.monotonic() < deadline:
+            if try_generate():
+                up = True
+                break
+            time.sleep(0.25)
+        assert up, "replica never came up"
+        open(arm, "w").close()
+        time.sleep(0.3)                      # child polls the arm file
+        try_generate()                       # burns the kill (dies@recv)
+        os.unlink(arm)                       # the respawn must NOT
+        #                                      re-arm and die again
+        deadline = time.monotonic() + 120
+        ok = False
+        while time.monotonic() < deadline:
+            if try_generate():
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, "respawned replica never served"
+    finally:
+        launcher.terminate()
+        try:
+            launcher.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            launcher.kill()
+            launcher.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 dynamic validation: the module under the lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+def test_router_module_clean_under_lockcheck():
+    """Router dispatch + health state machine + streaming writer is
+    exactly the multi-lock shape the PR-8 runtime sanitizer exists to
+    police: re-run this module's in-process tests with every
+    paddle_tpu lock order-checked (subprocess-spawning tests excluded
+    — their children re-run elsewhere)."""
+    if os.environ.get("PADDLE_TPU_LOCKCHECK") == "1":
+        pytest.skip("already running under the sanitizer")
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "test_router.py"),
+         "-q", "-x", "-k", "not subprocess and not lockcheck",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, \
+        res.stdout[-4000:] + res.stderr[-2000:]

@@ -468,7 +468,9 @@ def test_loadgen_replays_over_the_wire():
     """run_client: the same open-loop replay drives the network
     frontend (PR-1 wire format) — blocking `generate` calls ride their
     own threads so the arrival process never closes the loop, and the
-    wire handles feed the same slo_report."""
+    wire handles feed the same slo_report. Streaming generate closed
+    the PR-6 gap: TTFT/ITL are now measured ON the wire (frame arrival
+    times), so the report's percentiles must be populated."""
     from paddle_tpu.serving import ServingClient, ServingServer
     eng = _tiny_engine()
     _prewarm(eng)
@@ -484,6 +486,30 @@ def test_loadgen_replays_over_the_wire():
     assert rep["offered"] > 5
     assert rep["attainment"] == 1.0, rep
     assert rep["goodput_tokens"] > 0
+    # wire TTFT is populated (satellite: the one-shot-generate caveat
+    # is gone) — and inter-token latency once any request decoded >1
+    assert rep["ttft_ms_p50"] is not None and rep["ttft_ms_p50"] > 0
+    assert rep["ttft_ms_p99"] >= rep["ttft_ms_p50"]
+    assert rep["itl_ms_p99"] is not None and rep["itl_ms_p99"] > 0
+
+
+def test_loadgen_one_shot_wire_still_supported():
+    """stream=False restores the PR-6 one-shot wire call: attainment +
+    goodput only, no TTFT/ITL."""
+    from paddle_tpu.serving import ServingClient, ServingServer
+    eng = _tiny_engine()
+    _prewarm(eng)
+    gen = LoadGenerator(_traffic(seed=21, duration=0.4, rate=20),
+                        name="wire1shot")
+    with eng, ServingServer(eng, "127.0.0.1:0") as srv:
+        cli = ServingClient(srv.endpoint)
+        try:
+            res = gen.run_client(cli, timeout=60, stream=False)
+        finally:
+            cli.close()
+    rep = slo_report(res)
+    assert rep["attainment"] == 1.0, rep
+    assert rep["ttft_ms_p50"] is None and rep["itl_ms_p50"] is None
 
 
 def test_frontend_carries_priority_and_tenant_over_the_wire():
@@ -703,6 +729,229 @@ def test_chaos_ps_kill_under_serving_load(tmp_path, monkeypatch):
         watcher.join(timeout=30)
         cl.close()
         for p in [srv] + restarted:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill 3 (ISSUE 9): replica kill mid-run behind the router —
+# exactly-once failover, elastic respawn, post-recovery SLO band
+# ---------------------------------------------------------------------------
+
+REPLICA_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                               "serving_replica.py")
+ROUTER_ENGINE_KW = dict(num_slots=4, num_pages=64, page_size=4,
+                        max_seq_len=48)
+
+
+def _spawn_replica(ep, root, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update({"PADDLE_TPU_REPLICA_ENDPOINT": ep,
+                "REPLICA_CKPT": root,
+                "REPLICA_ENGINE_KW": json.dumps(ROUTER_ENGINE_KW),
+                "JAX_PLATFORMS": "cpu"})
+    env.update(extra_env or {})
+    return subprocess.Popen([sys.executable, REPLICA_FIXTURE], env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def test_router_chaos_kill_failover_respawn_slo(tmp_path):
+    """Acceptance drill: same-seed loadgen traffic through the router
+    fronting two REPLICA PROCESSES; one replica dies mid-run (the
+    PADDLE_PS_FAULT kill knob, armed mid-traffic via the fixture's arm
+    file) with a streamed generate in flight. The router must fail the
+    in-flight work over exactly-once (token parity, contiguous stream,
+    no drops/duplicates), respawn the replica from its engine
+    checkpoint, and a same-seed post-recovery run must attain within
+    0.1 of the fault-free baseline. Wire TTFT is measured throughout
+    (streaming generate)."""
+    import socket as _socket
+
+    from paddle_tpu.observability import REGISTRY
+    from paddle_tpu.serving import (Engine, GPTDecodeModel, ReplicaSpec,
+                                    Router, ServingClient)
+    from paddle_tpu.models.gpt import GPTConfig
+
+    root = str(tmp_path / "gpt")
+    GPTDecodeModel(GPTConfig.tiny(num_layers=1), seed=0) \
+        .save_checkpoint(root)
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    ep_a, ep_b = (f"127.0.0.1:{free_port()}" for _ in range(2))
+    arm = str(tmp_path / "arm_kill")
+    # b decodes SLOWLY from the start — the serving_decode stall knob
+    # wedges every decode step 50ms (kept out of the stash), so a
+    # 30-token stream lasts ~1.5s and streams one frame per token. The
+    # KILL knob arms via the file at the stream's FIRST token; the next
+    # request b receives (the router's health ping, <=0.2s later)
+    # os._exits it at recv — a process death mid-decode with the
+    # pinned stream provably in flight
+    procs = {"a": _spawn_replica(ep_a, root),
+             "b": _spawn_replica(ep_b, root, extra_env={
+                 "REPLICA_ARM_FAULT_FILE": arm,
+                 "REPLICA_KEEP_FAULTS": "PADDLE_PS_FAULT_STALL,"
+                                        "PADDLE_PS_FAULT_STALL_POINT",
+                 "PADDLE_PS_FAULT_KILL_AFTER": "1",
+                 "PADDLE_PS_FAULT_KILL_POINT": "recv",
+                 "PADDLE_PS_FAULT_STALL": "0.05",
+                 "PADDLE_PS_FAULT_STALL_POINT": "serving_decode"})}
+    for p in procs.values():                 # both READY (parallel boot)
+        json.loads(p.stdout.readline())
+    death_rc: list = []
+
+    def respawn_b():
+        p = procs["b"]
+        death_rc.append(p.poll())
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=30)
+        p2 = _spawn_replica(ep_b, root)      # clean env: no kill knob
+        json.loads(p2.stdout.readline())
+        procs["b"] = p2
+        return ep_b
+
+    mk_gen = lambda name: LoadGenerator(
+        _traffic(seed=31, duration=2.0, rate=20), name=name)
+    router = Router("127.0.0.1:0",
+                    replicas=[ReplicaSpec("a", ep_a),
+                              ReplicaSpec("b", ep_b,
+                                          respawn=respawn_b)],
+                    ping_interval=0.2, ping_timeout=1.0,
+                    suspect_after=1, dead_after=2, token_stall=5.0,
+                    failover_retries=2, respawn_cooldown=0.5)
+    # reference output for the pinned long generate (local engine,
+    # same checkpoint: every replica must match it bit-for-bit)
+    ref_eng = Engine.from_checkpoint(root, **ROUTER_ENGINE_KW)
+    with ref_eng:
+        expected_long = ref_eng.generate([7, 8], 30,
+                                         timeout=60).tolist()
+    try:
+        with router:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60 \
+                    and router.stats()["healthy_replicas"] < 2:
+                time.sleep(0.05)
+            assert router.stats()["healthy_replicas"] == 2
+            cli = ServingClient(router.endpoint)
+            # -- baseline: fault-free, streaming TTFT on the wire ----
+            res_base = mk_gen("rt_base").run_client(cli, timeout=60)
+            assert res_base.wait(120)
+            rep_base = slo_report(res_base)
+            assert rep_base["attainment"] == 1.0, rep_base
+            assert rep_base["ttft_ms_p50"] > 0          # wire TTFT
+            base_tokens = {a.index: [int(t) for t in h.generated]
+                           for a, h in res_base.handles
+                           if h.status == "done"}
+
+            # -- faulted run: same seed; kill b mid-run --------------
+            with router._lock:               # pin the long stream on b
+                router._sessions["kill-me"] = "b"
+            long_box: dict = {}
+            armed = threading.Event()
+
+            def long_gen():
+                c = ServingClient(router.endpoint)
+                frames = []
+
+                def on_tok(toks, idx):
+                    frames.append((idx, list(toks)))
+                    if not armed.is_set():
+                        # the stream is provably mid-flight on b: arm
+                        # the kill NOW (b dies on its next received
+                        # request — the router's ping, within 0.2s —
+                        # while the delayed stream is still going)
+                        armed.set()
+                        open(arm, "w").close()
+
+                long_box["rep"] = c.generate(
+                    [7, 8], 30, timeout=120, stream=True,
+                    session="kill-me", on_token=on_tok)
+                long_box["frames"] = frames
+                c.close()
+
+            res_box: list = []
+            runner = threading.Thread(
+                target=lambda: res_box.append(
+                    mk_gen("rt_fault").run_client(cli, timeout=60)),
+                daemon=True)
+            runner.start()
+            time.sleep(0.6)                  # traffic flowing
+            lg = threading.Thread(target=long_gen, daemon=True)
+            lg.start()
+            lg.join(180)
+            assert armed.is_set(), "stream never produced a token"
+            runner.join(180)
+            assert res_box and res_box[0].wait(120)
+            res_fault = res_box[0]
+
+            # exactly-once on the failed-over stream: done, token
+            # parity with the reference, and the relayed frames are
+            # contiguous — nothing dropped, nothing duplicated
+            rep_long = long_box["rep"]
+            assert rep_long["status"] == "done", rep_long
+            final = [int(t) for t in np.asarray(
+                rep_long["tokens"]).ravel()]
+            assert final == expected_long
+            streamed: list = []
+            for idx, toks in long_box["frames"]:
+                assert idx == len(streamed), "stream gap/duplicate"
+                streamed.extend(int(t) for t in toks)
+            assert streamed == final
+            fo = REGISTRY.get("paddle_tpu_router_failovers_total")
+            assert sum(s.value for lv, s in fo._series()
+                       if lv[0] == router.router_id) >= 1
+
+            # dedup-verified parity on the generated traffic: every
+            # arrival that completed in both runs produced identical
+            # tokens (greedy determinism + exactly-once failover)
+            fault_tokens = {a.index: [int(t) for t in h.generated]
+                            for a, h in res_fault.handles
+                            if h.status == "done"}
+            both = set(base_tokens) & set(fault_tokens)
+            assert len(both) > 10
+            for i in both:
+                assert base_tokens[i] == fault_tokens[i], i
+
+            # -- elastic respawn from the engine checkpoint ----------
+            t0 = time.monotonic()
+            st = router.stats()
+            while time.monotonic() - t0 < 60:
+                st = router.stats()
+                if st["replicas"]["b"]["state"] == "healthy":
+                    break
+                time.sleep(0.2)
+            assert st["replicas"]["b"]["state"] == "healthy", st
+            assert st["replicas"]["b"]["epoch"] >= 1
+            # it was the FAULT KNOB that killed b, not the respawner
+            assert death_rc \
+                and death_rc[0] == fi.KILL_EXIT_CODE, death_rc
+
+            # -- post-recovery: same seed again, attainment band -----
+            disp = REGISTRY.get("paddle_tpu_router_dispatch_total")
+            b_disp_before = disp.labels(router=router.router_id,
+                                        replica="b").value
+            res_post = mk_gen("rt_post").run_client(cli, timeout=60)
+            assert res_post.wait(120)
+            rep_post = slo_report(res_post)
+            assert rep_post["attainment"] is not None
+            assert rep_post["attainment"] \
+                >= rep_base["attainment"] - 0.1, (rep_base, rep_post)
+            assert rep_post["ttft_ms_p50"] > 0
+            # the respawned replica takes traffic again
+            assert disp.labels(router=router.router_id,
+                               replica="b").value > b_disp_before
+            cli.close()
+    finally:
+        for p in procs.values():
             if p.poll() is None:
                 p.kill()
                 p.wait(timeout=30)
