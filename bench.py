@@ -44,7 +44,10 @@ mid-run under wire traffic — failover detect + respawn recovery
 seconds, post-recovery attainment delta, wire TTFT via streaming) |
 kernels (per-kernel fused-vs-unfused speedups for the epilogue-fused
 decoder sub-blocks + autobench tuning-cache cold/warm first-call
-latency).
+latency) | transport (multiplexed RPC A/B: wire TTFT p50/p99 through
+ONE shared client under a concurrency sweep of long streams, mux vs
+legacy one-call-per-channel, plus the zero-copy pull path's
+bytes-copied-per-payload-byte on both paths).
 """
 from __future__ import annotations
 
@@ -701,6 +704,127 @@ def _slo_traffic(duration, rate, seed):
         output_lens={4: 3, 8: 2, 16: 1},
         tenants={"web": 3, "batch": 1}, tiers={0: 1, 1: 2, 2: 1},
         deadlines={0: 10.0, 1: 20.0, 2: None}, vocab_size=512)
+
+
+def bench_transport(concurrencies=(1, 4, 8), probes=30, seed=0):
+    """BENCH_CONFIG=transport (docs/PS_WIRE_PROTOCOL.md mux framing):
+    the multiplexed transport's reason to exist, measured. ONE shared
+    RpcClient carries N long streamed generates while short streamed
+    probes measure wire TTFT (time to FIRST frame — queueing included);
+    the sweep repeats with mux=False (exclusive one-call-per-channel
+    legacy mode), which reproduces the PR-9 head-of-line symptom.
+    Also reports the zero-copy pull path: transport bytes-copied per
+    payload byte, mux vs legacy."""
+    import socketserver
+    import threading
+
+    from paddle_tpu.distributed.fleet.runtime import rpc
+
+    class _Srv(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+        def __init__(self):
+            state = rpc.RpcServerState(
+                read_ops=frozenset({"ping", "pull", "gen"}))
+
+            def dispatch(req):
+                op = req["op"]
+                if op == "ping":
+                    return "pong"
+                if op == "pull":
+                    n, d = int(req["n"]), int(req["d"])
+                    return {"rows": np.zeros((n, d), np.float32)}
+
+                def g():
+                    for i in range(int(req["n"])):
+                        time.sleep(float(req.get("gap", 0.02)))
+                        yield {"i": i}
+                    return {"done": True}
+                return g()
+
+            class H(socketserver.BaseRequestHandler):
+                def handle(self):
+                    rpc.serve_connection(self.request, dispatch, state)
+
+            super().__init__(("127.0.0.1", 0), H)
+            self.endpoint = f"127.0.0.1:{self.server_address[1]}"
+            threading.Thread(target=self.serve_forever,
+                             daemon=True).start()
+
+    def _copied(path):
+        for vals, child in rpc._MUX_BYTES_COPIED._series():
+            if vals == (path,):
+                return child.value
+        return 0.0
+
+    srv = _Srv()
+    modes = {}
+    for mode, mux in (("mux", True), ("legacy", False)):
+        cli = rpc.RpcClient(srv.endpoint, mux=mux, pool_size=2,
+                            timeout=30.0, deadline=60.0)
+        sweep = {}
+        for conc in concurrencies:
+            stop = threading.Event()
+
+            def pump():
+                # a continuous long stream occupying the shared client
+                while not stop.is_set():
+                    gen = cli.call_stream(
+                        {"op": "gen", "n": 10, "gap": 0.03},
+                        timeout=30, stream_timeout=30)
+                    try:
+                        for _ in gen:
+                            if stop.is_set():
+                                break
+                    finally:
+                        gen.close()
+
+            threads = [threading.Thread(target=pump, daemon=True)
+                       for _ in range(conc)]
+            for th in threads:
+                th.start()
+            time.sleep(0.2)      # streams in flight before probing
+            lats = []
+            for _ in range(probes):
+                t0 = time.perf_counter()
+                gen = cli.call_stream({"op": "gen", "n": 1, "gap": 0.0},
+                                      timeout=30, stream_timeout=30)
+                next(gen)        # FIRST frame = wire TTFT
+                lats.append(time.perf_counter() - t0)
+                for _ in gen:    # drain the final reply
+                    pass
+            stop.set()
+            for th in threads:
+                th.join(timeout=30)
+            lats.sort()
+            sweep[conc] = {
+                "ttft_p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "ttft_p99_ms": round(
+                    lats[min(len(lats) - 1,
+                             int(0.99 * len(lats)))] * 1e3, 2)}
+        # zero-copy pull path: bytes memcpy'd per payload byte
+        n, d, reps = 512, 64, 8
+        path = "mux" if mux else "legacy"
+        c0 = _copied(path)
+        for _ in range(reps):
+            cli.call({"op": "pull", "n": n, "d": d}, timeout=30)
+        copied_per_byte = (_copied(path) - c0) / (reps * n * d * 4)
+        cli.close()
+        modes[mode] = {"ttft": sweep,
+                       "pull_bytes_copied_per_payload_byte":
+                       round(copied_per_byte, 4)}
+    srv.shutdown()
+    srv.server_close()
+    top = max(concurrencies)
+    mux_p99 = modes["mux"]["ttft"][top]["ttft_p99_ms"]
+    legacy_p99 = modes["legacy"]["ttft"][top]["ttft_p99_ms"]
+    return {"metric": "transport_wire_ttft_p99_ms",
+            "value": mux_p99, "unit": "ms",
+            "concurrency": top, "probes": probes,
+            "p99_speedup_vs_legacy": round(legacy_p99 / mux_p99, 2)
+            if mux_p99 else None,
+            "modes": modes}
 
 
 def bench_slo(duration=6.0, rate=30.0, seed=7):
@@ -1418,6 +1542,8 @@ def main():
         rec = bench_gpt_1p3b()
     elif which == "kernels":
         rec = bench_kernels()
+    elif which == "transport":
+        rec = bench_transport()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,

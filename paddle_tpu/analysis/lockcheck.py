@@ -33,6 +33,7 @@ import _thread
 import os
 import sys
 import threading
+from contextlib import contextmanager
 
 __all__ = ["LockOrderError", "install", "uninstall", "installed",
            "reset", "graph", "violations", "report",
@@ -105,12 +106,33 @@ def _find_path(graph_: dict, src: str, dst: str) -> list[str] | None:
     return None
 
 
+@contextmanager
+def _bookkeeping():
+    """_state_lock plus a thread-local reentrancy flag. GC can run a
+    weakref/finalizer callback at any allocation — including inside
+    this critical section — and if that callback acquires an
+    instrumented lock, _note_acquired re-enters on the same thread and
+    would self-deadlock on the raw _state_lock. The flag lets the
+    nested call detect that and skip recording instead."""
+    _tls.in_bookkeeping = True
+    try:
+        with _state_lock:
+            yield
+    finally:
+        _tls.in_bookkeeping = False
+
+
 def _note_acquired(site: str, inst_id: int):
     """Record edges held -> site; detect would-be cycles. Runs BEFORE
     the real acquire so a detected inversion raises without blocking."""
+    if getattr(_tls, "in_bookkeeping", False):
+        # re-entered from a GC-triggered callback while this thread is
+        # already inside the sanitizer's critical section; recording
+        # would deadlock on _state_lock, so skip this acquisition
+        return
     held = _held()
     held_sites = [s for s, _i, _n in held]
-    with _state_lock:
+    with _bookkeeping():
         for h in held_sites:
             if h == site:
                 continue
@@ -317,7 +339,7 @@ def installed() -> bool:
 
 def reset():
     """Clear the recorded graph/violations (between tests)."""
-    with _state_lock:
+    with _bookkeeping():
         _edges.clear()
         _edge_witness.clear()
         _violations.clear()
@@ -325,18 +347,18 @@ def reset():
 
 
 def graph() -> dict[str, list[str]]:
-    with _state_lock:
+    with _bookkeeping():
         return {k: sorted(v) for k, v in sorted(_edges.items())}
 
 
 def violations() -> list[dict]:
-    with _state_lock:
+    with _bookkeeping():
         return [dict(v) for v in _violations]
 
 
 def report() -> dict:
     """JSON-safe summary (tests and postmortem tooling)."""
-    with _state_lock:
+    with _bookkeeping():
         return {"installed": _installed, "mode": _mode,
                 "sites": sorted(set(_edges)
                                 | {s for v in _edges.values()

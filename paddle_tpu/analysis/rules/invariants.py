@@ -217,6 +217,14 @@ REQUIRED_METRICS = {
     "paddle_tpu_autobench_cache_stale_total",
     "paddle_tpu_autobench_cache_corrupt_total",
     "paddle_tpu_autobench_measure_total",
+    # multiplexed RPC transport (docs/PS_WIRE_PROTOCOL.md mux framing):
+    # in-flight depth, pool size, zero-copy proof (bytes-copied by
+    # path) and reply reordering are the transport's acceptance
+    # contract — the transport bench asserts against these exact names
+    "paddle_tpu_rpc_mux_inflight",
+    "paddle_tpu_rpc_mux_channels",
+    "paddle_tpu_rpc_mux_bytes_copied_total",
+    "paddle_tpu_rpc_mux_out_of_order_total",
 }
 
 

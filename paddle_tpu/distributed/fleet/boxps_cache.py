@@ -37,6 +37,7 @@ class _TableCache:
         self.data = np.zeros((capacity, dim), np.float32)
         self.delta = np.zeros((capacity, dim), np.float32)
         self.dirty = np.zeros(capacity, bool)   # slots touched since flush
+        self.stale = np.zeros(capacity, bool)   # invalidated by the PS
         self.n = 0
 
     def ensure(self, kv_pull, uids: np.ndarray):
@@ -59,6 +60,31 @@ class _TableCache:
         idx[ok] = self.slot_of[ids[ok]]
         return idx
 
+    def invalidate(self, keys: np.ndarray | None = None):
+        """Mark cached rows stale (keys=None: the whole table). Safe to
+        call from the PS subscription thread concurrently with pulls:
+        it only SETS per-slot flags — the worst interleaving refreshes
+        a row one pull later, never serves it as fresh."""
+        if keys is None:
+            self.stale[:self.n] = True
+            return
+        keys = np.asarray(keys, np.int64).ravel()
+        keys = keys[keys < self.id_space]
+        slots = self.slot_of[keys]
+        self.stale[slots[slots >= 0]] = True
+
+    def refresh_stale(self, kv_pull) -> int:
+        """Re-pull every stale row; re-apply the locally-buffered
+        (unflushed) delta on top so read-your-writes holds: the local
+        view is authoritative-PS-value minus the pending delta."""
+        sl = np.flatnonzero(self.stale[:self.n])
+        if not len(sl):
+            return 0
+        fresh = kv_pull(self.ids[sl])
+        self.data[sl] = fresh - self.delta[sl]
+        self.stale[sl] = False
+        return len(sl)
+
 
 class BoxPSWrapper:
     """FleetWrapper facade with a hot-row cache on the sparse tables."""
@@ -74,6 +100,8 @@ class BoxPSWrapper:
         self._first_table = None
         self.cache_hits = 0
         self.cache_misses = 0
+        self.stale_refreshes = 0   # rows re-pulled after invalidation
+        self._inval_stop = None
 
     def _table(self, name: str, dim: int) -> _TableCache:
         t = self._tables.get(name)
@@ -101,6 +129,11 @@ class BoxPSWrapper:
         t.ensure(lambda m: self.fw.pull_sparse(table, m, dim,
                                                init_std=init_std),
                  np.unique(ids))
+        # PS-pushed invalidations (other workers' flushed updates) land
+        # as stale flags; refresh them before serving from the cache
+        self.stale_refreshes += t.refresh_stale(
+            lambda m: self.fw.pull_sparse(table, m, dim,
+                                          init_std=init_std))
         idx = t.lookup(ids)
         hit = idx >= 0
         self.cache_hits += int(hit.sum())
@@ -173,6 +206,34 @@ class BoxPSWrapper:
                     first_err = first_err or e
         if first_err is not None:
             raise first_err
+
+    # -- PS-pushed invalidation wiring (PR 11) --------------------------
+    def invalidate(self, table: str, keys=None):
+        """Invalidation callback: mark cached rows of ``table`` stale
+        (keys=None invalidates the whole table). Shaped to plug
+        straight into PSClient.subscribe_invalidations."""
+        t = self._tables.get(table)
+        if t is not None:
+            t.invalidate(keys)
+
+    def attach_invalidations(self, ps_client=None) -> bool:
+        """Subscribe this cache to the PS shards' push-invalidation
+        stream, so other workers' flushed updates stop being served
+        stale between this worker's own flushes. Defaults to the
+        wrapped FleetWrapper's own PSClient; returns False when there
+        is none (local mode)."""
+        if ps_client is None:
+            ps_client = getattr(self.fw, "_client", None)
+        if ps_client is None:
+            return False
+        self._inval_stop = ps_client.subscribe_invalidations(
+            self.invalidate)
+        return True
+
+    def detach_invalidations(self):
+        if self._inval_stop is not None:
+            self._inval_stop.set()
+            self._inval_stop = None
 
     # -- dense + misc pass-through --------------------------------------
     def pull_dense(self, name, shape):

@@ -39,6 +39,26 @@ frame when nothing is configured):
                                 server share one process env)
   PADDLE_PS_FAULT_SEED=n        deterministic fault schedule
 
+Frame-granular faults (multiplexed channels): target ONE mux frame by
+request id — the point is proving a fault is contained to its own call
+while concurrent calls on the same socket complete untouched.
+
+  PADDLE_PS_FAULT_FRAME_ACTION=corrupt|drop|delay   what to do to the
+                                matched frame: flip a body byte (peer
+                                answers that id with a retryable wire
+                                error), swallow it (that call times
+                                out), or hold it back so later frames
+                                overtake it on the wire
+  PADDLE_PS_FAULT_FRAME_REQ=id  match: a full 64-bit request id, or
+                                "seq:N" to match the low-32-bit
+                                sequence number (client token unknown
+                                up front), or "any" for the first frame
+  PADDLE_PS_FAULT_FRAME_DELAY=sec   hold-back for action=delay
+                                (default 0.2)
+
+The frame fault fires ONCE (first matching frame on an injecting side);
+tests can re-arm programmatically via ``set_frame_fault``.
+
 A PADDLE_PS_FAULT_-prefixed env var that is NOT one of the above is a
 typo (a chaos drill that silently injects nothing is worse than one
 that fails loudly): `from_env` logs a warning naming it.
@@ -67,7 +87,8 @@ KNOWN_FAULT_KNOBS = frozenset({
     "PADDLE_PS_FAULT_KILL_AFTER", "PADDLE_PS_FAULT_KILL_POINT",
     "PADDLE_PS_FAULT_KILL_AFTER_BYTES", "PADDLE_PS_FAULT_STALL",
     "PADDLE_PS_FAULT_STALL_POINT", "PADDLE_PS_FAULT_SIDE",
-    "PADDLE_PS_FAULT_SEED",
+    "PADDLE_PS_FAULT_SEED", "PADDLE_PS_FAULT_FRAME_ACTION",
+    "PADDLE_PS_FAULT_FRAME_REQ", "PADDLE_PS_FAULT_FRAME_DELAY",
 })
 
 logger = logging.getLogger(__name__)
@@ -81,7 +102,9 @@ class FaultInjector:
                  kill_after: int = 0, kill_point: str = "reply",
                  kill_after_bytes: int = 0, stall: float = 0.0,
                  stall_point: str = "dispatch",
-                 side: str = "both", seed: int = 0):
+                 side: str = "both", seed: int = 0,
+                 frame_action: str = "", frame_req: str = "",
+                 frame_delay: float = 0.2):
         self.drop = drop
         self.delay = delay
         self.truncate = truncate
@@ -92,13 +115,17 @@ class FaultInjector:
         self.stall = stall
         self.stall_point = stall_point
         self.side = side
+        self.frame_action = frame_action
+        self.frame_req = frame_req
+        self.frame_delay = frame_delay
+        self._frame_fired = False
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._requests = 0
         self._bytes = 0
         self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
                          "corrupted": 0, "requests": 0, "bytes": 0,
-                         "stalled": 0}
+                         "stalled": 0, "frame_faults": 0}
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -124,22 +151,78 @@ class FaultInjector:
             stall=float(e("PADDLE_PS_FAULT_STALL", "0") or 0),
             stall_point=e("PADDLE_PS_FAULT_STALL_POINT", "dispatch"),
             side=e("PADDLE_PS_FAULT_SIDE", "both"),
-            seed=int(e("PADDLE_PS_FAULT_SEED", "0") or 0))
+            seed=int(e("PADDLE_PS_FAULT_SEED", "0") or 0),
+            frame_action=e("PADDLE_PS_FAULT_FRAME_ACTION", "") or "",
+            frame_req=e("PADDLE_PS_FAULT_FRAME_REQ", "any") or "any",
+            frame_delay=float(
+                e("PADDLE_PS_FAULT_FRAME_DELAY", "0.2") or 0.2))
 
     @property
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.truncate
                     or self.corrupt or self.kill_after
-                    or self.kill_after_bytes or self.stall)
+                    or self.kill_after_bytes or self.stall
+                    or self.frame_action)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
 
+    # -- frame-granular faults (multiplexed channels) --------------------
+    def set_frame_fault(self, action: str, req: str = "any",
+                        delay: float = 0.2, side: str | None = None):
+        """(Re)arm a one-shot fault against a single mux frame. `req`
+        matches like PADDLE_PS_FAULT_FRAME_REQ: a full id, "seq:N" for
+        the low-32-bit sequence, or "any"."""
+        with self._lock:
+            self.frame_action = action
+            self.frame_req = str(req)
+            self.frame_delay = delay
+            self._frame_fired = False
+            if side is not None:
+                self.side = side
+
+    def _frame_matches(self, req_id: int) -> bool:
+        spec = self.frame_req
+        if spec in ("", "any"):
+            return True
+        if spec.startswith("seq:"):
+            return (req_id & 0xFFFFFFFF) == int(spec[4:])
+        return req_id == int(spec)
+
+    def frame_fault(self, req_id: int,
+                    side: str | None) -> tuple[str, float] | None:
+        """One-shot fault check for a single outgoing mux frame.
+        Returns None (send normally) or (action, delay_seconds) with
+        action in {"corrupt", "drop", "delay"} — the fault is consumed
+        by the first matching frame on an injecting side."""
+        if not self.frame_action or not self._applies(side):
+            return None
+        with self._lock:
+            if self._frame_fired or not self._frame_matches(req_id):
+                return None
+            self._frame_fired = True
+            self.counters["frame_faults"] += 1
+            return self.frame_action, self.frame_delay
+
     # -- frame mangling (called from rpc.send_frame) --------------------
-    def mangle(self, frame: bytes, body_off: int,
-               side: str | None) -> tuple[bytes, str]:
+    def mangle(self, frame: bytes, body_off: int, side: str | None,
+               req_id: int | None = None) -> tuple[bytes, str]:
         """Returns (frame', action) where action is one of
-        "send" | "drop" | "truncate"."""
+        "send" | "drop" | "truncate" | "skip" ("skip": the frame is
+        consumed without a send AND without killing the connection —
+        only the frame-granular path produces it)."""
+        if req_id is not None and self.frame_action:
+            act = self.frame_fault(req_id, side)
+            if act is not None:
+                kind, _delay = act
+                if kind == "drop":
+                    return frame, "skip"
+                if kind == "delay":
+                    time.sleep(_delay)
+                elif kind == "corrupt" and len(frame) > body_off:
+                    buf = bytearray(frame)
+                    buf[body_off] ^= 0xFF
+                    frame = bytes(buf)
         if not self._applies(side):
             return frame, "send"
         with self._lock:

@@ -24,6 +24,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import queue
 import socketserver
 import threading
 import time
@@ -351,6 +352,17 @@ class _DGCRound:
             return {"idx": idx, "val": val}
 
 
+class _InvalSub:
+    """One subscriber's invalidation feed: a bounded event queue plus
+    an overflow set of tables owed a WHOLE-table invalidation (losing
+    an event must degrade to over-invalidation, never staleness)."""
+
+    def __init__(self, maxsize: int):
+        self.q: queue.Queue = queue.Queue(maxsize)
+        self.lost: set[str] = set()
+        self.lock = threading.Lock()
+
+
 class PSServer(socketserver.ThreadingTCPServer):
     """One PS shard: serves pull/push/save/size for its tables (reference
     listen_and_serv_op RunAsyncLoop — apply-on-arrival, no global
@@ -369,15 +381,21 @@ class PSServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
     # ops that never mutate server state: exempt from dedup caching
+    # (subscribe_inval only touches the subscriber registry — replaying
+    # a subscription must open a fresh stream, never a cached reply)
     READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
-                          "heartbeat", "metrics", "debug_dump"})
+                          "heartbeat", "metrics", "debug_dump",
+                          "subscribe_inval"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
-    # verbs that legitimately block on straggler trainers: they never
-    # count as in-flight work for the stall watchdog (a barrier waiting
-    # out a slow trainer is round semantics, not a wedged server)
+    # verbs that legitimately block on straggler trainers (or, for
+    # subscribe_inval, sit open for the subscriber's lifetime): they
+    # never count as in-flight work for the stall watchdog (a barrier
+    # waiting out a slow trainer is round semantics, not a wedged
+    # server)
     _BLOCKING_OPS = frozenset({"send_barrier", "fetch_barrier",
-                               "dgc_push", "dgc_pull"})
+                               "dgc_push", "dgc_pull",
+                               "subscribe_inval"})
 
     def __init__(self, endpoint: str, worker_timeout: float = 60.0,
                  snapshot_dir: str | None = None,
@@ -398,6 +416,17 @@ class PSServer(socketserver.ThreadingTCPServer):
         self._beats: dict[int, float] = {}
         self._dgc: dict[str, _DGCRound] = {}
         self._beats_lock = threading.Lock()
+        # hot-row invalidation pub/sub (PR 11): every applied push
+        # publishes {table, keys} to each subscriber's bounded queue;
+        # the subscribe_inval stream drains it over server-push frames.
+        # A queue overflow degrades to a whole-table invalidation
+        # marker instead of dropping keys silently.
+        self._inval_lock = threading.Lock()
+        self._inval_subs: dict[int, "_InvalSub"] = {}
+        self._inval_ids = itertools.count()
+        self._inval_queue_max = int(os.environ.get(
+            "PADDLE_PS_INVAL_QUEUE", "1024") or 0)
+        self.inval_published = 0   # events fanned out (tests/bench)
 
         env = os.environ.get
         self.snapshot_dir = snapshot_dir \
@@ -1024,6 +1053,53 @@ class PSServer(socketserver.ThreadingTCPServer):
         with self._snap_lock:
             self._dirty.add(name)
 
+    # -- hot-row invalidation pub/sub (PR 11) ---------------------------
+    def _publish_inval(self, table: str, keys):
+        """Fan an applied push's {table, keys} out to every subscriber.
+        Non-blocking: a full queue records the table in the
+        subscriber's overflow set (-> whole-table invalidation) so a
+        slow subscriber can never stall the push path."""
+        with self._inval_lock:
+            subs = list(self._inval_subs.values())
+        if not subs:
+            return
+        keys = np.asarray(keys, np.int64).ravel().copy()
+        ev = {"table": table, "keys": keys}
+        for s in subs:
+            try:
+                s.q.put_nowait(ev)
+            except queue.Full:
+                with s.lock:
+                    s.lost.add(table)
+        self.inval_published += 1
+
+    def _subscribe_inval(self):
+        """Dispatch generator for the subscribe_inval op: registers a
+        subscriber and streams its events as server-push frames until
+        the client cancels (F_CANCEL -> GeneratorExit) or disconnects.
+        Keepalive frames every few seconds keep the stream's cancel
+        check live while the shard is idle."""
+        sub = _InvalSub(self._inval_queue_max)
+        with self._inval_lock:
+            sid = next(self._inval_ids)
+            self._inval_subs[sid] = sub
+        try:
+            yield {"subscribed": True}
+            while True:
+                with sub.lock:
+                    lost, sub.lost = sub.lost, set()
+                for t in sorted(lost):
+                    yield {"table": t, "full": True}
+                try:
+                    ev = sub.q.get(timeout=5.0)
+                except queue.Empty:
+                    yield {"keepalive": True}
+                    continue
+                yield ev
+        finally:
+            with self._inval_lock:
+                self._inval_subs.pop(sid, None)
+
     def _dispatch(self, req: dict):
         """In-flight accounting wrapper around the op switch: arms the
         stall watchdog token (non-barrier ops only), applies the
@@ -1071,6 +1147,7 @@ class PSServer(socketserver.ThreadingTCPServer):
                 req["keys"], req["grads"], req.get("lr", 1.0))
             if self.snapshot_dir:
                 self._mark_dirty(req["table"])
+            self._publish_inval(req["table"], req["keys"])
             return True
         if op == "save":
             tag = self.endpoint.replace(":", "_")
@@ -1096,6 +1173,7 @@ class PSServer(socketserver.ThreadingTCPServer):
                     # loss of its batch shard
                     t = self.table(table, dim)
                     t.push(keys, grads, lr / n)
+                    self._publish_inval(table, keys)
                     if self.snapshot_dir:
                         # sync-mode mutation: the post-barrier delta
                         # snapshot must carry these tables too
@@ -1119,6 +1197,8 @@ class PSServer(socketserver.ThreadingTCPServer):
         if op == "fetch_barrier":
             return self._sync_state(req["trainers"]).fetch_barrier(
                 req["worker"])
+        if op == "subscribe_inval":
+            return self._subscribe_inval()
         if op == "ping":
             return "pong"
         if op == "metrics":
@@ -1225,6 +1305,8 @@ class PSClient:
                       max_retries=max_retries, backoff=backoff)
             for ep in self.endpoints]
         self._pool = None  # lazy persistent fan-out pool
+        self._inval_stop: threading.Event | None = None
+        self._inval_threads: list[threading.Thread] = []
 
     @property
     def bytes_out(self) -> int:
@@ -1350,6 +1432,68 @@ class PSClient:
         return {ep: self._call(i, dict(req))
                 for i, ep in enumerate(self.endpoints)}
 
+    # -- hot-row invalidation subscription (PR 11) -----------------------
+    def subscribe_invalidations(self, callback) -> threading.Event:
+        """Subscribe to every shard's push-invalidation stream over the
+        multiplexed channel (the stream shares the shard channel with
+        pulls/pushes — no extra connection). ``callback(table, keys)``
+        fires per event from a background thread; ``keys`` is an int64
+        array, or ``None`` for a whole-table invalidation (the server
+        overflowed this subscriber's queue). Returns a stop Event —
+        set it (or call ``close()``) to end the subscription; each
+        stream's F_CANCEL then frees the server-side subscriber.
+
+        Reconnect loop: a dead shard ends the stream with a transport
+        error; the thread re-subscribes with backoff, and the FIRST
+        event after a resubscribe is preceded by a synthetic
+        whole-table pass only if the server reports overflow — a
+        subscriber that missed pushes while disconnected should treat
+        the resubscribe ack as a full-invalidation trigger itself via
+        ``on_resubscribe``-style wrapping if it needs that guarantee
+        (BoxPSWrapper.flush's refresh covers the training loop)."""
+        if self._inval_stop is not None and not self._inval_stop.is_set():
+            raise RuntimeError("invalidation subscription already active")
+        stop = threading.Event()
+        self._inval_stop = stop
+        self._inval_threads = [
+            threading.Thread(target=self._inval_loop,
+                             args=(i, callback, stop), daemon=True,
+                             name=f"ps-inval-{i}")
+            for i in range(len(self.endpoints))]
+        for th in self._inval_threads:
+            th.start()
+        return stop
+
+    def _inval_loop(self, i: int, callback, stop: threading.Event):
+        while not stop.is_set():
+            gen = None
+            try:
+                gen = self._clients[i].call_stream(
+                    {"op": "subscribe_inval"},
+                    timeout=30.0, stream_timeout=30.0)
+                for ev in gen:
+                    if stop.is_set():
+                        return
+                    if not isinstance(ev, dict):
+                        continue
+                    table = ev.get("table")
+                    if table is None:   # subscribed/keepalive frames
+                        continue
+                    if ev.get("full"):
+                        callback(table, None)
+                    else:
+                        callback(table,
+                                 np.asarray(ev["keys"], np.int64))
+            except Exception:
+                pass   # shard down or stream stalled: resubscribe
+            finally:
+                if gen is not None:
+                    try:
+                        gen.close()   # sends F_CANCEL if mid-stream
+                    except Exception:
+                        pass
+            stop.wait(0.5)
+
     # -- DGC sparse-gradient rounds (shard by index hash) ----------------
     def dgc_allreduce(self, name: str, idx, val, worker: int,
                       trainers: int):
@@ -1383,6 +1527,8 @@ class PSClient:
         return midx[order], mval[order]
 
     def close(self):
+        if self._inval_stop is not None:
+            self._inval_stop.set()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None

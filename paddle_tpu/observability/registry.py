@@ -228,7 +228,11 @@ class _Metric:
         self.always = bool(always)
         self._registry = registry
         self._children: dict[tuple, _Child] = {}
-        self._lock = threading.Lock()
+        # RLock: remove_matching() runs from gc-driven finalizers (a
+        # dead Router/RpcClient dropping its per-instance series) and
+        # gc can trigger inside labels()/_series() while THIS thread
+        # already holds the lock — a plain Lock self-deadlocks there
+        self._lock = threading.RLock()
         for ln in self.labelnames:
             if not _LABEL_RE.match(ln):
                 raise MetricError(f"bad label name {ln!r}")

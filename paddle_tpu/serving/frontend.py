@@ -284,7 +284,14 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
 
 class ServingClient:
-    """Thin client over RpcClient (retry/deadline/dedup semantics)."""
+    """Thin client over RpcClient (retry/deadline/dedup semantics).
+
+    Thread-safe and truly concurrent since the multiplexed transport
+    (PR 11): calls and streamed generates from many threads interleave
+    over the pooled channels (PADDLE_TPU_RPC_POOL_SIZE sockets,
+    replies matched by request id), so one shared ServingClient no
+    longer serializes callers — a long streamed generate does not
+    head-of-line block a concurrent ping."""
 
     def __init__(self, endpoint: str, secret: str | None = None,
                  timeout: float | None = None):

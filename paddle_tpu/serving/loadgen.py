@@ -286,7 +286,12 @@ class LoadGenerator:
                    stream: bool = True, **kw) -> LoadResult:
         """Replay over the wire (serving/frontend.py ServingClient or
         a router). The blocking `generate` calls run on their own
-        threads so the arrival process stays open-loop; each handle
+        threads so the arrival process stays open-loop; since the
+        multiplexed transport (PR 11) those threads genuinely share
+        ONE client's pooled channels — concurrent calls interleave by
+        request id on the same sockets instead of each opening a
+        connection, so wire TTFT measures the server, not
+        head-of-line queueing in the client. Each handle
         mimics Request enough for slo_report
         (wait/status/generated/deadline...). With ``stream=True`` (the
         default) each call rides the streaming wire generate: token

@@ -174,9 +174,12 @@ class Replica:
         self.respawn_inflight = False
         self.probe_inflight = False
         self.last_respawn = -1e9
-        self._pool: list[RpcClient] = []
-        self._pool_lock = threading.Lock()
-        self._ping_client: RpcClient | None = None
+        # ONE multiplexed client per replica: generates, pings, and
+        # drain verbs interleave over its pooled channels — a streamed
+        # generate no longer monopolizes a connection, and the health
+        # probe shares the wire it is probing (PR 11)
+        self._cli: RpcClient | None = None
+        self._cli_lock = threading.Lock()
 
     @property
     def routable(self) -> bool:
@@ -201,14 +204,11 @@ class Replica:
                 self.last_pick)
 
     def reset_channel(self):
-        """Close every pooled connection (respawn/endpoint change)."""
-        with self._pool_lock:
-            pool, self._pool = self._pool, []
-            ping, self._ping_client = self._ping_client, None
-        for c in pool:
-            c.close()
-        if ping is not None:
-            ping.close()
+        """Close the shared mux client (respawn/endpoint change)."""
+        with self._cli_lock:
+            cli, self._cli = self._cli, None
+        if cli is not None:
+            cli.close()
 
 
 class Router(socketserver.ThreadingTCPServer):
@@ -485,21 +485,15 @@ class Router(socketserver.ThreadingTCPServer):
         r.reset_channel()
 
     def _probe(self, r: Replica):
-        with r._pool_lock:
-            cli = r._ping_client
-            if cli is None or cli.endpoint != r.endpoint:
-                old = cli
-                cli = r._ping_client = RpcClient(
-                    r.endpoint, secret=self.secret,
-                    timeout=self.ping_timeout,
-                    deadline=self.ping_timeout * 2, max_retries=0)
-            else:
-                old = None
-        if old is not None:
-            old.close()
+        cli = self._client(r)
         epoch = r.epoch
         try:
-            info = cli.call({"op": "ping"})
+            # fail-fast per-call override on the SHARED channel: the
+            # probe rides the same wire the generates use, so a green
+            # ping vouches for the path requests actually take
+            info = cli.call({"op": "ping"}, timeout=self.ping_timeout,
+                            deadline=self.ping_timeout * 2,
+                            max_retries=0)
         except Exception:
             self._note_failure(r, "ping", epoch=epoch)
         else:
@@ -517,7 +511,8 @@ class Router(socketserver.ThreadingTCPServer):
         # blocks ITS probe for ping_timeout, never the others' cadence
         # — failure detection must not slow down exactly when several
         # replicas are sick. probe_inflight keeps probes of one
-        # replica serial (the ping channel is single-user).
+        # replica serial (one probe verdict per replica at a time,
+        # even though the shared mux channel could carry many).
         while not self._stop_ev.wait(self.ping_interval):
             for r in list(self._replicas.values()):
                 if self._stop_ev.is_set():
@@ -585,26 +580,24 @@ class Router(socketserver.ThreadingTCPServer):
                 if r.slow_cap < r.max_inflight:
                     r.slow_cap = min(r.max_inflight, r.slow_cap * 2)
 
-    def _borrow(self, r: Replica) -> RpcClient:
-        with r._pool_lock:
-            if r._pool:
-                return r._pool.pop()
-        return RpcClient(r.endpoint, secret=self.secret,
-                         timeout=self.default_timeout,
-                         deadline=self.default_timeout * 2,
-                         max_retries=0)
-
-    def _return(self, r: Replica, cli: RpcClient, epoch: int,
-                good: bool):
-        if not good or epoch != r.epoch \
-                or cli.endpoint != r.endpoint:
-            cli.close()
-            return
-        with r._pool_lock:
-            if len(r._pool) < r.max_inflight:
-                r._pool.append(cli)
-                return
-        cli.close()
+    def _client(self, r: Replica) -> RpcClient:
+        """The replica's one multiplexed client, rebuilt lazily when
+        the endpoint moved (respawn). Construction is lazy-connecting,
+        so nothing blocks under the lock."""
+        with r._cli_lock:
+            cli = r._cli
+            if cli is None or cli.endpoint != r.endpoint:
+                old = cli
+                cli = r._cli = RpcClient(
+                    r.endpoint, secret=self.secret,
+                    timeout=self.default_timeout,
+                    deadline=self.default_timeout * 2,
+                    max_retries=0)
+            else:
+                old = None
+        if old is not None:
+            old.close()
+        return cli
 
     def _forward_req(self, req: dict) -> dict:
         fwd = {"op": "generate", "prompt": req["prompt"],
@@ -649,7 +642,7 @@ class Router(socketserver.ThreadingTCPServer):
             epoch = r.epoch
             _R_DISPATCH.labels(router=self.router_id,
                                replica=r.name).inc()
-            cli = self._borrow(r)
+            cli = self._client(r)
             ok = None   # True = channel fine, False = transport fault,
             #             None = abandoned (upstream died mid-relay)
             try:
@@ -714,9 +707,10 @@ class Router(socketserver.ThreadingTCPServer):
                 # runs on EVERY exit — including GeneratorExit when the
                 # upstream client dies mid-relay, which must not leak
                 # the in-flight reservation (capacity would shrink
-                # forever) or grow the slow-start cap
+                # forever) or grow the slow-start cap. The shared mux
+                # client needs no return/close: an abandoned stream
+                # sends F_CANCEL and the channel itself stays pooled.
                 self._release(r, ok is True)
-                self._return(r, cli, epoch, ok is True)
             status = final.get("status", "?") \
                 if isinstance(final, dict) else "?"
             if status == "rejected" \
@@ -795,19 +789,16 @@ class Router(socketserver.ThreadingTCPServer):
             if r is None:
                 raise ValueError(f"unknown replica {name!r}")
             self._set_state(r, DRAINING)
-            endpoint = r.endpoint
         # forward the drain verb so the replica itself stops admitting
-        # (direct clients included) and finishes its queue
-        cli = RpcClient(endpoint, secret=self.secret,
-                        timeout=self.ping_timeout * 4,
-                        deadline=self.ping_timeout * 8, max_retries=1)
-        try:
-            rep = cli.call({"op": "drain", "wait": bool(req.get("wait")),
-                            "timeout": req.get("timeout")},
-                           timeout=float(req.get("timeout") or 60) + 30,
-                           deadline=float(req.get("timeout") or 60) + 60)
-        finally:
-            cli.close()
+        # (direct clients included) and finishes its queue — on the
+        # replica's shared mux client, interleaved with whatever
+        # in-flight generates it is finishing
+        rep = self._client(r).call(
+            {"op": "drain", "wait": bool(req.get("wait")),
+             "timeout": req.get("timeout")},
+            timeout=float(req.get("timeout") or 60) + 30,
+            deadline=float(req.get("timeout") or 60) + 60,
+            max_retries=1)
         return {"replica": name, "draining": True,
                 "idle": rep.get("idle") if isinstance(rep, dict)
                 else None}
