@@ -47,7 +47,11 @@ decoder sub-blocks + autobench tuning-cache cold/warm first-call
 latency) | transport (multiplexed RPC A/B: wire TTFT p50/p99 through
 ONE shared client under a concurrency sweep of long streams, mux vs
 legacy one-call-per-channel, plus the zero-copy pull path's
-bytes-copied-per-payload-byte on both paths).
+bytes-copied-per-payload-byte on both paths) | online (continuous
+publish pipeline: PS push -> servable-version staleness on the wire,
+streamed-generate max inter-token gap across a staggered 2-replica
+rollout vs steady-state ITL, cross-version chunk dedup ratio on a
+one-row-mutated embedding).
 """
 from __future__ import annotations
 
@@ -1083,6 +1087,150 @@ def bench_router(duration=8.0, rate=25.0, seed=7, kill_at=2.5):
             "offered_rate_rps": rate, "duration_s": duration}
 
 
+def bench_online(staleness_rounds=5, cadence_steps=3, stream_tokens=64,
+                 dedup_rows=512, dedup_dim=256, seed=0):
+    """BENCH_CONFIG=online (docs/ONLINE_LEARNING.md): the continuous
+    publish pipeline end to end. Three numbers: (1) publish staleness
+    — PS training pushes into a publish-wired PSServer; time from the
+    cadence-triggering commit to the new version answering on the
+    pub_latest wire (manifest + registry both durable, i.e. servable);
+    (2) swap pause — a streamed wire generate spans a staggered
+    2-replica rollout; max inter-token gap inside the flip window vs
+    the same stream's gap outside it (the adopt happens under the
+    engine step lock, so the pause should be ~one weight load, not a
+    drain); (3) cross-version chunk dedup — a one-row-mutated
+    embedding republished through the content-addressed store."""
+    import tempfile
+    import threading
+
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient, PSServer
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.publish import Publisher, RegistryClient
+    from paddle_tpu.serving import (GPTDecodeModel, InProcessReplica,
+                                    Router, ServingClient)
+
+    base = tempfile.mkdtemp(prefix="bench_online_")
+
+    # -- (1) train-push -> servable staleness over the PS wire --------
+    ps_pub = os.path.join(base, "ps_pub")
+    srv = PSServer("127.0.0.1:0", publish_dir=ps_pub,
+                   publish_every_steps=cadence_steps)
+    srv.serve_in_thread()
+    cl = PSClient([srv.endpoint])
+    watcher = RegistryClient(srv.endpoint)
+    rng = np.random.RandomState(seed)
+    staleness = []
+    try:
+        for round_i in range(staleness_rounds):
+            for j in range(cadence_steps):
+                ids = np.arange(j * 8, j * 8 + 8)
+                t0 = time.perf_counter()
+                cl.push("emb", 64, ids, rng.randn(8, 64))
+            want = round_i + 1
+            while watcher.latest()["latest"] < want:
+                time.sleep(0.002)
+            staleness.append(time.perf_counter() - t0)
+    finally:
+        watcher.close()
+        cl.close()
+        srv.shutdown()
+        srv.server_close()
+    staleness.sort()
+    stale_p50 = staleness[len(staleness) // 2]
+
+    # -- (2) swap pause on the wire -----------------------------------
+    ckpt = os.path.join(base, "gpt")
+    pub = os.path.join(base, "pub")
+    cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                    max_position_embeddings=256, vocab_size=4096)
+    GPTDecodeModel(cfg, seed=seed).save_checkpoint(ckpt)
+    engine_kw = dict(num_slots=8, num_pages=128, page_size=8,
+                     max_seq_len=96)
+    reps = []
+    for i in range(2):
+        r = InProcessReplica(ckpt, name=f"rep{i}", engine_kw=engine_kw,
+                             publish_root=pub)
+        r.start()
+        r.engine.submit(np.full((4,), 1, np.int32), 2)
+        r.engine.run_until_idle()   # compile outside the window
+        reps.append(r)
+    router = Router("127.0.0.1:0", replicas=[r.spec() for r in reps],
+                    ping_interval=0.2, ping_timeout=1.0,
+                    suspect_after=1, dead_after=2, token_stall=5.0,
+                    respawn_cooldown=0.5, publish_root=pub)
+    frames = []          # (arrival_monotonic, index)
+    flip = {}
+    with router:
+        cli = ServingClient(router.endpoint)
+        try:
+            def publish_and_roll():
+                # flip once the stream is warmed up (a few frames in)
+                while len(frames) < 4:
+                    time.sleep(0.005)
+                Publisher(pub).publish_model(
+                    GPTDecodeModel(cfg, seed=seed + 1), step=100)
+                flip["t0"] = time.monotonic()
+                flip["res"] = router.rollout_version()
+                flip["t1"] = time.monotonic()
+
+            flipper = threading.Thread(target=publish_and_roll,
+                                       daemon=True)
+            flipper.start()
+            cli.generate(np.array([9, 8, 7], np.int32),
+                         max_new_tokens=stream_tokens, stream=True,
+                         on_token=lambda toks, idx: frames.append(
+                             (time.monotonic(), idx)))
+            flipper.join(120)
+        finally:
+            cli.close()
+    for r in reps:
+        r.stop()
+    gaps_in, gaps_out = [], []
+    for (t_prev, _i0), (t_cur, _i1) in zip(frames, frames[1:]):
+        gap = t_cur - t_prev
+        if "t0" in flip and flip["t0"] <= t_cur <= flip["t1"] + 0.05:
+            gaps_in.append(gap)
+        else:
+            gaps_out.append(gap)
+    pause_ms = max(gaps_in) * 1e3 if gaps_in else 0.0
+    steady_ms = (sorted(gaps_out)[len(gaps_out) // 2] * 1e3
+                 if gaps_out else 0.0)
+
+    # -- (3) cross-version chunk dedup --------------------------------
+    # chunk grid smaller than the table so a one-row delta shares all
+    # untouched chunks with the previous version (the production-scale
+    # shape; at the default chunk size this toy table is ONE chunk)
+    from paddle_tpu.checkpoint import CheckpointStore
+    dedup_root = os.path.join(base, "dedup")
+    dpub = Publisher(dedup_root,
+                     store=CheckpointStore(dedup_root,
+                                           chunk_bytes=16384))
+    table = np.random.RandomState(seed + 2).randn(
+        dedup_rows, dedup_dim).astype(np.float32)
+    dpub.publish_arrays({"r:emb": table}, step=1, kind="ps-table")
+    table[dedup_rows // 2, :] += 1.0   # one-row online update
+    t0 = time.perf_counter()
+    rec2 = dpub.publish_arrays({"r:emb": table}, step=2,
+                               kind="ps-table")
+    publish_s = time.perf_counter() - t0
+    return {"metric": "online_publish_staleness_s",
+            "value": round(stale_p50, 4), "unit": "s_push_to_servable",
+            "staleness_p50_s": round(stale_p50, 4),
+            "staleness_max_s": round(staleness[-1], 4),
+            "cadence_steps": cadence_steps,
+            "swap_pause_ms": round(pause_ms, 2),
+            "steady_itl_ms": round(steady_ms, 2),
+            "rollout_wall_s": round(flip["t1"] - flip["t0"], 3)
+            if "t1" in flip else None,
+            "rollout_adopted": (flip.get("res") or {}).get("adopted"),
+            "stream_frames": len(frames),
+            "dedup_ratio": round(float(
+                rec2["extra"]["dedup"]), 4),
+            "dedup_republish_s": round(publish_s, 4),
+            "dedup_array_mb": round(table.nbytes / 2**20, 2)}
+
+
 def _bench_serving_toggle_overhead(set_enabled, metric_name, steps=200,
                                    hidden=256, layers=4, heads=4,
                                    slots=4, seed=0):
@@ -1544,6 +1692,8 @@ def main():
         rec = bench_kernels()
     elif which == "transport":
         rec = bench_transport()
+    elif which == "online":
+        rec = bench_online()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,

@@ -225,6 +225,17 @@ REQUIRED_METRICS = {
     "paddle_tpu_rpc_mux_channels",
     "paddle_tpu_rpc_mux_bytes_copied_total",
     "paddle_tpu_rpc_mux_out_of_order_total",
+    # online-learning publish pipeline (docs/ONLINE_LEARNING.md):
+    # publication/rollback counts, cross-version chunk dedup, hot-swap
+    # phase timing and subscriber staleness are the loop's acceptance
+    # contract — the swap-under-load drill and the online bench assert
+    # against these exact names
+    "paddle_tpu_publish_publications_total",
+    "paddle_tpu_publish_rollbacks_total",
+    "paddle_tpu_publish_dedup_ratio",
+    "paddle_tpu_publish_seconds",
+    "paddle_tpu_publish_swap_seconds",
+    "paddle_tpu_publish_subscriber_lag_versions",
 }
 
 

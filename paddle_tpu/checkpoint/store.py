@@ -245,6 +245,56 @@ class CheckpointStore:
         self._write_state(dict(state), step, meta, "sync")
         return step
 
+    def save_part(self, state: dict, step: int, rank: int,
+                  world: int, meta=None) -> str:
+        """One rank's share of a multi-process save: write this rank's
+        chunks, then publish a PARTIAL manifest (invisible to
+        restore). ``step`` must be agreed across ranks; ``state``
+        holds only the arrays this rank owns — ranks must partition
+        the state by array name. Rank 0 calls ``merge_parts`` once
+        every rank returned to commit the version."""
+        arrays = {}
+        for name, val in state.items():
+            if isinstance(val, ShardedArray):
+                src = val
+                dtype, shape, nbytes = val.dtype, val.shape, val.nbytes
+            else:
+                arr = _host_array(val)
+                src = ShardedArray([arr.reshape((-1,) if arr.ndim == 0
+                                                else arr.shape)])
+                dtype, shape, nbytes = arr.dtype, arr.shape, arr.nbytes
+            chunks, off = [], 0
+            for piece in src.iter_bytes(self.chunk_bytes):
+                chunks.append({"h": self.chunks.put(piece), "o": off,
+                               "n": len(piece)})
+                off += len(piece)
+            arrays[name] = {"dtype": np.dtype(dtype).str,
+                            "shape": [int(s) for s in shape],
+                            "nbytes": int(nbytes), "chunks": chunks}
+        payload = {"step": int(step), "meta": meta, "arrays": arrays}
+        path = _manifest.commit_part(self.root, payload, rank, world)
+        _flight.record("ckpt", "part_commit", step=int(step),
+                       rank=int(rank), world=int(world),
+                       arrays=len(arrays))
+        return path
+
+    def merge_parts(self, step: int, world: int, meta=None) -> int:
+        """Rank 0's commit of a multi-process save: merge the
+        ``world`` parts of ``step`` into ONE manifest (the commit
+        point), then run retention GC. Raises ManifestError (nothing
+        commits, previous step stays restorable) if any part is
+        missing, torn, or overlaps another rank's arrays."""
+        self.wait()  # manifests must commit in step order
+        with self._async_lock:
+            self._last_step = max(self._last_step, int(step))
+        payload = _manifest.merge_parts(self.root, step, world,
+                                        meta=meta)
+        self._retention_gc()
+        _SAVES.labels(mode="merged").inc()
+        _flight.record("ckpt", "manifest_commit", step=int(step),
+                       mode="merged", arrays=len(payload["arrays"]))
+        return int(step)
+
     def _writer_loop(self, q):
         while True:
             item = q.get()

@@ -382,20 +382,22 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     # ops that never mutate server state: exempt from dedup caching
     # (subscribe_inval only touches the subscriber registry — replaying
-    # a subscription must open a fresh stream, never a cached reply)
+    # a subscription must open a fresh stream, never a cached reply;
+    # same for the pub_watch version-announce stream)
     READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
                           "heartbeat", "metrics", "debug_dump",
-                          "subscribe_inval"})
+                          "subscribe_inval", "pub_latest", "pub_get",
+                          "pub_list", "pub_watch"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
     # verbs that legitimately block on straggler trainers (or, for
-    # subscribe_inval, sit open for the subscriber's lifetime): they
-    # never count as in-flight work for the stall watchdog (a barrier
-    # waiting out a slow trainer is round semantics, not a wedged
-    # server)
+    # subscribe_inval / pub_watch, sit open for the subscriber's
+    # lifetime): they never count as in-flight work for the stall
+    # watchdog (a barrier waiting out a slow trainer is round
+    # semantics, not a wedged server)
     _BLOCKING_OPS = frozenset({"send_barrier", "fetch_barrier",
                                "dgc_push", "dgc_pull",
-                               "subscribe_inval"})
+                               "subscribe_inval", "pub_watch"})
 
     def __init__(self, endpoint: str, worker_timeout: float = 60.0,
                  snapshot_dir: str | None = None,
@@ -403,7 +405,12 @@ class PSServer(socketserver.ThreadingTCPServer):
                  snapshot_interval: float | None = None,
                  secret: str | None = None, fs=None,
                  auto_restore: bool = True,
-                 wal: bool | None = None):
+                 wal: bool | None = None,
+                 wal_bg_replay: bool | None = None,
+                 publish_dir: str | None = None,
+                 publish_every_steps: int | None = None,
+                 publish_every_seconds: float | None = None,
+                 publish_every_rows: int | None = None):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
@@ -524,17 +531,73 @@ class PSServer(socketserver.ThreadingTCPServer):
                 and self._fs.is_file(self.snapshot_path):
             self.load_snapshot()
             self._base_written = True
+        # WAL replay gate (PR 12): set = fully caught up. Background
+        # replay clears it so the shard SERVES during replay — pulls of
+        # rows the base/partial replay already holds come back
+        # stale-marked, everything else (mutations, row-creating pulls)
+        # waits on the event in _replay_gate. Default stays blocking
+        # replay (construction returns caught-up).
+        self._replay_done = threading.Event()
+        self._replay_done.set()
+        self.wal_bg_replay = wal_bg_replay if wal_bg_replay is not None \
+            else env("PADDLE_PS_WAL_BG_REPLAY", "") not in ("", "0")
         if self.wal_enabled:
             # replay runs even with NO base on disk: before the first
             # compaction the journal alone holds the whole history
-            if auto_restore:
-                self._replay_wal()
-            self._open_wal()
-            self._rpc.journal = self._journal
+            if auto_restore and self.wal_bg_replay:
+                self._replay_done.clear()
+                # journal hook armed NOW: it no-ops while _wal is None,
+                # and every mutating op is gated until _open_wal ran,
+                # so no mutation can slip through un-journaled
+                self._rpc.journal = self._journal
+                threading.Thread(target=self._bg_replay, daemon=True,
+                                 name="ps-wal-replay").start()
+            else:
+                if auto_restore:
+                    self._replay_wal()
+                self._open_wal()
+                self._rpc.journal = self._journal
+        # continuous publication (PR 12): route base exports through
+        # the publish tier's content-addressed store on a cadence; the
+        # pub_* registry verbs ride this server's own wire
+        self.publish_dir = publish_dir if publish_dir is not None \
+            else (env("PADDLE_TPU_PUBLISH_DIR") or None)
+        self._publisher = None
+        self._exporter = None
+        if self.publish_dir:
+            from ....publish import Publisher, PSExporter
+            self._publisher = Publisher(
+                self.publish_dir,
+                run=f"ps:{self.endpoint}")
+            self._exporter = PSExporter(
+                self, self._publisher,
+                every_steps=publish_every_steps,
+                every_seconds=publish_every_seconds,
+                every_rows=publish_every_rows).start()
         self._snap_stop = threading.Event()
         if self.snapshot_dir and self.snapshot_interval > 0:
             threading.Thread(target=self._snapshot_loop,
                              daemon=True).start()
+
+    def _bg_replay(self):
+        """Background WAL replay (PADDLE_PS_WAL_BG_REPLAY): identical
+        work to the blocking path — same journal files, same order,
+        same dedup re-arming — just behind the read-through gate
+        instead of in front of serve_forever. The finally guarantees a
+        replay crash still unwedges gated clients (they see the
+        table state the partial replay reached; the WAL files are
+        still on disk for the next restart)."""
+        try:
+            self._replay_wal()
+        finally:
+            try:
+                # arm journaling even after a partial replay: appends
+                # land after the torn tail recover=True truncated, the
+                # same state a blocking restart would reach
+                self._open_wal()
+            except Exception:
+                pass
+            self._replay_done.set()
 
     # -- snapshot/recovery tier ----------------------------------------
     @property
@@ -555,6 +618,10 @@ class PSServer(socketserver.ThreadingTCPServer):
     def _after_commit(self, op: str):
         if op not in self._SNAPSHOT_OPS:
             return
+        if self._exporter is not None:
+            # cadence counters + wake event only — publication IO
+            # never runs on the push path
+            self._exporter.note_commit(op)
         with self._snap_lock:
             self._mutations += 1
             if self._wal is not None:
@@ -1038,6 +1105,8 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def server_close(self):
         self._snap_stop.set()
+        if self._exporter is not None:
+            self._exporter.stop()
         if self._wal is not None:
             self._wal.close()
         super().server_close()
@@ -1127,8 +1196,50 @@ class PSServer(socketserver.ThreadingTCPServer):
                            if "keys" in req else 0)
         return rep
 
+    def _replay_gate(self, req: dict):
+        """Read-through gate while background WAL replay rebuilds
+        state. Pulls whose rows ALL exist already (base + replay so
+        far) are served immediately, wrapped ``{"v": rows, "stale":
+        True}`` so the client knows they predate catch-up. Everything
+        that would perturb replay — mutations, and pulls that would
+        lazily CREATE rows (row creation consumes the table RNG, so
+        out-of-order creation would diverge from journal order) —
+        waits on the replay-done event. Pure status reads (ping,
+        metrics, ...) pass through. Returns a reply to short-circuit
+        with, or None to fall through to the normal op switch."""
+        op = req["op"]
+        if op == "pull":
+            t = self.tables.get(req.get("table"))
+            if t is not None:
+                probe = t.missing_keys(req["keys"])
+                if probe is not None and len(probe) == 0:
+                    return {"v": t.pull(req["keys"]), "stale": True}
+            self._replay_done.wait()
+            return None
+        if op in ("ping", "size", "metrics", "debug_dump",
+                  "heartbeat", "lost_workers", "subscribe_inval") \
+                or op.startswith("pub_"):
+            return None
+        self._replay_done.wait()
+        return None
+
     def _dispatch_inner(self, req: dict):
         op = req["op"]
+        if not self._replay_done.is_set():
+            gated = self._replay_gate(req)
+            if gated is not None:
+                return gated
+        if op.startswith("pub_"):
+            # version-registry verbs (PR 12) ride the PS wire when
+            # publishing is configured — one endpoint serves pulls AND
+            # version announces, so serving subscribers need no extra
+            # connection
+            if self._publisher is None:
+                raise ValueError(
+                    "publishing not configured on this shard "
+                    "(set PADDLE_TPU_PUBLISH_DIR or publish_dir=)")
+            from ....publish.registry import registry_dispatch
+            return registry_dispatch(self._publisher.registry, req)
         if op == "pull":
             if self._wal is not None:
                 return self._wal_pull(req)
@@ -1148,6 +1259,9 @@ class PSServer(socketserver.ThreadingTCPServer):
             if self.snapshot_dir:
                 self._mark_dirty(req["table"])
             self._publish_inval(req["table"], req["keys"])
+            if self._exporter is not None:
+                self._exporter.note_rows(
+                    int(np.asarray(req["keys"]).size))
             return True
         if op == "save":
             tag = self.endpoint.replace(":", "_")
@@ -1307,6 +1421,11 @@ class PSClient:
         self._pool = None  # lazy persistent fan-out pool
         self._inval_stop: threading.Event | None = None
         self._inval_threads: list[threading.Thread] = []
+        # pulls answered stale-marked by a shard mid-background-replay
+        # (read-through gate): values predate WAL catch-up. Count, not
+        # content — training tolerates bounded staleness by design
+        self.stale_pulls = 0
+        self.last_pull_stale = False
 
     @property
     def bytes_out(self) -> int:
@@ -1348,8 +1467,15 @@ class PSClient:
                                              "keys": keys[m],
                                              "init_std": init_std}))
             for i, m in masks])
+        stale = False
         for (i, m), r in zip(masks, res):
+            if isinstance(r, dict):  # replay-gate read-through reply
+                stale = stale or bool(r.get("stale"))
+                r = r["v"]
             out[m] = r
+        if stale:
+            self.stale_pulls += 1
+        self.last_pull_stale = stale
         return out
 
     def push(self, table: str, dim: int, keys, grads, lr: float = 1.0,

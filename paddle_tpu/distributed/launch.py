@@ -81,6 +81,14 @@ def _parse(argv):
                         "durability for throughput — a crash can "
                         "silently drop up to N-1 acked pushes on "
                         "respawn (see docs/PS_WIRE_PROTOCOL.md)")
+    p.add_argument("--publish_dir", type=str, default=None,
+                   help="online learning: set PADDLE_TPU_PUBLISH_DIR "
+                        "for PS server and serving-replica children. "
+                        "Servers export their tables through the "
+                        "publish pipeline on the PADDLE_TPU_PUBLISH_"
+                        "EVERY_* cadence; replicas adopt published "
+                        "versions via the router's staggered rollout "
+                        "(docs/ONLINE_LEARNING.md)")
     p.add_argument("--metrics_dir", type=str, default=None,
                    help="telemetry: set PADDLE_TPU_METRICS_DIR for "
                         "every child so each process dumps its metric "
@@ -304,6 +312,13 @@ def launch(argv=None):
         os.makedirs(args.debug_dir, exist_ok=True)
         for _name, env, _argv in specs:
             env["PADDLE_TPU_DEBUG_DIR"] = args.debug_dir
+    if args.publish_dir:
+        # online learning: servers PUBLISH through this store, serving
+        # replicas ADOPT from it (workers/trainers don't need it)
+        os.makedirs(args.publish_dir, exist_ok=True)
+        for name, env, _argv in specs:
+            if name.startswith(("server.", "replica.")):
+                env["PADDLE_TPU_PUBLISH_DIR"] = args.publish_dir
     from .elastic import ElasticManager
     hb_dir = None
     if args.max_restarts > 0:

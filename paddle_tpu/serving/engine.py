@@ -193,6 +193,11 @@ class Engine:
                          wd_name)
         self._lock = threading.Lock()    # step loop exclusivity
         self._stats_lock = threading.Lock()  # deque append vs snapshot
+        # published-version identity (PR 12): stamped by warm_start
+        # under the step lock, so ping/stats can never report a version
+        # whose weights aren't the ones decoding. 0 = cold weights
+        # (never warm-started from a published version)
+        self.model_version = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -271,7 +276,8 @@ class Engine:
                            tenant=tenant).result(timeout)
 
     # -- checkpoint warm-start ------------------------------------------
-    def warm_start(self, root: str, step: int | None = None):
+    def warm_start(self, root: str, step: int | None = None,
+                   version: int | None = None):
         """Swap in weights from a committed checkpoint manifest
         (paddle_tpu.checkpoint) without rebuilding the engine: shapes/
         dtypes must match the current model (the jitted programs and
@@ -284,10 +290,20 @@ class Engine:
         between steps, never inside one, and never with disk I/O or a
         device transfer under the step lock (the lock-blocking-call
         analysis rule pins the disk half). Models served here provide
-        read_checkpoint/adopt_checkpoint (GPTDecodeModel does)."""
+        read_checkpoint/adopt_checkpoint (GPTDecodeModel does).
+
+        ``version`` stamps the published-version identity the flip
+        installs (online-learning hot swap): in-flight generations
+        finish on the old weights' tokens-so-far, and every request
+        prefilled after the flip — plus ping/stats — reports the new
+        version. Defaults to ``step`` so a plain checkpoint warm start
+        is still identifiable."""
         prepared = self.model.read_checkpoint(root, step=step)
         with self._lock:
             self.model.adopt_checkpoint(prepared)
+            v = version if version is not None else step
+            if v is not None:
+                self.model_version = int(v)
         return self
 
     @classmethod
@@ -546,6 +562,7 @@ class Engine:
             tps = sum(n for _, n in w[1:]) / (w[-1][0] - w[0][0])
         return {**self.scheduler.stats(),
                 "pool": self.pool.stats(),
+                "model_version": self.model_version,
                 "steps": int(self._m_steps.value),
                 "tokens_generated": total,
                 "tokens_per_sec": round(tps, 2),

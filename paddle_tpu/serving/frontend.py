@@ -46,8 +46,15 @@ Ops:
     around this replica. {"wait": true, "timeout": s} blocks until the
     queue ran dry (reply carries "idle").
   {"op": "ping"}  -> {"ok": true, "draining": bool, "queue_depth": n,
-    "active_slots": n, "occupancy": f}  — the router's health/load
-    probe (cheap: no latency sorting, two lock-free gauge reads)
+    "active_slots": n, "occupancy": f, "model_version": v}  — the
+    router's health/load probe (cheap: no latency sorting, two
+    lock-free gauge reads); model_version is the published version
+    the engine serves (docs/ONLINE_LEARNING.md)
+  {"op": "adopt_version", "version": v} -> {"adopted": v, ...}
+    Zero-downtime hot swap to published version v from the replica's
+    CONFIGURED publish root (publish_root= / PADDLE_TPU_PUBLISH_DIR —
+    the wire never chooses a path): two-phase warm start, in-flight
+    generations finish on the old weights, new prefills see v.
 
 In-process use (tests, co-located workers) needs none of this — call
 `Engine.submit` / `Engine.generate` directly.
@@ -77,9 +84,16 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, engine, endpoint: str = "127.0.0.1:0",
                  secret: str | None = None,
-                 default_timeout: float = 120.0):
+                 default_timeout: float = 120.0,
+                 publish_root: str | None = None):
+        import os
         self.engine = engine
         self.default_timeout = default_timeout
+        # the publish root adopt_version loads from is SERVER
+        # configuration (arg or PADDLE_TPU_PUBLISH_DIR), never a
+        # wire-chosen path — same rule as debug_dump's destination
+        self.publish_root = publish_root if publish_root is not None \
+            else (os.environ.get("PADDLE_TPU_PUBLISH_DIR") or None)
         self._rpc = RpcServerState(read_ops=self.READ_OPS, secret=secret)
         outer = self
 
@@ -159,7 +173,23 @@ class ServingServer(socketserver.ThreadingTCPServer):
             return {"ok": True, "draining": bool(sched.draining),
                     "queue_depth": sched.queue_depth,
                     "active_slots": len(sched.active_requests()),
-                    "occupancy": float(self.engine.pool.occupancy)}
+                    "occupancy": float(self.engine.pool.occupancy),
+                    "model_version":
+                        int(getattr(self.engine, "model_version", 0))}
+        if op == "adopt_version":
+            # online-learning hot swap (PR 12): two-phase warm start
+            # from the SERVER-configured publish root — the wire names
+            # only the version number, never a path. The router's
+            # staggered rollout drives this verb replica by replica.
+            if not self.publish_root:
+                raise ValueError(
+                    "no publish root configured on this replica "
+                    "(publish_root= or PADDLE_TPU_PUBLISH_DIR)")
+            version = int(req["version"])
+            self.engine.warm_start(self.publish_root, step=version,
+                                   version=version)
+            return {"adopted": version,
+                    "model_version": int(self.engine.model_version)}
         if op == "drain":
             self.engine.drain()
             idle = None
@@ -324,6 +354,16 @@ class ServingClient:
 
     def stats(self) -> dict:
         return self._rpc.call({"op": "stats"})
+
+    def adopt_version(self, version: int,
+                      timeout: float = 120.0) -> dict:
+        """Hot-swap the replica to published ``version`` (loaded from
+        ITS configured publish root). Mutating + dedup-cached: a
+        retried adopt replays the recorded reply, never a second
+        device upload."""
+        return self._rpc.call({"op": "adopt_version",
+                               "version": int(version)},
+                              timeout=timeout, deadline=timeout + 30)
 
     def metrics(self) -> str:
         """Prometheus text from the serving process's registry."""

@@ -27,6 +27,13 @@ to the router unchanged. What the router adds (docs/SERVING.md):
     sees exactly one authoritative final reply. Greedy decode is
     deterministic, so the survivor's tokens extend the tokens already
     streamed upstream (the relay forwards only the unseen tail);
+  * staggered rollout (PR 12) — with a publish root configured, the
+    `rollout` op hot-swaps the fleet to a published model version one
+    replica at a time (the rest keep serving), health-gating each
+    swap with a post-swap probe and rolling the flipped replicas AND
+    the registry back to the pinned version on failure;
+    `publish_watch=True` subscribes to the registry so every
+    publication rolls out automatically (docs/ONLINE_LEARNING.md);
   * elastic respawn — a dead replica with a respawn hook (subprocess
     via launch.py --serving_replicas, or `InProcessReplica` here) is rebuilt
     from its engine checkpoint (`Engine.from_checkpoint`); the router
@@ -239,7 +246,9 @@ class Router(socketserver.ThreadingTCPServer):
                  failover_retries: int | None = None,
                  max_inflight: int | None = None,
                  ready_pings: int | None = None,
-                 respawn_cooldown: float | None = None):
+                 respawn_cooldown: float | None = None,
+                 publish_root: str | None = None,
+                 publish_watch: bool = False):
         self.router_id = f"r{next(_router_ids)}"
         self.secret = secret
         self.default_timeout = default_timeout
@@ -265,6 +274,28 @@ class Router(socketserver.ThreadingTCPServer):
         self.respawn_cooldown = respawn_cooldown \
             if respawn_cooldown is not None \
             else _env_f("PADDLE_TPU_ROUTER_RESPAWN_COOLDOWN", 2.0)
+
+        # online-learning rollout (PR 12): with a publish root the
+        # router coordinates staggered fleet hot swaps ("rollout" op —
+        # one replica at a time, health-gated, automatic rollback to
+        # the pinned version); publish_watch additionally subscribes
+        # to the registry so every publication rolls out by itself
+        self.publish_root = publish_root if publish_root is not None \
+            else (os.environ.get("PADDLE_TPU_PUBLISH_DIR") or None)
+        self._pub_registry = None
+        self._pub_sub = None
+        self._rollout_lock = threading.Lock()
+        self.rollouts = 0
+        self.rollout_rollbacks = 0
+        if self.publish_root:
+            from ..publish import VersionRegistry
+            self._pub_registry = VersionRegistry(self.publish_root)
+            if publish_watch:
+                from ..publish import VersionSubscriber
+                self._pub_sub = VersionSubscriber(
+                    self.publish_root, registry=self._pub_registry,
+                    swap_fn=lambda v, rec: self.rollout_version(v),
+                    kinds=("gpt-decode",))
 
         self._replicas: dict[str, Replica] = {}
         self._pick_seq = itertools.count(1)
@@ -344,10 +375,14 @@ class Router(socketserver.ThreadingTCPServer):
         self._bg_threads = [serve, health]
         serve.start()
         health.start()
+        if self._pub_sub is not None:
+            self._pub_sub.start()
         return self
 
     def stop(self):
         self._stop_ev.set()
+        if self._pub_sub is not None:
+            self._pub_sub.stop()
         if self._bg_threads:         # shutdown() blocks unless
             self.shutdown()          # serve_forever is running
         self.server_close()
@@ -767,6 +802,9 @@ class Router(socketserver.ThreadingTCPServer):
             return _debug.dump_verb(req)
         if op == "drain_replica":
             return self._drain_replica(req)
+        if op == "rollout":
+            v = req.get("version")
+            return self.rollout_version(None if v is None else int(v))
         if op == "generate":
             rid = req.pop("_req_id", None)
             req["prompt"] = np.asarray(req["prompt"], np.int32)
@@ -803,6 +841,111 @@ class Router(socketserver.ThreadingTCPServer):
                 "idle": rep.get("idle") if isinstance(rep, dict)
                 else None}
 
+    # -- staggered fleet rollout (PR 12) --------------------------------
+    def _adopt_on(self, r: Replica, version: int) -> dict:
+        """One replica's hot swap + health gate: adopt_version on its
+        shared mux client, then a post-swap probe that must come back
+        ok AND reporting the new version (a swap that 'succeeded' into
+        a broken engine fails here). Raises on any failure."""
+        cli = self._client(r)
+        cli.call({"op": "adopt_version", "version": int(version)},
+                 timeout=self.default_timeout,
+                 deadline=self.default_timeout * 2, max_retries=0)
+        info = cli.call({"op": "ping"}, timeout=self.ping_timeout,
+                        deadline=self.ping_timeout * 2, max_retries=0)
+        if not (isinstance(info, dict) and info.get("ok")
+                and int(info.get("model_version", -1)) == int(version)):
+            raise RuntimeError(
+                f"post-swap probe on {r.name} reports "
+                f"{info.get('model_version') if isinstance(info, dict) else info!r}, "
+                f"wanted {version}")
+        self._note_alive(r, info)
+        return info
+
+    def rollout_version(self, version: int | None = None) -> dict:
+        """Staggered fleet hot swap to published ``version`` (default:
+        the registry's latest). One replica at a time — the rest keep
+        serving the old weights, so fleet capacity never drops by more
+        than one replica's worth mid-rollout. Any adopt failure or
+        post-swap probe failure aborts the rollout, re-adopts the
+        fallback (the registry's pinned version when set, else each
+        replica's pre-rollout version) on every replica already
+        flipped, and rewinds the registry's latest pointer — the
+        automatic-rollback contract (docs/ONLINE_LEARNING.md)."""
+        if self._pub_registry is None:
+            raise ValueError("rollout needs a publish root "
+                             "(publish_root= or PADDLE_TPU_PUBLISH_DIR)")
+        failure = None
+        with self._rollout_lock:   # one rollout at a time, fleet-wide
+            reg = self._pub_registry
+            reg.reload(missing_ok=True)
+            if version is None:
+                version = reg.latest()
+            version = int(version)
+            if not version:
+                return {"adopted": 0, "replicas": [],
+                        "error": "nothing published yet"}
+            pinned = reg.pinned()
+            with self._lock:
+                targets = [r for r in self._replicas.values()
+                           if r.state in (HEALTHY, SUSPECT)]
+            flipped: list[tuple[Replica, int]] = []  # (replica, prior)
+            for r in targets:
+                prior = int(r.last_info.get("model_version", 0))
+                try:
+                    self._adopt_on(r, version)
+                except Exception as e:
+                    err = f"{type(e).__name__}: {e}"
+                    _flight.record("serving", "rollout_failed",
+                                   router=self.router_id,
+                                   replica=r.name, version=version,
+                                   error=err)
+                    self._restore_flipped(flipped, pinned, version)
+                    self.rollout_rollbacks += 1
+                    failure = {"adopted": None, "version": version,
+                               "failed_on": r.name, "error": err}
+                    break
+                flipped.append((r, prior))
+            else:
+                self.rollouts += 1
+                _flight.record("serving", "rollout",
+                               router=self.router_id, version=version,
+                               replicas=[r.name for r, _p in flipped])
+                return {"adopted": version,
+                        "replicas": [r.name for r, _p in flipped]}
+        # rewinding the registry is a durable file commit — done after
+        # the rollout lock drops so no rollout ever blocks behind an
+        # fsync. The fleet is already restored; a rollout racing this
+        # rewind re-reads `latest` and simply re-serves the fallback.
+        failure["rolled_back"] = self._rewind_registry(pinned)
+        return failure
+
+    def _restore_flipped(self, flipped, pinned: int, bad: int):
+        """Abort path, under the rollout lock: restore every
+        already-flipped replica (pinned version when set, else its own
+        pre-rollout version)."""
+        for r, prior in flipped:
+            back = pinned or prior
+            if not back or back == bad:
+                continue             # replica predates publishing
+            try:
+                self._adopt_on(r, back)
+            except Exception:
+                # the health loop owns this replica now: it will go
+                # suspect/dead and respawn from its checkpoint
+                _flight.record("serving", "rollback_failed",
+                               router=self.router_id, replica=r.name,
+                               version=back)
+
+    def _rewind_registry(self, pinned: int) -> int | None:
+        """Rewind the registry's latest pointer so subscribers and
+        later rollouts never see the bad version as latest."""
+        try:
+            rec = self._pub_registry.rollback(pinned or None)
+            return int(rec["version"])
+        except Exception:
+            return None
+
     def stats(self) -> dict:
         with self._lock:
             reps = {r.name: {"state": r.state,
@@ -833,11 +976,16 @@ class InProcessReplica:
 
     def __init__(self, ckpt_root: str, name: str = "replica",
                  engine_kw: dict | None = None,
-                 endpoint: str = "127.0.0.1:0"):
+                 endpoint: str = "127.0.0.1:0",
+                 publish_root: str | None = None):
         self.ckpt_root = ckpt_root
         self.name = name
         self.engine_kw = dict(engine_kw or {})
         self._endpoint_req = endpoint
+        # online-learning: the replica's adopt_version loads from this
+        # root (server-side config, like the real subprocess replica's
+        # PADDLE_TPU_PUBLISH_DIR env)
+        self.publish_root = publish_root
         self.server = None
         self.engine = None
 
@@ -846,7 +994,8 @@ class InProcessReplica:
         from .frontend import ServingServer
         self.engine = Engine.from_checkpoint(self.ckpt_root,
                                              **self.engine_kw)
-        self.server = ServingServer(self.engine, self._endpoint_req)
+        self.server = ServingServer(self.engine, self._endpoint_req,
+                                    publish_root=self.publish_root)
         self.server.start()
         return self.server.endpoint
 

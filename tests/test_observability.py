@@ -294,7 +294,10 @@ ENGINE_STATS_KEYS = {
     # scheduler's new decision counters ride along
     "expired_in_queue", "shed", "quota_rejected",
     # PR-9 graceful drain: the router reads it from ping/stats
-    "draining"}
+    "draining",
+    # PR-12 online learning: published-version identity so loadgen can
+    # slice SLO windows pre/post hot swap
+    "model_version"}
 POOL_STATS_KEYS = {
     "num_pages", "page_size", "free_pages", "used_pages", "occupancy",
     "alloc_count", "free_count", "alloc_failures"}
