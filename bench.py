@@ -1313,6 +1313,36 @@ def bench_flight_overhead(steps=200, hidden=256, layers=4, heads=4,
         slots=slots, seed=seed)
 
 
+def bench_telemetry_overhead(steps=200, hidden=256, layers=4, heads=4,
+                             slots=4, seed=0):
+    """Fleet-telemetry cost guardrail (ISSUE 13 acceptance): a LIVE
+    TelemetryAgent streaming spans/flight events to an in-process
+    collector, toggled A/B/A on the same engine. The agent's sinks are
+    bounded-queue appends and all socket IO rides the agent's own
+    thread, so the decode hot path should see the same <2% bar as the
+    other observability toggles."""
+    from paddle_tpu.observability import agent as tel_agent
+    from paddle_tpu.observability.collector import CollectorServer
+
+    srv = CollectorServer("127.0.0.1:0").start()
+
+    def set_enabled(on):
+        if on:
+            tel_agent.arm(srv.endpoint)
+        else:
+            tel_agent.disarm()
+
+    set_enabled(True)
+    try:
+        return _bench_serving_toggle_overhead(
+            set_enabled, "serving_telemetry_overhead_pct", steps=steps,
+            hidden=hidden, layers=layers, heads=heads, slots=slots,
+            seed=seed)
+    finally:
+        tel_agent.disarm()
+        srv.stop()
+
+
 def bench_checkpoint(state_mb=64, train_steps=150, save_every=50,
                      hidden=1024, seed=0):
     """Checkpoint-store economics (ISSUE 4 acceptance): save/restore
@@ -1684,6 +1714,8 @@ def main():
         rec = bench_metrics_overhead()
     elif which == "flight_overhead":
         rec = bench_flight_overhead()
+    elif which == "telemetry_overhead":
+        rec = bench_telemetry_overhead()
     elif which == "checkpoint":
         rec = bench_checkpoint()
     elif which == "gpt_1p3b":

@@ -236,6 +236,13 @@ REQUIRED_METRICS = {
     "paddle_tpu_publish_seconds",
     "paddle_tpu_publish_swap_seconds",
     "paddle_tpu_publish_subscriber_lag_versions",
+    # fleet telemetry plane (docs/OBSERVABILITY.md): span-ring loss,
+    # agent-side backpressure drops and the tail-sampling verdict split
+    # are the plane's honesty surface — without them telemetry loss is
+    # silent and every downstream dashboard lies
+    "paddle_tpu_trace_dropped_total",
+    "paddle_tpu_telemetry_agent_dropped_total",
+    "paddle_tpu_telemetry_traces_total",
 }
 
 

@@ -387,7 +387,13 @@ class PSServer(socketserver.ThreadingTCPServer):
     READ_OPS = frozenset({"pull", "size", "ping", "lost_workers",
                           "heartbeat", "metrics", "debug_dump",
                           "subscribe_inval", "pub_latest", "pub_get",
-                          "pub_list", "pub_watch"})
+                          "pub_list", "pub_watch",
+                          # telemetry verbs (hosted collector): pushes
+                          # are single-attempt fire-and-forget, the
+                          # rest are reads — none need replay dedup
+                          "tel_push", "tel_ping", "tel_fleet",
+                          "tel_trace", "tel_traces", "tel_stats",
+                          "tel_watch"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
     # verbs that legitimately block on straggler trainers (or, for
@@ -397,7 +403,8 @@ class PSServer(socketserver.ThreadingTCPServer):
     # semantics, not a wedged server)
     _BLOCKING_OPS = frozenset({"send_barrier", "fetch_barrier",
                                "dgc_push", "dgc_pull",
-                               "subscribe_inval", "pub_watch"})
+                               "subscribe_inval", "pub_watch",
+                               "tel_watch"})
 
     def __init__(self, endpoint: str, worker_timeout: float = 60.0,
                  snapshot_dir: str | None = None,
@@ -492,6 +499,13 @@ class PSServer(socketserver.ThreadingTCPServer):
         self.snapshots_taken = 0
         self.full_snapshots = 0
         self.delta_snapshots = 0
+        # fleet-telemetry hosting: this shard answers the tel_* verbs
+        # (collector role) when PADDLE_TPU_TELEMETRY_HOST=1, so small
+        # fleets need no separate collector process
+        self._tel_collector = None
+        if env("PADDLE_TPU_TELEMETRY_HOST", "") == "1":
+            from ....observability.collector import TelemetryCollector
+            self._tel_collector = TelemetryCollector()
         self._rpc = RpcServerState(read_ops=self.READ_OPS,
                                    secret=secret,
                                    after_commit=self._after_commit,
@@ -1218,7 +1232,7 @@ class PSServer(socketserver.ThreadingTCPServer):
             return None
         if op in ("ping", "size", "metrics", "debug_dump",
                   "heartbeat", "lost_workers", "subscribe_inval") \
-                or op.startswith("pub_"):
+                or op.startswith("pub_") or op.startswith("tel_"):
             return None
         self._replay_done.wait()
         return None
@@ -1240,6 +1254,16 @@ class PSServer(socketserver.ThreadingTCPServer):
                     "(set PADDLE_TPU_PUBLISH_DIR or publish_dir=)")
             from ....publish.registry import registry_dispatch
             return registry_dispatch(self._publisher.registry, req)
+        if op.startswith("tel_"):
+            # fleet-telemetry verbs (hosted collector): one PS
+            # endpoint can double as the collector, the debug_dump /
+            # pub_* hosting pattern
+            if self._tel_collector is None:
+                raise ValueError(
+                    "telemetry collector not hosted on this shard "
+                    "(set PADDLE_TPU_TELEMETRY_HOST=1)")
+            from ....observability.collector import telemetry_dispatch
+            return telemetry_dispatch(self._tel_collector, req)
         if op == "pull":
             if self._wal is not None:
                 return self._wal_pull(req)

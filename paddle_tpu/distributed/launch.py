@@ -107,6 +107,18 @@ def _parse(argv):
                         "List/merge a job's bundles with `python -m "
                         "paddle_tpu.observability.registry <dir>` "
                         "(docs/DEBUGGING.md)")
+    p.add_argument("--telemetry", type=str, default=None,
+                   nargs="?", const="127.0.0.1:8600",
+                   metavar="HOST:PORT",
+                   help="fleet telemetry: spawn a collector child on "
+                        "this endpoint (default 127.0.0.1:8600 when "
+                        "the flag is given bare) and set "
+                        "PADDLE_TPU_TELEMETRY_COLLECTOR for every "
+                        "other child so each process streams spans / "
+                        "flight events / metric deltas to it; watch "
+                        "live with `python -m "
+                        "paddle_tpu.observability.top --collector "
+                        "HOST:PORT` (docs/OBSERVABILITY.md)")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -177,12 +189,16 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
                     spec = specs.get(name)
                     if spec is not None and manager is not None \
                             and (name.startswith("server.")
-                                 or name.startswith("replica.")) \
+                                 or name.startswith("replica.")
+                                 or name == "telemetry") \
                             and manager.should_restart_server():
                         manager.record_server_restart()
-                        what = "it from snapshot" \
-                            if name.startswith("server.") \
-                            else "it alone from its engine checkpoint"
+                        if name.startswith("server."):
+                            what = "it from snapshot"
+                        elif name == "telemetry":
+                            what = "the stateless collector alone"
+                        else:
+                            what = "it alone from its engine checkpoint"
                         sys.stderr.write(
                             f"[launch] {name} exited with code {rc}; "
                             f"restarting {what} "
@@ -206,13 +222,15 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
             # tears servers down once trainers exit)
             worker_rcs = [p.poll() for name, p, _ in procs
                           if not name.startswith("server.")
-                          and not name.startswith("replica.")]
+                          and not name.startswith("replica.")
+                          and name != "telemetry"]
             if worker_rcs and all(rc == 0 for rc in worker_rcs) \
                     and any(name.startswith("server.")
+                            or name == "telemetry"
                             for name, _, _ in procs):
                 sys.stderr.write(
-                    "[launch] all workers finished; stopping PS "
-                    "servers\n")
+                    "[launch] all workers finished; stopping daemon "
+                    "children (PS servers / telemetry)\n")
                 _kill_all(procs)
                 return 0, False
             if manager is not None:
@@ -319,6 +337,20 @@ def launch(argv=None):
         for name, env, _argv in specs:
             if name.startswith(("server.", "replica.")):
                 env["PADDLE_TPU_PUBLISH_DIR"] = args.publish_dir
+    if args.telemetry:
+        # fleet telemetry: one collector child answers the tel_* verbs;
+        # every rank's agent autostarts from this env at observability
+        # import and streams spans/flight/metric deltas to it. Agents
+        # reconnect with backoff, so neither spawn order nor collector
+        # respawns matter to serving.
+        for name, env, _argv in specs:
+            env["PADDLE_TPU_TELEMETRY_COLLECTOR"] = args.telemetry
+            env.setdefault("PADDLE_TPU_TELEMETRY_ROLE", name)
+        specs.append(("telemetry",
+                      {"PADDLE_TPU_TELEMETRY_COLLECTOR": ""},
+                      [sys.executable, "-m",
+                       "paddle_tpu.observability.collector",
+                       "--endpoint", args.telemetry]))
     from .elastic import ElasticManager
     hb_dir = None
     if args.max_restarts > 0:
@@ -346,10 +378,19 @@ def launch(argv=None):
         for name, env, argv in specs:
             if name.startswith("replica."):
                 server_specs[name] = (env, argv)
+    if args.telemetry and args.max_restarts > 0:
+        # the collector is stateless — respawn it alone; agents just
+        # reconnect, serving is never in the loop
+        for name, env, argv in specs:
+            if name == "telemetry":
+                server_specs[name] = (env, argv)
     manager = ElasticManager(
         max_restarts=args.max_restarts,
         heartbeat_timeout=args.heartbeat_timeout,
-        heartbeat_dir=hb_dir, world_size=len(specs)) \
+        heartbeat_dir=hb_dir,
+        # the telemetry collector never writes heartbeat files — it
+        # must not count toward the expected rank set
+        world_size=sum(1 for n, _, _ in specs if n != "telemetry")) \
         if args.max_restarts > 0 else None
 
     while True:

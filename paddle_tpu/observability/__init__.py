@@ -51,7 +51,10 @@ import atexit
 import os
 import socket
 
-from . import debug, flight, registry, tracing, watchdog
+from . import agent, collector, debug, flight, registry, tracing, \
+    watchdog
+from .agent import TelemetryAgent, publish_event
+from .collector import TelemetryCollector, telemetry_dispatch
 from .debug import collect, load_bundle, write_bundle
 from .flight import RECORDER
 from .registry import (REGISTRY, Counter, Gauge, Histogram, MetricError,
@@ -64,6 +67,9 @@ from .watchdog import WATCHDOG
 
 __all__ = [
     "registry", "tracing", "flight", "watchdog", "debug",
+    "agent", "collector",
+    "TelemetryAgent", "TelemetryCollector",
+    "telemetry_dispatch", "publish_event",
     "REGISTRY", "MetricsRegistry", "MetricError",
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",
@@ -181,3 +187,13 @@ if os.environ.get("PADDLE_TPU_WATCHDOG", "") not in ("", "0"):
         WATCHDOG.start()
     except Exception:
         pass
+
+
+# opt-in per-process telemetry agent: PADDLE_TPU_TELEMETRY_COLLECTOR
+# (launch.py --telemetry sets it for every child) arms a streamer to
+# the fleet collector — spans/flight/metrics/events, one daemon
+# sender thread, never in a serving path
+try:
+    agent.maybe_start_from_env()
+except Exception:
+    pass

@@ -164,15 +164,20 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild(_Child):
-    __slots__ = ("_counts", "_sum", "_count")
+    __slots__ = ("_counts", "_sum", "_count", "_exemplars")
 
     def __init__(self, metric, labelvalues):
         super().__init__(metric, labelvalues)
         self._counts = [0] * (len(metric.buckets) + 1)  # +1 for +Inf
         self._sum = 0.0
         self._count = 0
+        # bucket index -> {"trace_id", "value"}: the newest observation
+        # in that bucket that carried a trace id (OpenMetrics-style
+        # exemplars — an slo_report p99 links straight to an assembled
+        # trace in the telemetry collector)
+        self._exemplars: dict[int, dict] = {}
 
-    def observe(self, v: float):
+    def observe(self, v: float, trace_id: str | None = None):
         if not (self._metric.always
                 or self._metric._registry._enabled):
             return
@@ -188,6 +193,15 @@ class _HistogramChild(_Child):
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if trace_id:
+                self._exemplars[i] = {"trace_id": str(trace_id),
+                                      "value": v}
+
+    def exemplars(self) -> dict[int, dict]:
+        """{bucket index: {"trace_id", "value"}} — newest exemplar per
+        bucket (index len(buckets) is +Inf)."""
+        with self._lock:
+            return {i: dict(e) for i, e in self._exemplars.items()}
 
     def snapshot(self):
         """(cumulative bucket counts incl +Inf, sum, count)."""
@@ -281,7 +295,7 @@ class _Metric:
     # no-label convenience: metric itself acts as its default child
     def __getattr__(self, item):
         if item in ("inc", "dec", "set", "observe", "set_function",
-                    "value", "count", "sum", "snapshot"):
+                    "value", "count", "sum", "snapshot", "exemplars"):
             default = self.__dict__.get("_default")
             if default is None:
                 raise MetricError(
@@ -420,6 +434,10 @@ class MetricsRegistry:
                 if m.kind == "histogram":
                     cum, s, c = child.snapshot()
                     sample.update(cumulative=cum, sum=s, count=c)
+                    ex = child.exemplars()
+                    if ex:
+                        sample["exemplars"] = {str(i): e
+                                               for i, e in ex.items()}
                 else:
                     v = child.value
                     # NaN/Inf-safe: json.dump would emit the
@@ -552,5 +570,33 @@ if __name__ == "__main__":  # python -m paddle_tpu.observability.registry
         agg = aggregate_dir(_dir)
     else:
         agg = aggregate_with_bundles(_dir)
+    # merge the per-rank trace_<host>_<pid>.json span rings (the
+    # SIGTERM dump / launch.py --metrics_dir artifacts) into ONE
+    # Chrome trace with per-rank pid labels, using the telemetry
+    # collector's merge code — one Perfetto load instead of one per
+    # rank
+    _parts = []
+    for _fn in sorted(os.listdir(_dir) if os.path.isdir(_dir) else ()):
+        if (_fn.startswith("trace_") and _fn.endswith(".json")
+                and _fn != "trace_merged.json"):
+            try:
+                with open(os.path.join(_dir, _fn),
+                          encoding="utf-8") as _f:
+                    _doc = json.load(_f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            _parts.append((_fn[len("trace_"):-len(".json")],
+                           _doc.get("traceEvents") or []))
+    if _parts:
+        from .collector import merge_chrome_traces
+        _merged = merge_chrome_traces(_parts)
+        _out = os.path.join(_dir, "trace_merged.json")
+        _tmp = f"{_out}.tmp{os.getpid()}"
+        with open(_tmp, "w", encoding="utf-8") as _f:
+            json.dump(_merged, _f)
+        os.replace(_tmp, _out)
+        agg["trace_merged"] = {
+            "path": _out, "ranks": len(_parts),
+            "events": len(_merged["traceEvents"]) - len(_parts)}
     json.dump(agg, sys.stdout, indent=2)
     print()

@@ -1,0 +1,202 @@
+"""Live terminal fleet dashboard over the telemetry collector.
+
+``python -m paddle_tpu.observability.top --collector host:port``
+renders the fleet every interval: one row per process (role,
+liveness, rps, p50/p99 TTFT/ITL, queue depth, page occupancy, agent
+drop counts), the tail-sampling counters, and the most recent
+watchdog/bundle events with their bundle paths — the
+"start from the dashboard" entry point of docs/DEBUGGING.md.
+
+``python -m paddle_tpu.observability.top trace <id>`` prints the
+assembled cross-process waterfall for one trace id and, with
+``--out f.json``, exports it as ONE merged Chrome trace with
+per-rank pid labels (Perfetto / chrome://tracing).
+
+Rendering is pure (``render_fleet`` / ``render_waterfall`` take the
+collector reply dicts), so tests drive it without a terminal.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+__all__ = ["render_fleet", "render_waterfall", "main"]
+
+
+def _f(v, spec="7.1f", dash="      -") -> str:
+    if v is None:
+        return dash
+    try:
+        return format(float(v), spec)
+    except (TypeError, ValueError):
+        return dash
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{float(v) * 1000:.1f}ms"
+
+
+def render_fleet(fleet: dict) -> str:
+    """One screen of fleet state from a ``tel_fleet`` reply."""
+    lines = []
+    t = fleet.get("time") or time.time()
+    tr = fleet.get("traces") or {}
+    lines.append(
+        f"paddle-tpu fleet  {time.strftime('%H:%M:%S', time.localtime(t))}"
+        f"  procs={len(fleet.get('procs') or ())}"
+        f"  open={fleet.get('open_traces', 0)}"
+        f"  kept={fleet.get('kept_traces', 0)}")
+    lines.append(
+        "traces: assembled=%d kept(err=%d slow=%d sampled=%d) "
+        "sampled_out=%d evicted=%d" % (
+            tr.get("assembled", 0), tr.get("kept_error", 0),
+            tr.get("kept_slow", 0), tr.get("kept_sampled", 0),
+            tr.get("sampled_out", 0), tr.get("evicted", 0)))
+    lines.append("")
+    lines.append(f"{'ROLE':<16} {'HOST:PID':<22} {'AGE':>5} {'RPS':>7} "
+                 f"{'TTFT p50/p99':>15} {'ITL p50/p99':>15} "
+                 f"{'QUEUE':>6} {'OCC':>5} {'DROPS':>6}")
+    for p in fleet.get("procs") or ():
+        s = p.get("summary") or {}
+        drops = sum((p.get("dropped") or {}).values())
+        ttft = f"{_ms(s.get('ttft_p50'))}/{_ms(s.get('ttft_p99'))}" \
+            if "ttft_p50" in s else \
+            (f"{_ms(s.get('latency_p50'))}/{_ms(s.get('latency_p99'))}"
+             if "latency_p50" in s else "-")
+        itl = f"{_ms(s.get('itl_p50'))}/{_ms(s.get('itl_p99'))}" \
+            if "itl_p50" in s else "-"
+        lines.append(
+            f"{str(p.get('role'))[:16]:<16} "
+            f"{p.get('host')}:{p.get('pid'):<10} "
+            f"{_f(p.get('age_s'), '5.1f', '    -')} "
+            f"{_f(s.get('rps'), '7.1f')} "
+            f"{ttft:>15} {itl:>15} "
+            f"{_f(s.get('queue_depth'), '6.0f', '     -')} "
+            f"{_f(s.get('page_occupancy'), '5.2f', '    -')} "
+            f"{drops:>6d}")
+    events = fleet.get("recent_events") or ()
+    if events:
+        lines.append("")
+        lines.append("recent events:")
+        for ev in list(events)[-8:]:
+            at = ev.get("attrs") or {}
+            extra = " ".join(f"{k}={v}" for k, v in sorted(at.items()))
+            w = ev.get("wall")
+            stamp = time.strftime("%H:%M:%S", time.localtime(w)) \
+                if w else "--:--:--"
+            lines.append(f"  {stamp} {ev.get('role')}@{ev.get('host')}"
+                         f":{ev.get('pid')} {ev.get('kind')} {extra}")
+    return "\n".join(lines)
+
+
+def render_waterfall(trace: dict) -> str:
+    """The assembled cross-process waterfall of one ``tel_trace``
+    reply: spans in aligned start order, indented by span parentage,
+    one rank tag per line."""
+    spans = trace.get("spans") or ()
+    if not spans:
+        return f"trace {trace.get('trace_id')}: no spans"
+    t0 = min(s["t0"] for s in spans)
+    t1 = max(s["t1"] for s in spans)
+    by_id = {s.get("span_id"): s for s in spans}
+
+    def depth(s, limit=16):
+        d = 0
+        while d < limit:
+            pid_ = s.get("parent_id")
+            if not pid_ or pid_ not in by_id:
+                return d
+            s = by_id[pid_]
+            d += 1
+        return d
+
+    head = [f"trace {trace.get('trace_id')}  "
+            f"{(t1 - t0) * 1000:.2f}ms  "
+            f"spans={len(spans)} procs={len(trace.get('procs') or ())}"
+            f"  verdict={trace.get('verdict', 'open')}"
+            f"{'' if trace.get('complete', True) else '  (incomplete)'}"]
+    if trace.get("error"):
+        head.append("  ** contains errors/deadline misses **")
+    if trace.get("watchdog_flagged"):
+        head.append("  ** watchdog flagged **")
+    lines = head
+    width = 30
+    span_ms = max(1e-9, t1 - t0)
+    for s in sorted(spans, key=lambda x: (x["t0"], x["t1"])):
+        off = (s["t0"] - t0)
+        dur = max(0.0, s["t1"] - s["t0"])
+        a = int(width * off / span_ms)
+        b = max(1, int(width * dur / span_ms))
+        bar = " " * a + "#" * min(b, width - a)
+        lines.append(
+            f"{off * 1000:9.2f}ms {bar:<{width}} "
+            f"{'  ' * depth(s)}{s['name']} ({dur * 1000:.2f}ms) "
+            f"[{s.get('role')}@{s.get('host')}:{s.get('pid')}]")
+    flights = trace.get("flight") or ()
+    if flights:
+        lines.append(f"flight events: "
+                     + ", ".join(sorted({f"{e.get('tier')}/{e.get('kind')}"
+                                         for e in flights})))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.observability.top",
+        description="live fleet dashboard / trace waterfall viewer")
+    ap.add_argument("cmd", nargs="?", default="top",
+                    choices=["top", "trace"])
+    ap.add_argument("trace_id", nargs="?")
+    ap.add_argument("--collector", default=os.environ.get(
+        "PADDLE_TPU_TELEMETRY_COLLECTOR") or "127.0.0.1:8600")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no ANSI)")
+    ap.add_argument("--out", help="trace: write the merged Chrome "
+                                  "trace JSON here")
+    args = ap.parse_args(argv)
+
+    from ..distributed.fleet.runtime.rpc import RpcClient
+    cli = RpcClient(args.collector,
+                    secret=os.environ.get("PADDLE_PS_SECRET") or None,
+                    timeout=5.0, deadline=5.0, max_retries=0)
+    try:
+        if args.cmd == "trace":
+            if not args.trace_id:
+                print("usage: ... trace <trace_id>", file=sys.stderr)
+                return 2
+            rep = cli.call({"op": "tel_trace",
+                            "trace_id": args.trace_id,
+                            "chrome": bool(args.out)})
+            tr = rep.get("trace")
+            if tr is None:
+                print(f"trace {args.trace_id}: not retained "
+                      f"(unknown or sampled out)", file=sys.stderr)
+                return 1
+            print(render_waterfall(tr))
+            if args.out and rep.get("chrome") is not None:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    json.dump(rep["chrome"], f)
+                print(f"chrome trace -> {args.out}")
+            return 0
+        # top: live loop (or one shot)
+        while True:
+            fleet = cli.call({"op": "tel_fleet"})["fleet"]
+            text = render_fleet(fleet)
+            if args.once:
+                print(text)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        cli.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

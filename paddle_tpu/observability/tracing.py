@@ -34,8 +34,20 @@ import threading
 import time
 from collections import deque
 
+from . import registry as _obs
+
 __all__ = ["Span", "Tracer", "TRACER", "span", "current_trace_id",
            "export_chrome_trace", "new_trace_id"]
+
+# the span ring is bounded; overwrites used to be silent — mirror the
+# flight rings' drop accounting so a reader knows the window clipped
+_DROPPED = _obs.counter(
+    "paddle_tpu_trace_dropped_total",
+    "spans overwritten by a full trace ring")
+_HIGH_WATER = _obs.gauge(
+    "paddle_tpu_trace_ring_high_water",
+    "max spans ever resident in the trace ring (ring size when the "
+    "ring has wrapped)")
 
 
 def new_trace_id() -> str:
@@ -106,6 +118,16 @@ class Tracer:
         self._spans: deque[Span] = deque(maxlen=max_spans)
         self._lock = threading.Lock()
         self._tls = threading.local()
+        self._high_water = 0
+        # optional per-span tap (the telemetry agent): called OUTSIDE
+        # the ring lock with each finished span; must never block
+        self._sink = None
+
+    def set_sink(self, fn):
+        """``fn(span)`` is called for every finished span (after ring
+        append, outside the tracer lock). Pass None to detach. The sink
+        must be cheap and non-blocking — it runs on the traced thread."""
+        self._sink = fn
 
     # -- context --------------------------------------------------------
     def _stack(self) -> list:
@@ -157,7 +179,19 @@ class Tracer:
             stack.pop()
             if self.enabled:
                 with self._lock:
+                    if len(self._spans) == self._spans.maxlen:
+                        _DROPPED.inc()
                     self._spans.append(sp)
+                    n = len(self._spans)
+                    if n > self._high_water:
+                        self._high_water = n
+                        _HIGH_WATER.set(n)
+                sink = self._sink
+                if sink is not None:
+                    try:
+                        sink(sp)
+                    except Exception:
+                        pass
 
     # -- inspection / export --------------------------------------------
     def spans(self) -> list[Span]:

@@ -264,16 +264,22 @@ class ServingServer(socketserver.ThreadingTCPServer):
             return {"status": "timeout",
                     "tokens": np.asarray(h.generated, np.int32),
                     "error": f"not finished within {timeout}s; "
-                             "request cancelled"}
+                             "request cancelled",
+                    "trace_id": h.trace_id}
         return self._finished_reply(h)
 
     def _finished_reply(self, h):
+        # trace_id rides every reply so callers (loadgen exemplars,
+        # operators) can pull the assembled cross-process trace from
+        # the telemetry collector by id
         if h.status == "error":
-            return {"status": "error", "error": h.error or "failed"}
+            return {"status": "error", "error": h.error or "failed",
+                    "trace_id": h.trace_id}
         return {"status": h.status,
                 "tokens": np.asarray(h.generated, np.int32),
                 "prompt_len": int(h.prompt.size),
-                "latency_ms": round((h.latency() or 0.0) * 1e3, 3)}
+                "latency_ms": round((h.latency() or 0.0) * 1e3, 3),
+                "trace_id": h.trace_id}
 
     def _stream_result(self, req: dict, h):
         """Push tokens as they decode, finish with the normal reply.

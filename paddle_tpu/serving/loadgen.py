@@ -307,6 +307,7 @@ class LoadGenerator:
             def __init__(self, arr: Arrival, submitted_at: float):
                 self.status = "pending"
                 self.generated: list[int] = []
+                self.trace_id = None
                 self.deadline = None if arr.deadline is None \
                     else submitted_at + arr.deadline
                 self._queued_at = submitted_at
@@ -354,6 +355,7 @@ class LoadGenerator:
                         stream=stream,
                         on_token=h.on_tokens if stream else None)
                     h.status = rep.get("status", "error")
+                    h.trace_id = rep.get("trace_id")
                     h.generated = list(np.asarray(
                         rep.get("tokens", ())).ravel())
                 except Exception:
@@ -379,6 +381,16 @@ def _pct(sorted_vals: list[float], p: float) -> float | None:
         return None
     i = max(0, math.ceil(p / 100.0 * len(sorted_vals)) - 1)
     return sorted_vals[min(len(sorted_vals) - 1, i)]
+
+
+def _pct_exemplar(sorted_pairs: list, p: float):
+    """Trace id of the nearest-rank percentile sample — the request
+    that IS the reported p99, so an SLO regression links straight to
+    one assembled fleet trace instead of a number."""
+    if not sorted_pairs:
+        return None
+    i = max(0, math.ceil(p / 100.0 * len(sorted_pairs)) - 1)
+    return sorted_pairs[min(len(sorted_pairs) - 1, i)][1]
 
 
 def slo_report(result: LoadResult, window: tuple | None = None,
@@ -415,23 +427,28 @@ def slo_report(result: LoadResult, window: tuple | None = None,
     result._mirrored.add(gen)
     if mirror:
         weakref.finalize(result, _drop_gen_series, gen)
-    ttfts: list[float] = []
-    itls: list[float] = []
+    ttfts: list[tuple] = []     # (seconds, trace id or None)
+    itls: list[tuple] = []
     met = 0
     good_tokens = 0
     by_status: dict[str, int] = {}
     for arr, h in pairs:
         by_status[h.status] = by_status.get(h.status, 0) + 1
+        # engine Requests carry .trace_id natively; wire handles learn
+        # theirs from the generate reply — either way the histogram
+        # observation carries the exemplar so a bucket links back to
+        # the collector's assembled trace
+        tid = getattr(h, "trace_id", None)
         tt = h.ttft()
         if tt is not None:
-            ttfts.append(tt)
+            ttfts.append((tt, tid))
             if mirror:
-                _TTFT_H.labels(gen=gen).observe(tt)
+                _TTFT_H.labels(gen=gen).observe(tt, trace_id=tid)
         itl = h.inter_token()
         if itl is not None:
-            itls.append(itl)
+            itls.append((itl, tid))
             if mirror:
-                _ITL_H.labels(gen=gen).observe(itl)
+                _ITL_H.labels(gen=gen).observe(itl, trace_id=tid)
         ok = h.status == "done" and (
             h.deadline is None or h.finished_at is None
             or h.finished_at <= h.deadline)
@@ -449,8 +466,10 @@ def slo_report(result: LoadResult, window: tuple | None = None,
         if attainment is not None:
             _ATTAIN.labels(gen=gen).set(attainment)
         _GOODPUT.labels(gen=gen).inc(good_tokens)
-    ttfts.sort()
-    itls.sort()
+    ttfts.sort(key=lambda p: p[0])
+    itls.sort(key=lambda p: p[0])
+    tt_vals = [v for v, _ in ttfts]
+    itl_vals = [v for v, _ in itls]
     return {
         "offered": offered,
         "met": met,
@@ -458,14 +477,16 @@ def slo_report(result: LoadResult, window: tuple | None = None,
         else None,
         "goodput_tokens_per_sec": round(good_tokens / span, 2),
         "goodput_tokens": good_tokens,
-        "ttft_ms_p50": None if not ttfts
-        else round(_pct(ttfts, 50) * 1e3, 3),
-        "ttft_ms_p99": None if not ttfts
-        else round(_pct(ttfts, 99) * 1e3, 3),
-        "itl_ms_p50": None if not itls
-        else round(_pct(itls, 50) * 1e3, 3),
-        "itl_ms_p99": None if not itls
-        else round(_pct(itls, 99) * 1e3, 3),
+        "ttft_ms_p50": None if not tt_vals
+        else round(_pct(tt_vals, 50) * 1e3, 3),
+        "ttft_ms_p99": None if not tt_vals
+        else round(_pct(tt_vals, 99) * 1e3, 3),
+        "ttft_p99_trace": _pct_exemplar(ttfts, 99),
+        "itl_ms_p50": None if not itl_vals
+        else round(_pct(itl_vals, 50) * 1e3, 3),
+        "itl_ms_p99": None if not itl_vals
+        else round(_pct(itl_vals, 99) * 1e3, 3),
+        "itl_p99_trace": _pct_exemplar(itls, 99),
         "by_status": by_status,
         "elapsed_s": round(result.elapsed, 3),
     }

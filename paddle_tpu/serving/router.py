@@ -83,6 +83,8 @@ from ..distributed.fleet.runtime.rpc import (PSRemoteError, RpcClient,
 from ..observability import (debug as _debug, flight as _flight,
                              registry as _obs, tracing as _tracing,
                              watchdog as _watchdog)
+from ..observability.collector import (TEL_READ_OPS, TelemetryCollector,
+                                       telemetry_dispatch)
 
 __all__ = ["ReplicaSpec", "Replica", "Router", "InProcessReplica"]
 
@@ -232,10 +234,12 @@ class Router(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    READ_OPS = frozenset({"stats", "ping", "metrics", "debug_dump"})
+    READ_OPS = frozenset({"stats", "ping", "metrics", "debug_dump"}
+                         | TEL_READ_OPS)
 
     def __init__(self, endpoint: str = "127.0.0.1:0", replicas=(),
                  secret: str | None = None,
+                 telemetry_host: bool | None = None,
                  default_timeout: float = 120.0,
                  ping_interval: float | None = None,
                  ping_timeout: float | None = None,
@@ -304,6 +308,15 @@ class Router(socketserver.ThreadingTCPServer):
         self._lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._bg_threads: list[threading.Thread] = []
+        # telemetry hosting (the debug_dump-verb pattern): the router
+        # can carry the fleet collector on its own dispatch so small
+        # deployments need no extra process (PADDLE_TPU_TELEMETRY_HOST=1
+        # or telemetry_host=True); agents then point
+        # PADDLE_TPU_TELEMETRY_COLLECTOR at the router endpoint
+        if telemetry_host is None:
+            telemetry_host = os.environ.get(
+                "PADDLE_TPU_TELEMETRY_HOST", "") == "1"
+        self.collector = TelemetryCollector() if telemetry_host else None
         self._rpc = RpcServerState(read_ops=self.READ_OPS, secret=secret,
                                    expose_req_id=True)
         outer = self
@@ -800,6 +813,12 @@ class Router(socketserver.ThreadingTCPServer):
             return _obs.prometheus_text()
         if op == "debug_dump":
             return _debug.dump_verb(req)
+        if op and op.startswith("tel_"):
+            if self.collector is None:
+                raise ValueError("telemetry collector not hosted here "
+                                 "(set PADDLE_TPU_TELEMETRY_HOST=1)")
+            req.pop("_req_id", None)
+            return telemetry_dispatch(self.collector, req)
         if op == "drain_replica":
             return self._drain_replica(req)
         if op == "rollout":

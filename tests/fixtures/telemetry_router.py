@@ -1,0 +1,34 @@
+"""Router child for the fleet-telemetry e2e test.
+
+Builds a Router over the replicas named in ROUTER_REPLICAS (JSON
+``[["name", "endpoint"], ...]``) and serves until killed. The spawn
+env carries PADDLE_TPU_TELEMETRY_COLLECTOR, so the router process's
+telemetry agent auto-arms at observability import and streams the
+router-side spans of every forwarded generate to the collector.
+
+Prints one READY JSON line ({"endpoint", "pid"}).
+"""
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from paddle_tpu.serving import ReplicaSpec, Router  # noqa: E402
+
+
+def main():
+    replicas = [ReplicaSpec(name, ep) for name, ep in
+                json.loads(os.environ["ROUTER_REPLICAS"])]
+    router = Router(os.environ.get("ROUTER_ENDPOINT", "127.0.0.1:0"),
+                    replicas=replicas,
+                    ping_interval=0.1, ping_timeout=2.0)
+    router.start()
+    print(json.dumps({"endpoint": router.endpoint,
+                      "pid": os.getpid()}), flush=True)
+    while True:
+        time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()
