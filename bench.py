@@ -33,7 +33,9 @@ secondary configs in an "extras" dict unless BENCH_EXTRAS=0) | bert_base_512
 infer (BERT predictor latency) | flash_attn (pallas-vs-jnp microbench) |
 allreduce | metrics_overhead (telemetry enabled-vs-disabled decode
 step-time delta, <2% bar) | flight_overhead (flight recorder only
-toggled, same harness and bar) | checkpoint (store save/restore MB/s,
+toggled, same harness and bar) | perfwatch_overhead (perf-plane step
+sampler at its default cadence vs off, same harness and bar) |
+checkpoint (store save/restore MB/s,
 dedup ratio on a 1%-mutated state, async-vs-sync save step overhead,
 <5% bar) | slo (open-loop traffic replay against the serving tier:
 SLO attainment, goodput, p99 TTFT/ITL) | chaos (same seeded traffic +
@@ -64,13 +66,6 @@ import numpy as np
 
 _T0 = time.perf_counter()
 
-# known peak bf16 TFLOP/s per chip by device-kind substring
-_PEAKS = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5litepod", 197e12), ("v5", 459e12), ("v4", 275e12), ("v3", 123e12),
-    ("v2", 45e12),
-]
-_DEFAULT_PEAK = 275e12
 
 
 def _sync(x):
@@ -102,14 +97,15 @@ def _finish_timed(t0, loss):
 
 
 def chip_peak_flops():
+    # the peak table lives in the perf plane (ONE source for the live
+    # MFU gauges and the bench reports — the two can never disagree)
+    from paddle_tpu.observability import perf as _perf
+    peak, kind = _perf.chip_peak_flops()
     if os.environ.get("TPU_PEAK_TFLOPS_BF16"):
-        return float(os.environ["TPU_PEAK_TFLOPS_BF16"]) * 1e12, "env"
-    import jax
-    kind = getattr(jax.devices()[0], "device_kind", "") or ""
-    for sub, peak in _PEAKS:
-        if sub in kind.lower():
-            return peak, kind
-    return _DEFAULT_PEAK, f"{kind or 'unknown'} (assumed v4-class)"
+        return peak, "env"
+    if not any(sub in kind.lower() for sub, _ in _perf._PEAKS):
+        return peak, f"{kind or 'unknown'} (assumed v4-class)"
+    return peak, kind
 
 
 def bert_train_flops_per_step(cfg, batch, seq, n_pred=None):
@@ -1343,6 +1339,31 @@ def bench_telemetry_overhead(steps=200, hidden=256, layers=4, heads=4,
         srv.stop()
 
 
+def bench_perfwatch_overhead(steps=200, hidden=256, layers=4, heads=4,
+                             slots=4, seed=0):
+    """Perf-plane cost guardrail (ISSUE 14 acceptance): the step
+    sampler toggled A/B/A at its DEFAULT cadence vs fully off on the
+    same engine. Between samples the decode hot path only pays one
+    sampler tick (an int increment + modulo); a sampled step adds a
+    block_until_ready fence the following np.asarray would have paid
+    anyway. Same <2% bar as the other observability toggles."""
+    from paddle_tpu.observability import perf
+
+    default_every = perf.sampling_every() or 50
+
+    def set_enabled(on):
+        perf.set_every(default_every if on else 0)
+
+    set_enabled(True)
+    try:
+        return _bench_serving_toggle_overhead(
+            set_enabled, "serving_perfwatch_overhead_pct", steps=steps,
+            hidden=hidden, layers=layers, heads=heads, slots=slots,
+            seed=seed)
+    finally:
+        perf.set_every(default_every)
+
+
 def bench_checkpoint(state_mb=64, train_steps=150, save_every=50,
                      hidden=1024, seed=0):
     """Checkpoint-store economics (ISSUE 4 acceptance): save/restore
@@ -1716,6 +1737,8 @@ def main():
         rec = bench_flight_overhead()
     elif which == "telemetry_overhead":
         rec = bench_telemetry_overhead()
+    elif which == "perfwatch_overhead":
+        rec = bench_perfwatch_overhead()
     elif which == "checkpoint":
         rec = bench_checkpoint()
     elif which == "gpt_1p3b":
@@ -1807,6 +1830,11 @@ def main():
                         "error": f"{type(e).__name__}: {e}"}
             rec["extras"] = extras
     rec.setdefault("vs_baseline", 1.0)
+    # every config leaves a schema-versioned record; the same writer
+    # backs `perfwatch record`, and PADDLE_TPU_BENCH_OUT collects a
+    # sweep into one JSONL artifact for `perfwatch compare`
+    from paddle_tpu.observability.perfwatch import finalize_record
+    finalize_record(rec, which)
     print(json.dumps(rec))
 
 

@@ -19,8 +19,9 @@ import re
 from ..core import FileContext, KeyCounter, Rule, register
 
 __all__ = ["WirePickleRule", "MetricNamesRule", "EnvKnobsRule",
-           "REQUIRED_METRICS", "wire_hits", "metric_regs",
-           "knobs_in_tree", "wire_main", "metric_main", "env_main"]
+           "BenchSchemaRule", "REQUIRED_METRICS", "wire_hits",
+           "metric_regs", "knobs_in_tree", "wire_main", "metric_main",
+           "env_main", "bench_schema_main", "bench_result_paths"]
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +244,18 @@ REQUIRED_METRICS = {
     "paddle_tpu_trace_dropped_total",
     "paddle_tpu_telemetry_agent_dropped_total",
     "paddle_tpu_telemetry_traces_total",
+    # perf observability plane (docs/OBSERVABILITY.md perf plane): the
+    # cost registry, live MFU/breakdown attribution, compile wall-time
+    # and memory headroom gauges are the plane's acceptance contract —
+    # the perfwatch sentinel and the `top` perf pane read these exact
+    # names
+    "paddle_tpu_perf_flops",
+    "paddle_tpu_perf_bytes",
+    "paddle_tpu_perf_mfu",
+    "paddle_tpu_perf_step_breakdown_seconds",
+    "paddle_tpu_perf_compile_seconds",
+    "paddle_tpu_perf_hbm_bytes",
+    "paddle_tpu_perf_kv_cache_bytes",
 }
 
 
@@ -510,3 +523,91 @@ class EnvKnobsRule(Rule):
             f"docs/ENV_KNOBS.md (master index)",
             key=f"knob::{name}")
             for name in sorted(set(self._code) - documented)]
+
+
+# ---------------------------------------------------------------------------
+# bench-result schema (perfwatch sentinel inputs)
+# ---------------------------------------------------------------------------
+
+# the repo-root benchmark artifacts the perf-regression sentinel
+# compares across revisions (docs/OBSERVABILITY.md perf plane)
+BENCH_RESULT_RE = re.compile(r"^BENCH_r\d+.*\.json$")
+
+
+def _load_perfwatch():
+    """The perfwatch validator WITHOUT importing the jax-heavy
+    paddle_tpu package (same trick as scripts/_analysis_loader.py):
+    observability/perfwatch.py is stdlib-only at module level by
+    contract, so it loads standalone straight from its file."""
+    import importlib.util
+    import sys
+    if "paddle_tpu.observability.perfwatch" in sys.modules:
+        return sys.modules["paddle_tpu.observability.perfwatch"]
+    if "pt_perfwatch" not in sys.modules:
+        from ..core import repo_root
+        path = os.path.join(repo_root(), "paddle_tpu",
+                            "observability", "perfwatch.py")
+        spec = importlib.util.spec_from_file_location(
+            "pt_perfwatch", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["pt_perfwatch"] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules["pt_perfwatch"]
+
+
+def bench_result_paths(repo: str) -> list[str]:
+    return [os.path.join(repo, fn) for fn in sorted(os.listdir(repo))
+            if BENCH_RESULT_RE.match(fn)]
+
+
+def bench_schema_main(argv: list[str], repo: str) -> int:
+    """check_bench_schema.py behavior: every benchmark artifact must
+    parse under the perfwatch record schema, or `perfwatch compare`
+    against a future revision silently loses metrics."""
+    paths = argv[1:] or bench_result_paths(repo)
+    pw = _load_perfwatch()
+    bad = []
+    for path in paths:
+        try:
+            problems = pw.validate_file(path)
+        except OSError as e:
+            problems = [f"unreadable: {e}"]
+        bad.extend(f"{path}: {p}" for p in problems)
+    if bad:
+        print("bench result files violate the perfwatch record schema "
+              "(docs/OBSERVABILITY.md perf plane — `perfwatch "
+              "compare` reads these):")
+        print("\n".join(bad))
+        return 1
+    print(f"OK: {len(paths)} bench result file(s) conform to the "
+          f"perfwatch record schema")
+    return 0
+
+
+@register
+class BenchSchemaRule(Rule):
+    name = "bench-schema"
+    description = ("repo-root BENCH_r*.json artifacts parse under the "
+                   "perfwatch record schema (the perf-regression "
+                   "sentinel's input contract)")
+
+    def visit(self, ctx: FileContext):
+        return ()
+
+    def finalize(self, run):
+        if not run.default_scan:  # fixture/subtree scans carry no
+            return ()             # benchmark artifacts
+        from ..core import repo_root
+        out = []
+        dedup = KeyCounter()
+        for path in bench_result_paths(repo_root()):
+            try:
+                problems = _load_perfwatch().validate_file(path)
+            except Exception as e:  # a validator crash must not take
+                problems = [f"validator error: {e}"]  # down the scan
+            rel = os.path.basename(path)
+            out.extend(self.finding(
+                path, 0, f"bench artifact {problem}",
+                key=dedup(f"bench::{rel}::{problem}"))
+                for problem in problems)
+        return out

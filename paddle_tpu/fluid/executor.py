@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..observability import flight as _flight, registry as _obs
+from ..observability import (flight as _flight, perf as _perf,
+                             registry as _obs)
 from . import core, registry
 from .framework import Block, Program, Variable, default_main_program
 from .scope import Scope, global_scope
@@ -169,6 +170,12 @@ class Executor:
         self.place = place or core.default_place()
         self._cache: dict[tuple, Any] = {}
         self._run_counter = 0
+        # perf plane: compile misses time the first (compiling) call and
+        # register the program's XLA cost; steady-state runs are fenced
+        # and decomposed only when the sampler fires
+        self._compile_missed = False
+        self._perf_sampler = _perf.StepSampler("executor")
+        self._perf_flops: dict[str, float] = {}
 
     # -- public API --------------------------------------------------------
     def run(self, program: Program | None = None, feed: dict | None = None,
@@ -187,6 +194,8 @@ class Executor:
 
     def _run_impl(self, program, feed, fetch_list, scope, return_numpy,
                   use_program_cache, use_prune):
+        import time as _time
+        t_host0 = _time.perf_counter()
         program = program if program is not None else default_main_program()
         # CompiledProgram.with_data_parallel → batch-axis sharding over the
         # mesh (replaces reference ParallelExecutor, parallel_executor.cc:443)
@@ -286,8 +295,33 @@ class Executor:
             (program.random_seed * 1000003 + self._run_counter) & 0xFFFFFFFF
             if program.random_seed
             else np.random.randint(0, 2**31))
+        miss = self._compile_missed
+        sample = (not miss) and self._perf_sampler.tick()
+        ckey = None
+        if miss or sample:
+            ckey = _cost_key(feed_names, feed_vals, program._is_test)
+        if miss:
+            # lowering is abstract and rides the path that pays the
+            # compile anyway; the buffers are still valid pre-call
+            fl = _perf.register_jit_cost(
+                "executor", ckey, fn, tuple(upd_in_vals), tuple(ro_vals),
+                tuple(feed_vals), seed)
+            if fl:
+                self._perf_flops[ckey] = fl
+        t_disp0 = _time.perf_counter()
         fetches, updates = fn(tuple(upd_in_vals), tuple(ro_vals),
                               tuple(feed_vals), seed)
+        if miss or sample:
+            t_disp1 = _time.perf_counter()
+            jax.block_until_ready((fetches, updates))
+            t_dev = _time.perf_counter()
+            if miss:
+                _perf.note_compile_seconds("executor", t_dev - t_disp0)
+            else:
+                fl = self._perf_flops.get(ckey)
+                if fl:
+                    _perf.set_mfu("executor",
+                                  _perf.mfu(fl, t_dev - t_disp0))
         for n, v in zip(upd_names, updates):
             scope.set(n, v)
         if core.get_flags("FLAGS_benchmark")["FLAGS_benchmark"]:
@@ -313,9 +347,23 @@ class Executor:
                 raise RuntimeError(
                     f"NaN/Inf detected in {bad[:8]} after executor step "
                     f"(FLAGS_check_nan_inf)")
-        if return_numpy:
-            return core.batched_to_numpy(fetches)
-        return list(fetches)
+        if not sample:
+            if return_numpy:
+                return core.batched_to_numpy(fetches)
+            return list(fetches)
+        # sampled run: close the breakdown with the host->numpy copy as
+        # the transfer phase (zero when the caller keeps device arrays)
+        t_tr0 = _time.perf_counter()
+        out = core.batched_to_numpy(fetches) if return_numpy \
+            else list(fetches)
+        _perf.record_breakdown("executor", {
+            "host": t_disp0 - t_host0,
+            "dispatch": t_disp1 - t_disp0,
+            "device": t_dev - t_disp1,
+            "transfer": (_time.perf_counter() - t_tr0)
+            if return_numpy else 0.0,
+        })
+        return out
 
     # -- data-parallel sharding --------------------------------------------
     def _mesh_for(self, program):
@@ -402,8 +450,10 @@ class Executor:
         if fn is not None:
             self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
             _EXEC_CACHE_HITS.inc()
+            self._compile_missed = False
             return fn
         _EXEC_COMPILES.inc()
+        self._compile_missed = True
         # one flight event per cache miss: a burst of these in a
         # postmortem ring IS a recompile storm (feed shapes/structure
         # churning), with the feed shapes as the evidence
@@ -512,6 +562,16 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def _cost_key(feed_names, feed_vals, is_test: bool) -> str:
+    """Deterministic low-cardinality cost-registry key for a compiled
+    program signature: mode + the first few feed shapes (what actually
+    distinguishes compile buckets in practice)."""
+    feeds = ";".join(
+        f"{n}{'x'.join(map(str, v.shape)) or 'scalar'}"
+        for n, v in list(zip(feed_names, feed_vals))[:4])
+    return f"{'test' if is_test else 'train'}[{feeds}]"
 
 
 def _to_array(x, dtype=None):

@@ -51,8 +51,8 @@ import atexit
 import os
 import socket
 
-from . import agent, collector, debug, flight, registry, tracing, \
-    watchdog
+from . import agent, collector, debug, flight, perf, perfwatch, \
+    registry, tracing, watchdog
 from .agent import TelemetryAgent, publish_event
 from .collector import TelemetryCollector, telemetry_dispatch
 from .debug import collect, load_bundle, write_bundle
@@ -67,7 +67,7 @@ from .watchdog import WATCHDOG
 
 __all__ = [
     "registry", "tracing", "flight", "watchdog", "debug",
-    "agent", "collector",
+    "agent", "collector", "perf", "perfwatch",
     "TelemetryAgent", "TelemetryCollector",
     "telemetry_dispatch", "publish_event",
     "REGISTRY", "MetricsRegistry", "MetricError",

@@ -306,6 +306,42 @@ class TelemetryCollector:
             or total("paddle_tpu_rpc_server_requests_total")
         if pushes is not None:
             out["server_requests_total"] = pushes
+
+        def by_labels(name, *keys):
+            m = by_name.get(name)
+            if not m:
+                return {}
+            return {"/".join(str(s["labels"].get(k, "")) for k in keys):
+                    s.get("value") for s in m.get("samples", ())
+                    if s.get("value") is not None}
+
+        # perf plane (docs/OBSERVABILITY.md): per-loop MFU, last
+        # sampled step breakdown, compile counts, HBM + KV bytes —
+        # what the `top` perf pane renders per process
+        perf = {}
+        mfu = by_labels("paddle_tpu_perf_mfu", "name")
+        if mfu:
+            perf["mfu"] = mfu
+        bd = by_labels("paddle_tpu_perf_step_breakdown_seconds",
+                       "name", "phase")
+        if bd:
+            perf["breakdown"] = bd
+        compiles = total("paddle_tpu_serving_compiles_total")
+        ecompiles = total("paddle_tpu_executor_compiles_total")
+        if compiles or ecompiles:
+            perf["compiles_total"] = (compiles or 0.0) + (ecompiles or 0.0)
+        hbm = by_labels("paddle_tpu_perf_hbm_bytes", "kind")
+        if any(hbm.values()):
+            perf["hbm"] = hbm
+        kv = total("paddle_tpu_perf_kv_cache_bytes")
+        if kv:
+            perf["kv_cache_bytes"] = kv
+        kern = by_labels("paddle_tpu_autobench_candidate_ms",
+                         "key", "candidate")
+        if kern:
+            perf["kernel_ms"] = kern
+        if perf:
+            out["perf"] = perf
         return out
 
     # -- completion + tail sampling --------------------------------------

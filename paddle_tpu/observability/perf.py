@@ -1,0 +1,538 @@
+"""Performance observability plane: cost registry, MFU, step attribution.
+
+This module turns "MFU is 44%" into "these buckets/phases burn the gap".
+Three pieces, all views over the one metrics registry:
+
+* **cost registry** — every jitted callable we own (Executor programs,
+  Engine per-bucket prefill/decode, fused-block ops) registers its
+  ``lower().cost_analysis()`` FLOPs / bytes-accessed at trace time,
+  keyed by ``(name, key)`` where ``key`` is the compile bucket or feed
+  shape.  Exposed as ``paddle_tpu_perf_flops`` / ``paddle_tpu_perf_bytes``
+  gauges and a :func:`roofline` table (arithmetic intensity vs the
+  chip's ridge point).
+* **step-time decomposition** — :class:`StepSampler` gates a sampled
+  profile of one step in ``PADDLE_TPU_PERFWATCH_EVERY`` (default 50;
+  0 disables).  On a sampled step the caller fences phase boundaries
+  with ``block_until_ready`` and reports host / dispatch / device /
+  transfer seconds via :func:`record_breakdown`; between samples the
+  hot path is untouched, so steady-state overhead stays ~0.
+* **MFU accounting** — :func:`chip_peak_flops` resolves the chip's
+  peak bf16 FLOP/s from ``jax.devices()[0].device_kind`` (bench.py
+  delegates here, so live gauges and bench reports share one peak
+  table by construction) and :func:`mfu` converts achieved FLOP/s to
+  model-flops-utilisation.
+
+:func:`snapshot` serialises the whole plane (costs, breakdowns, kernel
+margins, HBM stats) into the schema-versioned dict ``perfwatch record``
+writes and ``perfwatch compare`` diffs.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import weakref
+
+from . import flight as _flight
+from . import registry as _obs
+
+__all__ = [
+    "SNAPSHOT_SCHEMA",
+    "StepSampler",
+    "analytic_gpt_flops",
+    "chip_peak_bytes_per_s",
+    "chip_peak_flops",
+    "breakdowns",
+    "costs",
+    "drop_instance",
+    "kernels",
+    "kv_cache_gauge",
+    "mfu",
+    "mfu_gauge",
+    "note_compile_seconds",
+    "note_kernel",
+    "record_breakdown",
+    "register_cost",
+    "register_jit_cost",
+    "register_provider",
+    "reset",
+    "roofline",
+    "sampling_every",
+    "set_every",
+    "set_mfu",
+    "snapshot",
+    "weak_provider",
+]
+
+SNAPSHOT_SCHEMA = "paddle_tpu.perf/1"
+
+# ---------------------------------------------------------------------------
+# Peak tables.  bench.py's chip_peak_flops() delegates here so the live
+# MFU gauges and the bench reports can never disagree on the peak.
+# ---------------------------------------------------------------------------
+
+# (device_kind substring, peak bf16 FLOP/s).  Order matters: first match
+# wins, so the more specific names come first.
+_PEAKS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+_DEFAULT_PEAK = 275e12
+
+# (device_kind substring, HBM bandwidth bytes/s) — for the roofline
+# ridge point.  Same shape as _PEAKS; override with TPU_PEAK_GBPS.
+_BWS = [
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5litepod", 819e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+]
+_DEFAULT_BW = 1228e9
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def chip_peak_flops() -> tuple[float, str]:
+    """(peak bf16 FLOP/s, device kind) for one chip.
+
+    ``TPU_PEAK_TFLOPS_BF16`` overrides the table (e.g. for new chips or
+    int8 serving); on CPU the TPU-class default keeps MFU numbers
+    comparable across hosts rather than meaningful in absolute terms.
+    """
+    kind = _device_kind()
+    env = os.environ.get("TPU_PEAK_TFLOPS_BF16")
+    if env:
+        try:
+            return float(env) * 1e12, kind
+        except ValueError:
+            pass
+    low = kind.lower()
+    for sub, peak in _PEAKS:
+        if sub in low:
+            return peak, kind
+    return _DEFAULT_PEAK, kind
+
+
+def chip_peak_bytes_per_s() -> tuple[float, str]:
+    """(HBM bandwidth bytes/s, device kind); ``TPU_PEAK_GBPS`` overrides."""
+    kind = _device_kind()
+    env = os.environ.get("TPU_PEAK_GBPS")
+    if env:
+        try:
+            return float(env) * 1e9, kind
+        except ValueError:
+            pass
+    low = kind.lower()
+    for sub, bw in _BWS:
+        if sub in low:
+            return bw, kind
+    return _DEFAULT_BW, kind
+
+
+def mfu(flops: float, seconds: float) -> float:
+    """Model-flops-utilisation of `flops` model FLOPs in `seconds`."""
+    if seconds <= 0 or flops <= 0:
+        return 0.0
+    peak, _ = chip_peak_flops()
+    return float(flops) / seconds / peak
+
+
+def analytic_gpt_flops(cfg, tokens: int, ctx: int) -> float:
+    """Matmul-only forward FLOPs for `tokens` new tokens of a GPT block
+    stack at context length `ctx` — the fallback when XLA cost analysis
+    is unavailable.  Matches bench.py's convention (qkv+proj+mlp+attn
+    matmuls + the LM head, no norms/softmax)."""
+    H = int(getattr(cfg, "hidden_size", 0))
+    L = int(getattr(cfg, "num_layers", 0))
+    F = int(getattr(cfg, "intermediate_size", 4 * H) or 4 * H)
+    V = int(getattr(cfg, "vocab_size", 0))
+    if not (H and L):
+        return 0.0
+    per_layer = (
+        3 * 2 * H * H        # qkv projections
+        + 2 * H * H          # output projection
+        + 2 * 2 * ctx * H    # qk^T and attn@v
+        + 2 * H * F + 2 * F * H  # mlp
+    )
+    return float(tokens) * (L * per_layer + 2 * H * V)
+
+
+# ---------------------------------------------------------------------------
+# Metric series (the ONE registration site for every paddle_tpu_perf_*
+# name — check_metric_names.py holds this).
+# ---------------------------------------------------------------------------
+
+_FLOPS = _obs.gauge(
+    "paddle_tpu_perf_flops",
+    "XLA/analytic FLOPs per invocation of a jitted callable",
+    ["name", "key"])
+_BYTES = _obs.gauge(
+    "paddle_tpu_perf_bytes",
+    "XLA bytes accessed per invocation of a jitted callable",
+    ["name", "key"])
+_MFU = _obs.gauge(
+    "paddle_tpu_perf_mfu",
+    "live model-flops-utilisation (achieved/peak) per instrumented loop",
+    ["name"])
+_BREAKDOWN = _obs.gauge(
+    "paddle_tpu_perf_step_breakdown_seconds",
+    "last sampled step-time decomposition (host/dispatch/device/transfer)",
+    ["name", "phase"])
+# Compiles run 0.1s (tiny CPU programs) to minutes (big TPU models);
+# the default request-latency buckets top out far too low.
+_COMPILE_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+                    30.0, 60.0, 120.0, 300.0)
+_COMPILE_H = _obs.histogram(
+    "paddle_tpu_perf_compile_seconds",
+    "jit compile wall time per site (first-call wall clock)",
+    ["site"], buckets=_COMPILE_BUCKETS)
+_HBM = _obs.gauge(
+    "paddle_tpu_perf_hbm_bytes",
+    "device memory stats from jax (0 when the backend has none)",
+    ["kind"])
+_KV_BYTES = _obs.gauge(
+    "paddle_tpu_perf_kv_cache_bytes",
+    "bytes held by a serving engine's paged KV cache",
+    ["engine"])
+
+
+def _hbm_stat(stat: str) -> float:
+    try:
+        import jax
+
+        st = jax.devices()[0].memory_stats()
+        if st:
+            return float(st.get(stat, 0) or 0)
+    except Exception:
+        pass
+    return 0.0
+
+
+_HBM.labels(kind="in_use").set_function(lambda: _hbm_stat("bytes_in_use"))
+_HBM.labels(kind="limit").set_function(lambda: _hbm_stat("bytes_limit"))
+_HBM.labels(kind="peak").set_function(lambda: _hbm_stat("peak_bytes_in_use"))
+
+
+def kv_cache_gauge(engine_id: str):
+    """Per-engine KV-cache-bytes gauge child (engine sets a weakref
+    function on it; dropped with the engine's other series)."""
+    return _KV_BYTES.labels(engine=engine_id)
+
+
+def mfu_gauge(name: str):
+    """Labeled MFU gauge child for `name` (callers may set_function)."""
+    return _MFU.labels(name=name)
+
+
+# ---------------------------------------------------------------------------
+# Cost registry
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+_COSTS: dict[tuple[str, str], dict] = {}
+_BREAKDOWNS: dict[str, dict] = {}
+_KERNELS: dict[str, dict] = {}
+_MFU_VALUES: dict[str, float] = {}
+# name -> zero-arg callable returning a JSON-safe dict merged into
+# snapshot()["providers"].  Callables must be cheap and must not block.
+_PROVIDERS: dict[str, object] = {}
+
+
+def costs_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_PERFWATCH_COSTS", "1") != "0"
+
+
+def register_cost(name: str, key: str, flops: float | None,
+                  bytes_accessed: float | None = None,
+                  source: str = "analytic") -> float | None:
+    """Record the per-invocation cost of jitted callable (name, key)."""
+    fl = float(flops) if flops and flops > 0 else None
+    by = float(bytes_accessed) if bytes_accessed and bytes_accessed > 0 else None
+    with _LOCK:
+        _COSTS[(name, key)] = {"flops": fl, "bytes": by, "source": source}
+    if fl is not None:
+        _FLOPS.labels(name=name, key=key).set(fl)
+    if by is not None:
+        _BYTES.labels(name=name, key=key).set(by)
+    return fl
+
+
+def _cost_from_analysis(ca) -> tuple[float | None, float | None]:
+    # jax returns a dict, a list of per-computation dicts, or None
+    # depending on version/backend.
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None, None
+    fl = ca.get("flops")
+    by = ca.get("bytes accessed")
+    fl = float(fl) if isinstance(fl, (int, float)) and fl > 0 else None
+    by = float(by) if isinstance(by, (int, float)) and by > 0 else None
+    return fl, by
+
+
+def register_jit_cost(name: str, key: str, jitfn, *args,
+                      analytic_flops: float | None = None) -> float | None:
+    """Lower `jitfn(*args)` and register its XLA cost analysis.
+
+    Lowering is abstract (shapes only — safe with donated buffers) but
+    not free, so call this once per compile bucket, on the same path
+    that pays the compile.  Falls back to `analytic_flops` when the
+    backend reports nothing; never raises.
+    """
+    fl = by = None
+    if costs_enabled():
+        try:
+            fl, by = _cost_from_analysis(jitfn.lower(*args).cost_analysis())
+        except Exception:
+            fl = by = None
+    if fl is not None:
+        return register_cost(name, key, fl, by, source="xla")
+    return register_cost(name, key, analytic_flops, by, source="analytic")
+
+
+def costs() -> dict[tuple[str, str], dict]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _COSTS.items()}
+
+
+def roofline() -> list[dict]:
+    """Rows of (name, key, flops, bytes, intensity, bound, frac_of_ridge).
+
+    `bound` says whether the op sits left (memory-bound) or right
+    (compute-bound) of the chip's ridge point peak_flops/peak_bw.
+    """
+    peak, _ = chip_peak_flops()
+    bw, _ = chip_peak_bytes_per_s()
+    ridge = peak / bw if bw else float("inf")
+    rows = []
+    for (name, key), c in sorted(costs().items()):
+        fl, by = c.get("flops"), c.get("bytes")
+        inten = (fl / by) if fl and by else None
+        rows.append({
+            "name": name, "key": key,
+            "flops": fl, "bytes": by,
+            "intensity": inten,
+            "ridge": ridge,
+            "bound": (None if inten is None
+                      else ("compute" if inten >= ridge else "memory")),
+            "source": c.get("source"),
+        })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Step sampling + breakdown
+# ---------------------------------------------------------------------------
+
+def _env_every() -> int:
+    try:
+        return max(0, int(os.environ.get("PADDLE_TPU_PERFWATCH_EVERY", "50")))
+    except ValueError:
+        return 50
+
+
+_EVERY = _env_every()
+
+
+def sampling_every() -> int:
+    """Current sampling cadence (every Nth step; 0 = off)."""
+    return _EVERY
+
+
+def set_every(n: int) -> None:
+    """Override the sampling cadence at runtime (bench A/B/A, tests)."""
+    global _EVERY
+    _EVERY = max(0, int(n))
+
+
+class StepSampler:
+    """Decides which steps pay for a fenced profile.
+
+    ``tick()`` returns True on every Nth call where N is the *current*
+    module cadence (so ``set_every`` toggles live samplers too).  The
+    first tick never samples: step 1 is usually a compile.
+    """
+
+    __slots__ = ("name", "_n")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._n = 0
+
+    def tick(self) -> bool:
+        every = _EVERY
+        if every <= 0:
+            return False
+        self._n += 1
+        return self._n % every == 0
+
+
+def record_breakdown(name: str, phases: dict[str, float]) -> None:
+    """Report one sampled step's phase decomposition (seconds)."""
+    now = time.time()
+    with _LOCK:
+        ent = _BREAKDOWNS.setdefault(name, {"samples": 0, "phases": {}})
+        ent["samples"] += 1
+        ent["time"] = now
+        for ph, v in phases.items():
+            ent["phases"][ph] = float(v)
+    for ph, v in phases.items():
+        _BREAKDOWN.labels(name=name, phase=ph).set(float(v))
+    _flight.record("perf", "sample", name=name,
+                   **{k: round(float(v), 6) for k, v in phases.items()})
+
+
+def breakdowns() -> dict[str, dict]:
+    with _LOCK:
+        return {k: {"samples": v["samples"], "time": v.get("time"),
+                    "phases": dict(v["phases"])}
+                for k, v in _BREAKDOWNS.items()}
+
+
+def set_mfu(name: str, value: float) -> None:
+    """Set the live MFU gauge for `name` (explicit-update style; loops
+    that prefer pull register a set_function on mfu_gauge instead)."""
+    v = float(value)
+    if not math.isfinite(v):
+        v = 0.0
+    with _LOCK:
+        _MFU_VALUES[name] = v
+    _MFU.labels(name=name).set(v)
+
+
+def note_compile_seconds(site: str, seconds: float) -> None:
+    """Record one jit compile's wall time (first-call wall clock)."""
+    _COMPILE_H.labels(site=site).observe(float(seconds))
+
+
+# ---------------------------------------------------------------------------
+# Kernel margins (autobench feeds this)
+# ---------------------------------------------------------------------------
+
+def note_kernel(key: str, winner: str, timings_ms: dict[str, float]) -> None:
+    """Record an autobench decision: all measured candidate times, the
+    winner, and the winner's margin over the best loser."""
+    ts = {c: float(v) for c, v in timings_ms.items() if math.isfinite(v)}
+    margin = None
+    win_ms = ts.get(winner)
+    losers = [v for c, v in ts.items() if c != winner]
+    if win_ms and losers:
+        margin = min(losers) / win_ms  # >1: winner is margin× faster
+    with _LOCK:
+        _KERNELS[key] = {"winner": winner, "candidates_ms": ts,
+                         "margin": margin}
+
+
+def kernels() -> dict[str, dict]:
+    with _LOCK:
+        return {k: dict(v) for k, v in _KERNELS.items()}
+
+
+# ---------------------------------------------------------------------------
+# Providers + snapshot
+# ---------------------------------------------------------------------------
+
+def register_provider(name: str, fn) -> None:
+    """Register a cheap zero-arg callable contributing a dict to
+    snapshot()["providers"][name] (engines register a weakref-wrapped
+    rates summary).  Re-registering replaces."""
+    with _LOCK:
+        _PROVIDERS[name] = fn
+
+
+def unregister_provider(name: str) -> None:
+    with _LOCK:
+        _PROVIDERS.pop(name, None)
+
+
+def drop_instance(name: str, engine_id: str | None = None) -> None:
+    """Drop the per-instance series for a garbage-collected owner."""
+    unregister_provider(name)
+    _MFU.remove_matching(name=name)
+    _BREAKDOWN.remove_matching(name=name)
+    if engine_id is not None:
+        _KV_BYTES.remove_matching(engine=engine_id)
+    with _LOCK:
+        _BREAKDOWNS.pop(name, None)
+        _MFU_VALUES.pop(name, None)
+
+
+def snapshot() -> dict:
+    """Schema-versioned JSON-safe dump of the whole perf plane — the
+    payload of ``perfwatch record`` and the input to ``compare``."""
+    peak, kind = chip_peak_flops()
+    bw, _ = chip_peak_bytes_per_s()
+    with _LOCK:
+        providers = dict(_PROVIDERS)
+        mfus = dict(_MFU_VALUES)
+    prov_out = {}
+    for name, fn in providers.items():  # outside _LOCK: fns may lock
+        try:
+            d = fn()
+            if isinstance(d, dict):
+                prov_out[name] = d
+        except Exception:
+            pass
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "created_unix": time.time(),
+        "device_kind": kind,
+        "peak_flops": peak,
+        "peak_bytes_per_s": bw,
+        "costs": [
+            {"name": n, "key": k, **c} for (n, k), c in sorted(costs().items())
+        ],
+        "breakdown": breakdowns(),
+        "mfu": mfus,
+        "kernels": kernels(),
+        "hbm": {k: _hbm_stat(s) for k, s in
+                (("in_use", "bytes_in_use"), ("limit", "bytes_limit"),
+                 ("peak", "peak_bytes_in_use"))},
+        "providers": prov_out,
+    }
+
+
+def reset() -> None:
+    """Test hook: clear tables and per-(name,key) series."""
+    with _LOCK:
+        _COSTS.clear()
+        _BREAKDOWNS.clear()
+        _KERNELS.clear()
+        _MFU_VALUES.clear()
+        _PROVIDERS.clear()
+    for g in (_FLOPS, _BYTES):
+        g.remove_matching()
+    _MFU.remove_matching()
+    _BREAKDOWN.remove_matching()
+
+
+def weak_provider(obj, method_name: str):
+    """A provider callable holding only a weakref to `obj`."""
+    ref = weakref.ref(obj)
+    def call():
+        o = ref()
+        if o is None:
+            return {}
+        return getattr(o, method_name)()
+    return call

@@ -12,8 +12,15 @@ assembled cross-process waterfall for one trace id and, with
 ``--out f.json``, exports it as ONE merged Chrome trace with
 per-rank pid labels (Perfetto / chrome://tracing).
 
-Rendering is pure (``render_fleet`` / ``render_waterfall`` take the
-collector reply dicts), so tests drive it without a terminal.
+``python -m paddle_tpu.observability.top perf`` renders the perf
+pane (docs/OBSERVABILITY.md perf plane): per-role MFU, the last
+sampled step breakdown, compile counts (a rising number mid-run is a
+compile storm), HBM/KV headroom, and the autobench per-kernel
+Pallas-vs-XLA margins.
+
+Rendering is pure (``render_fleet`` / ``render_waterfall`` /
+``render_perf`` take the collector reply dicts), so tests drive it
+without a terminal.
 """
 from __future__ import annotations
 
@@ -22,7 +29,7 @@ import os
 import sys
 import time
 
-__all__ = ["render_fleet", "render_waterfall", "main"]
+__all__ = ["render_fleet", "render_perf", "render_waterfall", "main"]
 
 
 def _f(v, spec="7.1f", dash="      -") -> str:
@@ -91,6 +98,68 @@ def render_fleet(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def _gb(v) -> str:
+    return "-" if not v else f"{float(v) / 2**30:.2f}G"
+
+
+def render_perf(fleet: dict) -> str:
+    """The perf pane of a ``tel_fleet`` reply: per-role MFU + step
+    breakdown, compile counts, HBM/KV bytes, per-kernel margins."""
+    lines = [f"{'ROLE':<16} {'HOST:PID':<22} {'MFU':>7} {'COMPILES':>9} "
+             f"{'HBM used/limit':>16} {'KV':>8}  STEP BREAKDOWN (sampled)"]
+    kernel_ms: dict[str, float] = {}
+    any_perf = False
+    for p in fleet.get("procs") or ():
+        perf = (p.get("summary") or {}).get("perf") or {}
+        if not perf:
+            continue
+        any_perf = True
+        mfu = perf.get("mfu") or {}
+        hbm = perf.get("hbm") or {}
+        # one row per instrumented loop (engine:eN / executor), the
+        # process-level columns repeated on the first row only
+        loops = sorted(set(mfu)
+                       | {k.split("/")[0]
+                          for k in (perf.get("breakdown") or {})}) or ["-"]
+        for i, name in enumerate(loops):
+            bd = {k.split("/", 1)[1]: v for k, v
+                  in (perf.get("breakdown") or {}).items()
+                  if k.split("/")[0] == name}
+            bd_s = " ".join(f"{ph}={v * 1e3:.2f}ms" for ph, v
+                            in sorted(bd.items())) or "-"
+            first = i == 0
+            lines.append(
+                f"{str(p.get('role'))[:16] if first else '':<16} "
+                f"{(str(p.get('host')) + ':' + str(p.get('pid'))) if first else '':<22} "
+                f"{_f(mfu.get(name), '7.4f')} "
+                f"{_f(perf.get('compiles_total') if first else None, '9.0f', '        -')} "
+                f"{(_gb(hbm.get('in_use')) + '/' + _gb(hbm.get('limit'))) if first else '':>16} "
+                f"{_gb(perf.get('kv_cache_bytes')) if first else '':>8}  "
+                f"{name}: {bd_s}")
+        kernel_ms.update(perf.get("kernel_ms") or {})
+    if not any_perf:
+        lines.append("(no perf data yet — engines/executors report "
+                     "after their first compiled step)")
+    if kernel_ms:
+        lines.append("")
+        lines.append("kernel margins (autobench, ms per candidate):")
+        by_key: dict[str, dict[str, float]] = {}
+        for kc, ms in kernel_ms.items():
+            key, _, cand = kc.rpartition("/")
+            by_key.setdefault(key, {})[cand] = ms
+        for key in sorted(by_key):
+            cands = by_key[key]
+            finite = {c: m for c, m in cands.items()
+                      if m is not None and m == m and m != float("inf")}
+            win = min(finite, key=finite.get) if finite else "-"
+            row = " ".join(
+                f"{c}={'' if m is None else format(m, '.3f')}"
+                + ("*" if c == win else "")
+                for c, m in sorted(cands.items()))
+            lines.append(f"  {key}: {row}")
+    return "\n".join(lines)
+
+
 def render_waterfall(trace: dict) -> str:
     """The assembled cross-process waterfall of one ``tel_trace``
     reply: spans in aligned start order, indented by span parentage,
@@ -148,7 +217,7 @@ def main(argv=None) -> int:
         prog="paddle_tpu.observability.top",
         description="live fleet dashboard / trace waterfall viewer")
     ap.add_argument("cmd", nargs="?", default="top",
-                    choices=["top", "trace"])
+                    choices=["top", "trace", "perf"])
     ap.add_argument("trace_id", nargs="?")
     ap.add_argument("--collector", default=os.environ.get(
         "PADDLE_TPU_TELEMETRY_COLLECTOR") or "127.0.0.1:8600")
@@ -182,10 +251,11 @@ def main(argv=None) -> int:
                     json.dump(rep["chrome"], f)
                 print(f"chrome trace -> {args.out}")
             return 0
-        # top: live loop (or one shot)
+        # top/perf: live loop (or one shot)
+        render = render_perf if args.cmd == "perf" else render_fleet
         while True:
             fleet = cli.call({"op": "tel_fleet"})["fleet"]
-            text = render_fleet(fleet)
+            text = render(fleet)
             if args.once:
                 print(text)
                 return 0

@@ -63,7 +63,7 @@ import time
 import zlib
 from typing import Callable
 
-from ..observability import registry as _obs
+from ..observability import perf as _perf, registry as _obs
 
 __all__ = ["prefer", "decisions", "clear", "stats", "register_warmer",
            "warm", "list_entries", "invalidate", "KERNEL_VERSION",
@@ -133,6 +133,10 @@ def _record_decision(key, winner: str, timings: dict[str, float],
             round(t * 1e3, 4) if t < float("inf") else float("inf"))
         _WINNER.labels(key=skey, candidate=name).set(
             1.0 if name == winner else 0.0)
+    # the perf plane keeps the full per-candidate table so `top` can
+    # show Pallas-vs-XLA margins, not just the winner name
+    _perf.note_kernel(skey, winner,
+                      {n: t * 1e3 for n, t in timings.items()})
     _verbose_logging()
     ms = {k: round(v * 1e3, 3) for k, v in timings.items()}
     logger.info("%s -> %s %s (%s)", skey, winner, ms, source)
@@ -355,9 +359,19 @@ def prefer(key, candidates: dict[str, Callable], make_args: Callable,
     with _LOCK:
         _STATS["measures"] += 1
     _MEASURES.inc()
+    cost_args = None
     for name, fn in candidates.items():
         try:
             timings[name] = _measure(fn, make_args, reps)
+            # fused-block ops join the perf-plane cost registry on the
+            # same once-per-key measuring path (roofline rows per
+            # candidate; lowering is abstract and never raises)
+            if _perf.costs_enabled():
+                import jax
+                if cost_args is None:
+                    cost_args = make_args()
+                _perf.register_jit_cost(f"ops:{name}", str(key),
+                                        jax.jit(fn), *cost_args)
         except Exception:  # a candidate that errors never wins
             timings[name] = float("inf")
     winner = min(timings, key=timings.get)

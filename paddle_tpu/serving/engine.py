@@ -36,8 +36,8 @@ import numpy as np
 
 from ..distributed.fleet.runtime import fault_injection as _fi
 from ..observability import (debug as _debug, flight as _flight,
-                             registry as _obs, tracing as _tracing,
-                             watchdog as _watchdog)
+                             perf as _perf, registry as _obs,
+                             tracing as _tracing, watchdog as _watchdog)
 from .kv_cache import PagePool, defrag_plan
 from .scheduler import QueueFull, Request, Scheduler
 
@@ -234,6 +234,23 @@ class Engine:
         self._prefill = jax.jit(prefill, **kw)
         self._decode = jax.jit(decode, **kw)
 
+        # perf plane: per-bucket FLOP costs land in _register_perf_cost
+        # on each bucket's first (compiling) call; a bounded window of
+        # (time, flops) pairs backs the live MFU gauge the same way
+        # _tok_window backs tokens_per_sec
+        self.num_chips = 1               # single-chip engine today
+        self._flops_window: deque[tuple[float, float]] = deque(maxlen=512)
+        self._bucket_flops: dict[str, float] = {}
+        self._perf_sampler = _perf.StepSampler(f"engine:{eid}")
+        self._perf_name = f"engine:{eid}"
+        _perf.mfu_gauge(self._perf_name).set_function(
+            lambda: (lambda e: e.perf_rates()["mfu"] if e else 0.0)(wr()))
+        _perf.kv_cache_gauge(eid).set_function(
+            lambda: (lambda e: e._kv_cache_bytes() if e else 0.0)(wr()))
+        _perf.register_provider(self._perf_name,
+                                _perf.weak_provider(self, "perf_rates"))
+        weakref.finalize(self, _perf.drop_instance, self._perf_name, eid)
+
     # -- submission (any thread) ---------------------------------------
     def submit(self, prompt, max_new_tokens: int = 16,
                deadline: float | None = None,
@@ -331,17 +348,29 @@ class Engine:
         T = min(T, self.max_pages_per_req * self.page_size)
         toks = np.zeros((T,), np.int32)
         toks[:req.prompt.size] = req.prompt
+        bucket = f"prefill[{T}]"
+        targs = (self.model.params, self.cache, jnp.asarray(toks),
+                 np.int32(req.prompt.size),
+                 jnp.asarray(self._row(req), dtype=jnp.int32))
+        # read BEFORE the cost registration: lower() traces the fn and
+        # seeds the jit cache, so the note_compile side effect fires
+        # there, not on the timed first call
+        pre_compiles = self._compiles.get(bucket, 0)
+        if bucket not in self._compiles:
+            # first call of this bucket pays the compile anyway; the
+            # abstract lowering for cost analysis rides the same path
+            self._register_perf_cost(bucket, self._prefill, targs, T, T)
         t0 = time.perf_counter()
         with _tracing.span("engine.prefill", trace_id=req.trace_id,
                            engine=self.engine_id, request=req.id,
                            prompt_len=int(req.prompt.size), bucket=T):
-            self.cache, tok = self._prefill(
-                self.model.params, self.cache, jnp.asarray(toks),
-                np.int32(req.prompt.size), jnp.asarray(self._row(req),
-                                                       dtype=jnp.int32))
+            self.cache, tok = self._prefill(*targs)
             tok = int(tok)
         dt = time.perf_counter() - t0
         self._m_prefill_h.observe(dt)
+        if self._compiles.get(bucket, 0) > pre_compiles:
+            _perf.note_compile_seconds("engine.prefill", dt)
+        self._note_flops(self._bucket_flops.get(bucket))
         _flight.record("serving", "prefill", trace_id=req.trace_id,
                        engine=self.engine_id, request=req.id,
                        bucket=T, seconds=round(dt, 6))
@@ -369,6 +398,8 @@ class Engine:
                       if r is not None]
             if not active:
                 return bool(self.scheduler.queue_depth)
+            sample = self._perf_sampler.tick()
+            t_host0 = time.perf_counter()
             S = self.num_slots
             tokens = np.zeros((S,), np.int32)
             positions = np.zeros((S,), np.int32)
@@ -384,17 +415,34 @@ class Engine:
             # hung jitted decode — which is what the stall watchdog
             # must catch while requests keep queueing
             _fi.injector().maybe_stall("serving_decode")
+            bucket = f"decode[slots={S},pages={self.max_pages_per_req}]"
+            targs = (self.model.params, self.cache, jnp.asarray(tokens),
+                     jnp.asarray(positions), jnp.asarray(tables))
+            # as in _run_prefill: read before lower() runs the trace
+            pre_compiles = self._compiles.get(bucket, 0)
+            if bucket not in self._compiles:
+                self._register_perf_cost(bucket, self._decode, targs,
+                                         S, self.max_seq_len)
             try:
                 t0 = time.perf_counter()
                 with _tracing.span("engine.decode",
                                    engine=self.engine_id,
                                    active=len(active)):
-                    self.cache, next_toks = self._decode(
-                        self.model.params, self.cache,
-                        jnp.asarray(tokens), jnp.asarray(positions),
-                        jnp.asarray(tables))
-                    next_toks = np.asarray(next_toks)
-                self._m_decode_h.observe(time.perf_counter() - t0)
+                    self.cache, device_toks = self._decode(*targs)
+                    if sample:
+                        # fenced phase boundaries: dispatch ends when
+                        # the async jit call returns, device when the
+                        # result is ready, transfer when it is host-side
+                        import jax
+                        t1 = time.perf_counter()
+                        jax.block_until_ready(device_toks)
+                        t2 = time.perf_counter()
+                        next_toks = np.asarray(device_toks)
+                        t3 = time.perf_counter()
+                    else:
+                        next_toks = np.asarray(device_toks)
+                dt = time.perf_counter() - t0
+                self._m_decode_h.observe(dt)
             except Exception as e:
                 # a decode-step failure poisons the whole slot batch (the
                 # cache buffer may be donated/invalid): fail the in-flight
@@ -405,7 +453,20 @@ class Engine:
                     self._note_done(r)
                 self._recover_cache("failed decode")
                 raise
+            if self._compiles.get(bucket, 0) > pre_compiles:
+                _perf.note_compile_seconds("engine.decode", dt)
+            elif sample:
+                # host = batch building (token/position/table arrays);
+                # dispatch = the async jit call returning; device = the
+                # block_until_ready fence; transfer = device->host copy
+                _perf.record_breakdown(self._perf_name, {
+                    "host": t0 - t_host0,
+                    "dispatch": t1 - t0,
+                    "device": t2 - t1,
+                    "transfer": t3 - t2,
+                })
             self._note_tokens(len(active))
+            self._note_flops(self._bucket_flops.get(bucket))
             self._m_steps.inc()
             _flight.record("serving", "step", engine=self.engine_id,
                            active=len(active))
@@ -504,6 +565,49 @@ class Engine:
     def __exit__(self, *exc):
         self.stop()
 
+    # -- perf plane ----------------------------------------------------
+    def _register_perf_cost(self, bucket: str, jitfn, targs,
+                            tokens: int, ctx: int):
+        """First call of a compile bucket: register its XLA FLOPs/bytes
+        under (serving:<eid>, bucket), analytic matmul FLOPs as the
+        fallback when the backend reports no cost analysis."""
+        analytic = _perf.analytic_gpt_flops(
+            getattr(self.model, "cfg", None), tokens, ctx) or None
+        fl = _perf.register_jit_cost(f"serving:{self.engine_id}", bucket,
+                                     jitfn, *targs,
+                                     analytic_flops=analytic)
+        if fl:
+            self._bucket_flops[bucket] = fl
+
+    def _note_flops(self, flops: float | None):
+        if flops:
+            with self._stats_lock:
+                self._flops_window.append((time.monotonic(), flops))
+
+    def _kv_cache_bytes(self) -> float:
+        # the cache is whatever pytree the model keeps (dict of layers
+        # here); tree_leaves reaches the buffers regardless of shape
+        import jax
+        return float(sum(getattr(leaf, "nbytes", 0)
+                         for leaf in jax.tree_util.tree_leaves(self.cache)))
+
+    def perf_rates(self) -> dict:
+        """Cheap live rates for ping/stats and the perf snapshot: no
+        latency sort, two deque copies under the stats lock."""
+        with self._stats_lock:
+            w = list(self._tok_window)
+            fw = list(self._flops_window)
+        tps = 0.0
+        if len(w) >= 2 and w[-1][0] > w[0][0]:
+            tps = sum(n for _, n in w[1:]) / (w[-1][0] - w[0][0])
+        mfu = 0.0
+        if len(fw) >= 2 and fw[-1][0] > fw[0][0]:
+            flops_per_s = sum(f for _, f in fw[1:]) / (fw[-1][0] - fw[0][0])
+            mfu = _perf.mfu(flops_per_s, 1.0)
+        return {"tokens_per_sec": round(tps, 2),
+                "tokens_per_s_per_chip": round(tps / self.num_chips, 2),
+                "mfu": round(mfu, 5)}
+
     # -- stats ---------------------------------------------------------
     def _note_tokens(self, n: int):
         self._wd_progress += 1
@@ -560,12 +664,15 @@ class Engine:
         tps = 0.0
         if len(w) >= 2 and w[-1][0] > w[0][0]:
             tps = sum(n for _, n in w[1:]) / (w[-1][0] - w[0][0])
+        rates = self.perf_rates()
         return {**self.scheduler.stats(),
                 "pool": self.pool.stats(),
                 "model_version": self.model_version,
                 "steps": int(self._m_steps.value),
                 "tokens_generated": total,
                 "tokens_per_sec": round(tps, 2),
+                "tokens_per_s_per_chip": rates["tokens_per_s_per_chip"],
+                "mfu": rates["mfu"],
                 "latency_ms_p50": pct(50), "latency_ms_p99": pct(99),
                 "completed_seen": len(lats),
                 "compiles": dict(self._compiles)}

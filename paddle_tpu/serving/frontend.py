@@ -46,10 +46,11 @@ Ops:
     around this replica. {"wait": true, "timeout": s} blocks until the
     queue ran dry (reply carries "idle").
   {"op": "ping"}  -> {"ok": true, "draining": bool, "queue_depth": n,
-    "active_slots": n, "occupancy": f, "model_version": v}  — the
-    router's health/load probe (cheap: no latency sorting, two
-    lock-free gauge reads); model_version is the published version
-    the engine serves (docs/ONLINE_LEARNING.md)
+    "active_slots": n, "occupancy": f, "model_version": v,
+    "tokens_per_s_per_chip": f, "mfu": f}  — the router's health/load
+    probe (cheap: no latency sorting); model_version is the published
+    version the engine serves (docs/ONLINE_LEARNING.md); the rate/MFU
+    keys are the perf plane's live per-chip view (docs/OBSERVABILITY.md)
   {"op": "adopt_version", "version": v} -> {"adopted": v, ...}
     Zero-downtime hot swap to published version v from the replica's
     CONFIGURED publish root (publish_root= / PADDLE_TPU_PUBLISH_DIR —
@@ -169,13 +170,19 @@ class ServingServer(socketserver.ThreadingTCPServer):
             # the router's combined health + load probe: queue depth and
             # occupancy WITHOUT engine.stats()'s latency sort, so a
             # sub-second ping cadence costs nothing measurable
+            # (perf_rates is two deque copies, same class of cheap)
             sched = self.engine.scheduler
+            rates = self.engine.perf_rates() \
+                if hasattr(self.engine, "perf_rates") else {}
             return {"ok": True, "draining": bool(sched.draining),
                     "queue_depth": sched.queue_depth,
                     "active_slots": len(sched.active_requests()),
                     "occupancy": float(self.engine.pool.occupancy),
                     "model_version":
-                        int(getattr(self.engine, "model_version", 0))}
+                        int(getattr(self.engine, "model_version", 0)),
+                    "tokens_per_s_per_chip":
+                        rates.get("tokens_per_s_per_chip", 0.0),
+                    "mfu": rates.get("mfu", 0.0)}
         if op == "adopt_version":
             # online-learning hot swap (PR 12): two-phase warm start
             # from the SERVER-configured publish root — the wire names

@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Static check: repo-root BENCH_r*.json artifacts parse under the
+perfwatch record schema.
+
+THIN WRAPPER over the unified static-analysis engine — the detection
+logic lives in paddle_tpu/analysis/rules/invariants.py (the
+``bench-schema`` rule; see docs/STATIC_ANALYSIS.md) and this entry
+point keeps the argv/stdout/exit-code contract of its sibling
+check_* scripts.
+
+A benchmark artifact that drifts off-schema is a silent hole in the
+perf-regression sentinel: ``perfwatch compare old.json new.json``
+skips metrics it cannot parse, so a regression in a malformed record
+ships unnoticed. docs/OBSERVABILITY.md (perf plane) documents the
+schema family; paddle_tpu/observability/perfwatch.py owns it.
+
+Usage: check_bench_schema.py [result.json ...]
+(default: every BENCH_r*.json at the repo root).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import REPO, load_invariants  # noqa: E402
+
+_inv = load_invariants()
+
+# re-exports for callers that import the script module directly
+BENCH_RESULT_RE = _inv.BENCH_RESULT_RE
+bench_result_paths = _inv.bench_result_paths
+
+
+def main(argv: list[str]) -> int:
+    return _inv.bench_schema_main(argv, REPO)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -297,7 +297,10 @@ ENGINE_STATS_KEYS = {
     "draining",
     # PR-12 online learning: published-version identity so loadgen can
     # slice SLO windows pre/post hot swap
-    "model_version"}
+    "model_version",
+    # PR-14 perf plane: live efficiency surface — a fleet scrape
+    # answers the MFU question without a profiler
+    "tokens_per_s_per_chip", "mfu"}
 POOL_STATS_KEYS = {
     "num_pages", "page_size", "free_pages", "used_pages", "occupancy",
     "alloc_count", "free_count", "alloc_failures"}
