@@ -1494,6 +1494,144 @@ def bench_checkpoint(state_mb=64, train_steps=150, save_every=50,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_elastic(train_steps=120, save_every=30, hidden=512, seed=0):
+    """BENCH_CONFIG=elastic (docs/ELASTIC.md): the economics of the
+    elastic-training substrate. Three numbers:
+
+    - cluster-checkpoint cadence overhead, async vs sync, A/B/A
+      wall-clock against a no-save baseline on a jitted train step
+      (bar: async <5% at the benched cadence, same as checkpoint);
+    - detect→resume wall time of a SIGKILL-mid-step gang restart
+      through the real launcher (kill at step 7, backoff 0.05s),
+      measured as the largest inter-record gap in the drill fixture's
+      per-step jsonl;
+    - loss-continuation delta of the resumed run vs a fault-free one
+      (bit-for-bit at the same world ⇒ 0.0)."""
+    import json as _json
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.cluster_ckpt import ClusterCheckpoint
+
+    rs = np.random.RandomState(seed)
+    p = jnp.asarray(rs.randn(hidden, hidden).astype(np.float32))
+    x = jnp.asarray(rs.randn(64, hidden).astype(np.float32))
+
+    @jax.jit
+    def step(p, x):
+        def loss(p):
+            h = jnp.tanh(x @ p)
+            h = jnp.tanh(h @ p)
+            return jnp.sum(h * h)
+        g = jax.grad(loss)(p)
+        return p - 1e-4 * g
+
+    def run(ck):
+        nonlocal p
+        _sync(step(p, x))  # warm
+        t0 = time.perf_counter()
+        for i in range(train_steps):
+            p = step(p, x)
+            if ck is not None:
+                ck.maybe_save(i, replicated={"p": p})
+        _sync(p)
+        if ck is not None:
+            ck.wait()
+        return (time.perf_counter() - t0) / train_steps
+
+    def cadenced(async_save):
+        root = tempfile.mkdtemp(prefix="elastic_bench_")
+        try:
+            return run(ClusterCheckpoint(
+                root, rank=0, world=1, every_steps=save_every,
+                async_save=async_save))
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+    base1 = run(None)
+    t_async = cadenced(True)
+    t_sync = cadenced(False)
+    base2 = run(None)
+    base = min(base1, base2)
+    async_pct = (t_async - base) / base * 100 if base > 0 else 0.0
+    sync_pct = (t_sync - base) / base * 100 if base > 0 else 0.0
+
+    # gang-restart drill through the real launcher (fixture arms a
+    # deterministic kill at step 7; resumed life recomputes from the
+    # committed step)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    fixture = os.path.join(repo, "tests", "fixtures",
+                           "elastic_trainer.py")
+
+    def free_port():
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def drill(extra_env, launcher_args):
+        work = tempfile.mkdtemp(prefix="elastic_drill_")
+        out, ckpt = os.path.join(work, "out"), os.path.join(work, "c")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   ELASTIC_DRILL_OUT=out,
+                   ELASTIC_DRILL_STEPS="12",
+                   ELASTIC_DRILL_SAVE_EVERY="2",
+                   ELASTIC_DRILL_STEP_SLEEP="0.02", **extra_env)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH",
+                                                        "")
+        env.pop("XLA_FLAGS", None)
+        res = subprocess.run(
+            [_sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", f"--started_port={free_port()}",
+             "--log_dir", os.path.join(work, "logs"),
+             f"--cluster_ckpt_dir={ckpt}"] + launcher_args + [fixture],
+            env=env, capture_output=True, text=True, timeout=300)
+        recs = []
+        for r in range(2):
+            path = os.path.join(out, f"loss_rank{r}.jsonl")
+            if os.path.exists(path):
+                with open(path) as f:
+                    recs += [_json.loads(ln) for ln in f]
+        curve = {}
+        for rec in sorted(recs, key=lambda r: r["t"]):
+            if rec["rank"] == 0:
+                curve[rec["step"]] = rec["loss"]
+        shutil.rmtree(work, ignore_errors=True)
+        return res.returncode, recs, curve
+
+    rc0, _, want = drill({}, [])
+    rc1, recs, got = drill(
+        {"ELASTIC_DRILL_KILL_RANK": "1", "ELASTIC_DRILL_KILL_AT": "7"},
+        ["--max_restarts=2", "--restart_backoff=0.05"])
+    ts = sorted(r["t"] for r in recs)
+    detect_resume_s = max(b - a for a, b in zip(ts, ts[1:])) \
+        if len(ts) > 1 else float("nan")
+    deltas = [abs(got[s] - want[s]) / max(abs(want[s]), 1e-12)
+              for s in want if s in got]
+    loss_delta = max(deltas) if deltas else float("nan")
+
+    return {"metric": "elastic_detect_resume_s",
+            "value": round(detect_resume_s, 3),
+            "unit": "s",
+            "drill_rc": [rc0, rc1],
+            "loss_continuation_max_rel_delta": loss_delta,
+            "async_save_overhead_pct": round(async_pct, 2),
+            "sync_save_overhead_pct": round(sync_pct, 2),
+            "async_overhead_bar_pct": 5.0,
+            "baseline_step_ms": round(base * 1e3, 4),
+            "async_step_ms": round(t_async * 1e3, 4),
+            "sync_step_ms": round(t_sync * 1e3, 4),
+            "save_every": save_every,
+            "train_steps": train_steps}
+
+
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
     predictor parity path): save -> load -> timed ZeroCopyRun.
@@ -1741,6 +1879,8 @@ def main():
         rec = bench_perfwatch_overhead()
     elif which == "checkpoint":
         rec = bench_checkpoint()
+    elif which == "elastic":
+        rec = bench_elastic()
     elif which == "gpt_1p3b":
         rec = bench_gpt_1p3b()
     elif which == "kernels":

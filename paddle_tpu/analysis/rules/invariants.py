@@ -33,8 +33,11 @@ PICKLE_MODULES = {"pickle", "cPickle", "_pickle", "dill"}
 
 # subtrees held to the data-only rule when scanning the shipped tree
 # (relative to paddle_tpu/): the transport package and every
-# checkpoint RESTORE path (docs/PS_WIRE_PROTOCOL.md, CHECKPOINT.md)
-WIRE_SUBTREES = ("distributed/", "checkpoint/")
+# checkpoint RESTORE path (docs/PS_WIRE_PROTOCOL.md, CHECKPOINT.md).
+# incubate/ joined when its CheckpointSaver moved onto the store: its
+# one legacy pickle read lives in fluid/io.legacy_pickle_load (a
+# position-exempt disk-archive shim, like fluid/io's own)
+WIRE_SUBTREES = ("distributed/", "checkpoint/", "incubate/")
 
 
 def _pickle_aliases(tree: ast.AST) -> set[str]:
@@ -103,7 +106,8 @@ def wire_main(argv: list[str], repo: str) -> int:
         roots = argv[1:]
     else:
         roots = [os.path.join(repo, "paddle_tpu", "distributed"),
-                 os.path.join(repo, "paddle_tpu", "checkpoint")]
+                 os.path.join(repo, "paddle_tpu", "checkpoint"),
+                 os.path.join(repo, "paddle_tpu", "incubate")]
     bad = []
     for root in roots:
         for dirpath, _dirs, files in os.walk(root):
@@ -256,6 +260,17 @@ REQUIRED_METRICS = {
     "paddle_tpu_perf_compile_seconds",
     "paddle_tpu_perf_hbm_bytes",
     "paddle_tpu_perf_kv_cache_bytes",
+    # elastic training (docs/ELASTIC.md): hang-vs-straggler split,
+    # restart/give-up accounting and resume latency are the gang-
+    # restart tier's acceptance contract — the chaos drills and the
+    # launcher's watchdog read these exact names
+    "paddle_tpu_elastic_heartbeats_total",
+    "paddle_tpu_elastic_stale_ranks",
+    "paddle_tpu_elastic_straggler_ranks",
+    "paddle_tpu_elastic_step_lag",
+    "paddle_tpu_elastic_restarts_total",
+    "paddle_tpu_elastic_crash_loop_giveups_total",
+    "paddle_tpu_elastic_resume_seconds",
 }
 
 

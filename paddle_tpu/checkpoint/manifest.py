@@ -158,11 +158,19 @@ def list_parts(root: str, step: int) -> list[tuple[int, str]]:
     return sorted(out)
 
 
-def _load_part(path: str) -> dict:
+def _load_part(path: str, expect_world: int | None = None) -> dict:
     with open(path, "rb") as f:
         doc = json.loads(f.read().decode("utf-8"))
     if doc.get("format") != FORMAT:
         raise ManifestError(f"{path}: not a {FORMAT} part")
+    if expect_world is not None \
+            and int(doc.get("world", -1)) != int(expect_world):
+        # a leftover part from a previous life at a DIFFERENT world
+        # size (elastic resize) must never merge into this version —
+        # its shard pieces were cut for the old partition
+        raise ManifestError(
+            f"{path}: part written for world {doc.get('world')}, "
+            f"merging world {expect_world}")
     payload = doc["payload"]
     crc = zlib.crc32(_canonical(payload)) & 0xFFFFFFFF
     if crc != int(doc.get("crc32", -1)):
@@ -188,7 +196,8 @@ def merge_parts(root: str, step: int, world: int,
     arrays: dict = {}
     merged_meta = {} if meta is None else dict(meta)
     for rank in range(int(world)):
-        payload = _load_part(parts[rank])   # raises on torn/corrupt
+        # raises on torn/corrupt/wrong-world
+        payload = _load_part(parts[rank], expect_world=world)
         if int(payload.get("step", -1)) != int(step):
             raise ManifestError(
                 f"{parts[rank]}: part claims step {payload.get('step')}"

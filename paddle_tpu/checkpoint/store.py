@@ -295,26 +295,69 @@ class CheckpointStore:
                        mode="merged", arrays=len(payload["arrays"]))
         return int(step)
 
+    def _merge_when_ready(self, step: int, world: int, meta,
+                          timeout: float) -> int:
+        """Poll for all ``world`` parts of ``step``, then merge-commit.
+        Unlike ``merge_parts`` this never calls ``wait()`` — it is the
+        writer-thread body of ``merge_parts_async`` (queue FIFO already
+        orders it after this store's own part write). A timeout leaves
+        the parts uncommitted: restore degrades to the previous
+        manifest bit-for-bit."""
+        deadline = time.monotonic() + max(float(timeout), 0.0)
+        while True:
+            present = len(_manifest.list_parts(self.root, step))
+            if present >= world:
+                break
+            if time.monotonic() >= deadline:
+                raise _manifest.ManifestError(
+                    f"merge step {step}: only {present}/{world} parts "
+                    f"after {timeout}s — previous manifest stays the "
+                    "restore target")
+            time.sleep(0.02)
+        with self._async_lock:
+            self._last_step = max(self._last_step, int(step))
+        payload = _manifest.merge_parts(self.root, step, world,
+                                        meta=meta)
+        self._retention_gc()
+        _SAVES.labels(mode="merged").inc()
+        _flight.record("ckpt", "manifest_commit", step=int(step),
+                       mode="merged", arrays=len(payload["arrays"]))
+        return int(step)
+
     def _writer_loop(self, q):
         while True:
             item = q.get()
             if item is None:
                 q.task_done()
                 return
-            host, step, meta, nbytes = item
+            kind, step, nbytes = item["kind"], item["step"], \
+                item["nbytes"]
             self._save_started = time.monotonic()
             _flight.record("ckpt", "write_start", step=step,
-                           bytes=nbytes, queued=q.qsize())
+                           bytes=nbytes, queued=q.qsize(), kind=kind)
             try:
-                self._write_state(host, step, meta, "async")
+                if kind == "full":
+                    self._write_state(item["host"], step, item["meta"],
+                                      "async")
+                elif kind == "part":
+                    self.save_part(item["host"], step, item["rank"],
+                                   item["world"], meta=item["meta"])
+                elif kind == "merge":
+                    self._merge_when_ready(step, item["world"],
+                                           item["meta"],
+                                           item["timeout"])
+                else:  # pragma: no cover - enqueue sites are in-file
+                    raise ValueError(f"unknown writer item {kind!r}")
             except BaseException as e:  # surfaced on wait()/next save
                 with self._async_lock:
                     self._async_error = e
                 _flight.record("ckpt", "write_error", step=step,
+                               kind=kind,
                                error=f"{type(e).__name__}: {e}")
             else:
                 _flight.record(
                     "ckpt", "write_done", step=step, bytes=nbytes,
+                    kind=kind,
                     seconds=round(
                         time.monotonic() - self._save_started, 6))
             finally:
@@ -323,14 +366,9 @@ class CheckpointStore:
                     self._pending_bytes -= nbytes
                 q.task_done()
 
-    def save_async(self, state: dict, step: int | None = None,
-                   meta=None) -> int:
-        """Non-blocking save: host copies are taken NOW (so the caller
-        may keep mutating/donating its arrays); chunk+manifest IO runs
-        on a persistent background writer. Blocks only when TWO saves
-        are already pending (backpressure — bounded host-copy memory).
-        Returns the step that WILL commit; ``wait()`` (or the next
-        save) surfaces writer errors."""
+    def _ensure_writer(self):
+        """Start (once) the persistent background writer; re-raise any
+        error the previous async item left behind."""
         with self._async_lock:
             err, self._async_error = self._async_error, None
             if self._queue is None:
@@ -347,7 +385,8 @@ class CheckpointStore:
                 weakref.finalize(self, _stop_writer, self._queue)
         if err is not None:
             raise err
-        step = self._resolve_step(step)
+
+    def _host_copy(self, state: dict) -> tuple[dict, int]:
         host = {}
         for name, val in state.items():
             if isinstance(val, ShardedArray):
@@ -357,13 +396,59 @@ class CheckpointStore:
                     [np.array(p, copy=True) for p in val.pieces])
             else:
                 host[name] = np.array(_host_array(val), copy=True)
-        nbytes = int(sum(v.nbytes for v in host.values()))
+        return host, int(sum(v.nbytes for v in host.values()))
+
+    def _enqueue(self, item: dict):
         with self._async_lock:
-            self._pending_bytes += nbytes
-        _flight.record("ckpt", "enqueue", step=step, bytes=nbytes,
+            self._pending_bytes += item["nbytes"]
+        _flight.record("ckpt", "enqueue", step=item["step"],
+                       bytes=item["nbytes"], kind=item["kind"],
                        queued=self._queue.qsize())
-        self._queue.put((host, step, meta, nbytes))
+        self._queue.put(item)
+
+    def save_async(self, state: dict, step: int | None = None,
+                   meta=None) -> int:
+        """Non-blocking save: host copies are taken NOW (so the caller
+        may keep mutating/donating its arrays); chunk+manifest IO runs
+        on a persistent background writer. Blocks only when TWO saves
+        are already pending (backpressure — bounded host-copy memory).
+        Returns the step that WILL commit; ``wait()`` (or the next
+        save) surfaces writer errors."""
+        self._ensure_writer()
+        step = self._resolve_step(step)
+        host, nbytes = self._host_copy(state)
+        self._enqueue({"kind": "full", "host": host, "step": step,
+                       "meta": meta, "nbytes": nbytes})
         return step
+
+    def save_part_async(self, state: dict, step: int, rank: int,
+                        world: int, meta=None) -> int:
+        """``save_part`` off the step path: host copies now, partial
+        manifest published by the background writer. Same backpressure
+        and error-surfacing contract as ``save_async``. Nothing
+        becomes restorable until rank 0 merges."""
+        self._ensure_writer()
+        with self._async_lock:
+            self._last_step = max(self._last_step, int(step))
+        host, nbytes = self._host_copy(state)
+        self._enqueue({"kind": "part", "host": host, "step": int(step),
+                       "rank": int(rank), "world": int(world),
+                       "meta": meta, "nbytes": nbytes})
+        return int(step)
+
+    def merge_parts_async(self, step: int, world: int, meta=None,
+                          timeout: float = 60.0) -> int:
+        """Rank 0's asynchronous commit of a multi-process save: the
+        background writer waits (up to ``timeout`` seconds) for all
+        ``world`` parts of ``step`` then merge-commits. Queue FIFO
+        guarantees this rank's own part lands first. On timeout the
+        ManifestError surfaces on ``wait()``/next save and the
+        PREVIOUS manifest remains the restore target bit-for-bit."""
+        self._ensure_writer()
+        self._enqueue({"kind": "merge", "step": int(step),
+                       "world": int(world), "meta": meta,
+                       "timeout": float(timeout), "nbytes": 0})
+        return int(step)
 
     def wait(self):
         """Drain pending async saves and re-raise any writer error."""
@@ -448,6 +533,34 @@ class CheckpointStore:
             -> np.ndarray:
         payload = self.latest_manifest(step)
         return self._assemble(payload["arrays"][name])
+
+    def materialize(self, ent: dict) -> np.ndarray:
+        """Assemble one manifest ``arrays`` entry (as returned by
+        ``latest_manifest``) into an ndarray — the entry-level restore
+        primitive for layers that walk a manifest once and read many
+        arrays (cluster_ckpt's resize path)."""
+        return self._assemble(ent)
+
+    def read_rows(self, ent: dict, row_lo: int, row_hi: int) \
+            -> np.ndarray:
+        """Axis-0 rows [row_lo, row_hi) of one manifest entry, reading
+        only the chunks overlapping that byte span. Scalars cannot be
+        row-addressed."""
+        shape = tuple(ent["shape"])
+        if not shape:
+            raise ValueError("read_rows: scalar entries have no rows")
+        dtype = np.dtype(ent["dtype"])
+        row_bytes = dtype.itemsize * int(np.prod(shape[1:],
+                                                 dtype=np.int64))
+        if not 0 <= row_lo <= row_hi <= shape[0]:
+            raise ValueError(
+                f"read_rows: [{row_lo},{row_hi}) outside [0,{shape[0]}]")
+        if row_lo == row_hi:
+            return np.empty((0,) + shape[1:], dtype=dtype)
+        blob = self._read_range(ent, row_lo * row_bytes,
+                                row_hi * row_bytes)
+        return np.frombuffer(blob, dtype=dtype) \
+            .reshape((row_hi - row_lo,) + shape[1:]).copy()
 
     def restore_shard(self, name: str, shard: int, num_shards: int,
                       step: int | None = None) -> np.ndarray:

@@ -19,6 +19,10 @@ frame when nothing is configured):
   PADDLE_PS_FAULT_KILL_AFTER_BYTES=N  checkpoint writer: os._exit once
                                 N payload bytes have been written
                                 (kill-mid-save crash tests)
+  PADDLE_PS_FAULT_KILL_AT_STEP=N  trainer: os._exit at the START of
+                                training step N (elastic.note_step is
+                                the hook) — the deterministic SIGKILL
+                                for gang-restart chaos drills
   PADDLE_PS_FAULT_KILL_POINT=recv|reply   kill before dispatch (request
                                 lost) or after commit-before-reply (the
                                 hard exactly-once case); default reply
@@ -28,12 +32,16 @@ frame when nothing is configured):
                                 catch; the in-flight op pins the tier
                                 non-idle while its progress counter
                                 freezes)
-  PADDLE_PS_FAULT_STALL_POINT=dispatch|serving_decode   where to stall:
-                                the PS server's dispatch path, or the
-                                serving engine's decode step (the step
-                                thread wedges INSIDE its step lock —
-                                the chaos-drill fault for the serving
-                                tier, docs/DEBUGGING.md)
+  PADDLE_PS_FAULT_STALL_POINT=dispatch|serving_decode|trainer_step
+                                where to stall: the PS server's
+                                dispatch path, the serving engine's
+                                decode step (the step thread wedges
+                                INSIDE its step lock — the chaos-drill
+                                fault for the serving tier,
+                                docs/DEBUGGING.md), or the trainer's
+                                per-step elastic.note_step hook (hung
+                                rank drills — step counter freezes
+                                while the heartbeat keeps beating)
   PADDLE_PS_FAULT_SIDE=client|server|both   which transport end injects
                                 (default both — set it when client and
                                 server share one process env)
@@ -85,7 +93,8 @@ KNOWN_FAULT_KNOBS = frozenset({
     "PADDLE_PS_FAULT_DROP", "PADDLE_PS_FAULT_DELAY",
     "PADDLE_PS_FAULT_TRUNCATE", "PADDLE_PS_FAULT_CORRUPT",
     "PADDLE_PS_FAULT_KILL_AFTER", "PADDLE_PS_FAULT_KILL_POINT",
-    "PADDLE_PS_FAULT_KILL_AFTER_BYTES", "PADDLE_PS_FAULT_STALL",
+    "PADDLE_PS_FAULT_KILL_AFTER_BYTES",
+    "PADDLE_PS_FAULT_KILL_AT_STEP", "PADDLE_PS_FAULT_STALL",
     "PADDLE_PS_FAULT_STALL_POINT", "PADDLE_PS_FAULT_SIDE",
     "PADDLE_PS_FAULT_SEED", "PADDLE_PS_FAULT_FRAME_ACTION",
     "PADDLE_PS_FAULT_FRAME_REQ", "PADDLE_PS_FAULT_FRAME_DELAY",
@@ -100,7 +109,8 @@ class FaultInjector:
     def __init__(self, drop: float = 0.0, delay: float = 0.0,
                  truncate: float = 0.0, corrupt: float = 0.0,
                  kill_after: int = 0, kill_point: str = "reply",
-                 kill_after_bytes: int = 0, stall: float = 0.0,
+                 kill_after_bytes: int = 0, kill_at_step: int = -1,
+                 stall: float = 0.0,
                  stall_point: str = "dispatch",
                  side: str = "both", seed: int = 0,
                  frame_action: str = "", frame_req: str = "",
@@ -112,6 +122,7 @@ class FaultInjector:
         self.kill_after = kill_after
         self.kill_point = kill_point
         self.kill_after_bytes = kill_after_bytes
+        self.kill_at_step = kill_at_step
         self.stall = stall
         self.stall_point = stall_point
         self.side = side
@@ -148,6 +159,8 @@ class FaultInjector:
             kill_point=e("PADDLE_PS_FAULT_KILL_POINT", "reply"),
             kill_after_bytes=int(
                 e("PADDLE_PS_FAULT_KILL_AFTER_BYTES", "0") or 0),
+            kill_at_step=int(
+                e("PADDLE_PS_FAULT_KILL_AT_STEP", "-1") or -1),
             stall=float(e("PADDLE_PS_FAULT_STALL", "0") or 0),
             stall_point=e("PADDLE_PS_FAULT_STALL_POINT", "dispatch"),
             side=e("PADDLE_PS_FAULT_SIDE", "both"),
@@ -161,8 +174,8 @@ class FaultInjector:
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.truncate
                     or self.corrupt or self.kill_after
-                    or self.kill_after_bytes or self.stall
-                    or self.frame_action)
+                    or self.kill_after_bytes or self.kill_at_step >= 0
+                    or self.stall or self.frame_action)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
@@ -273,6 +286,16 @@ class FaultInjector:
             with self._lock:
                 self.counters["stalled"] += 1
             time.sleep(self.stall)
+
+    # -- trainer kill switch (gang-restart chaos drills) ------------------
+    def maybe_kill_at_step(self, step: int):
+        """Dies (os._exit, no cleanup — a SIGKILL stand-in) at the
+        START of training step ``kill_at_step``: state reflects the
+        previous step, the coordinated checkpoint of it may be
+        mid-flight — exactly the crash the gang-restart resume drill
+        must survive. elastic.note_step calls this every step."""
+        if self.kill_at_step >= 0 and int(step) >= self.kill_at_step:
+            os._exit(KILL_EXIT_CODE)
 
     # -- writer kill switch (checkpoint crash tests) ---------------------
     def maybe_kill_bytes(self, n: int):

@@ -60,6 +60,47 @@ def _parse(argv):
                    help="elastic: seconds without a heartbeat before a "
                         "rank counts as hung (ranks opt in via "
                         "distributed.elastic.start_heartbeat)")
+    p.add_argument("--step_deadline", type=float, default=0.0,
+                   help="elastic: seconds a rank's heartbeat STEP "
+                        "counter may freeze (while still beating) "
+                        "before it counts as hung — catches wedged "
+                        "collectives a live heartbeat thread hides. "
+                        "0 disables; ranks report steps via "
+                        "distributed.elastic.note_step")
+    p.add_argument("--straggler_lag", type=int, default=10,
+                   help="elastic: steps behind the fastest rank before "
+                        "a slow-but-progressing rank is flagged "
+                        "(paddle_tpu_elastic_straggler_ranks metric + "
+                        "flight event). Stragglers are NEVER killed")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="elastic: base seconds of exponential backoff "
+                        "between whole-job restarts (doubles per "
+                        "restart, capped by --restart_backoff_max; "
+                        "0 restarts immediately)")
+    p.add_argument("--restart_backoff_max", type=float, default=30.0)
+    p.add_argument("--crash_loop_window", type=float, default=60.0,
+                   help="elastic: sliding window (seconds) for crash-"
+                        "loop detection")
+    p.add_argument("--crash_loop_threshold", type=int, default=0,
+                   help="elastic: give up once this many job failures "
+                        "land inside --crash_loop_window even with "
+                        "restart budget left, and write a debug "
+                        "bundle naming the flapping rank (0 disables)")
+    p.add_argument("--exclude_flapping", action="store_true",
+                   help="elastic: after a trainer rank fails "
+                        "--flap_threshold times, respawn the job at "
+                        "world W-1 WITHOUT it (ranks renumber; "
+                        "children resume via the cluster-checkpoint "
+                        "resize path, docs/ELASTIC.md)")
+    p.add_argument("--flap_threshold", type=int, default=2,
+                   help="elastic: failures by one rank before "
+                        "--exclude_flapping drops it")
+    p.add_argument("--cluster_ckpt_dir", type=str, default=None,
+                   help="elastic: set PADDLE_TPU_CLUSTER_CKPT_DIR for "
+                        "every child — the coordinated cluster-"
+                        "checkpoint store (distributed/cluster_ckpt) "
+                        "restarts resume from. NEVER cleared between "
+                        "restarts (it IS the cross-life state)")
     p.add_argument("--ps_snapshot_dir", type=str, default=None,
                    help="PS mode: server snapshot directory "
                         "(PADDLE_PS_SNAPSHOT_DIR for the children); "
@@ -164,19 +205,26 @@ def _spawn_children(specs, log_dir):
             for name, env_over, argv in specs]
 
 
-def _watch(procs, manager=None, specs=None, log_dir=None):
+def _watch(procs, manager=None, specs=None, log_dir=None,
+           rank_names=None):
     """Poll children; on failure or a hung heartbeat kill the rest
     (reference launch.py:214 watch + terminate_local_trainers). Returns
-    (rc, needs_restart): the elastic loop in `launch` respawns when the
-    manager still has restarts left.
+    (rc, needs_restart, offender, reason): the elastic loop in
+    `launch` respawns when the manager still has restarts left;
+    `offender` is the child name that triggered the teardown (crash or
+    first hung rank, None otherwise) and `reason` is "crash" | "hang".
 
     Graceful degradation: when `specs` carries a respawnable child —
     a `server.*` PS shard (restores from its snapshot) or a
     `replica.*` serving replica (rebuilds from its engine checkpoint;
     the router fails its in-flight work over meanwhile) — and the
     manager still has single-child restart budget, ONLY that child is
-    respawned instead of the whole job."""
+    respawned instead of the whole job. Step-lag stragglers are
+    reported once (stderr + the manager's metrics/flight event), never
+    killed."""
     specs = specs or {}
+    rank_names = rank_names or {}
+    slow_reported: set = set()
     try:
         while True:
             alive = False
@@ -214,9 +262,9 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
                         f"[launch] {name} exited with code {rc}; "
                         f"terminating the job\n")
                     _kill_all(procs)
-                    return rc, True
+                    return rc, True, name, "crash"
             if not alive:
-                return 0, False
+                return 0, False, None, None
             # PS mode: servers run forever — the job is DONE when every
             # worker/trainer child finished cleanly (reference fleetrun
             # tears servers down once trainers exit)
@@ -232,7 +280,7 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
                     "[launch] all workers finished; stopping daemon "
                     "children (PS servers / telemetry)\n")
                 _kill_all(procs)
-                return 0, False
+                return 0, False, None, None
             if manager is not None:
                 hung = manager.hung_ranks()
                 if hung:
@@ -241,11 +289,21 @@ def _watch(procs, manager=None, specs=None, log_dir=None):
                         f">{manager.heartbeat_timeout}s; terminating the "
                         f"job\n")
                     _kill_all(procs)
-                    return 1, True
+                    return 1, True, \
+                        rank_names.get(hung[0], f"rank{hung[0]}"), \
+                        "hang"
+                for r in manager.stragglers():
+                    if r not in slow_reported:
+                        slow_reported.add(r)
+                        sys.stderr.write(
+                            f"[launch] rank {r} lags "
+                            f">{manager.straggler_lag} steps behind "
+                            f"the fastest rank (straggler — flagged, "
+                            f"not killed)\n")
             time.sleep(0.2)
     except KeyboardInterrupt:
         _kill_all(procs)
-        return 1, False
+        return 1, False, None, None
     finally:
         for _, _, fh in procs:
             if fh:
@@ -330,6 +388,10 @@ def launch(argv=None):
         os.makedirs(args.debug_dir, exist_ok=True)
         for _name, env, _argv in specs:
             env["PADDLE_TPU_DEBUG_DIR"] = args.debug_dir
+    if args.cluster_ckpt_dir:
+        os.makedirs(args.cluster_ckpt_dir, exist_ok=True)
+        for _name, env, _argv in specs:
+            env["PADDLE_TPU_CLUSTER_CKPT_DIR"] = args.cluster_ckpt_dir
     if args.publish_dir:
         # online learning: servers PUBLISH through this store, serving
         # replicas ADOPT from it (workers/trainers don't need it)
@@ -390,9 +452,13 @@ def launch(argv=None):
         heartbeat_dir=hb_dir,
         # the telemetry collector never writes heartbeat files — it
         # must not count toward the expected rank set
-        world_size=sum(1 for n, _, _ in specs if n != "telemetry")) \
+        world_size=sum(1 for n, _, _ in specs if n != "telemetry"),
+        step_deadline=args.step_deadline,
+        straggler_lag=args.straggler_lag) \
         if args.max_restarts > 0 else None
 
+    fail_times: list[float] = []     # monotonic stamps of job failures
+    offender_counts: dict[str, int] = {}
     while True:
         if hb_dir:  # fresh heartbeat epoch per attempt
             for f in os.listdir(hb_dir):
@@ -403,22 +469,142 @@ def launch(argv=None):
             # from a stale snapshot would double-apply every first-life
             # push — servers must start fresh too. (Single-server
             # respawn inside _watch intentionally KEEPS the snapshot:
-            # there the workers' in-flight state continues.)
+            # there the workers' in-flight state continues. The
+            # cluster-checkpoint dir is likewise never cleared — it is
+            # the state restarts resume from.)
             for f in os.listdir(snap_dir):
                 os.unlink(os.path.join(snap_dir, f))
         procs = _spawn_children(specs, args.log_dir)
         # forward SIGTERM to the job
         signal.signal(signal.SIGTERM, lambda *a: (_kill_all(procs),
                                                   sys.exit(143)))
-        rc, needs_restart = _watch(procs, manager, specs=server_specs,
-                                   log_dir=args.log_dir)
-        if rc == 0 or manager is None or not needs_restart \
-                or not manager.should_restart():
+        rc, needs_restart, offender, reason = _watch(
+            procs, manager, specs=server_specs, log_dir=args.log_dir,
+            rank_names=_heartbeat_rank_names(specs))
+        if rc == 0 or manager is None or not needs_restart:
             return rc
-        manager.record_restart()
+        if offender is not None:
+            offender_counts[offender] = \
+                offender_counts.get(offender, 0) + 1
+        now = time.monotonic()
+        fail_times.append(now)
+        recent = [t for t in fail_times
+                  if now - t <= args.crash_loop_window]
+        flapping = max(offender_counts, key=offender_counts.get) \
+            if offender_counts else None
+        if args.crash_loop_threshold \
+                and len(recent) >= args.crash_loop_threshold:
+            # crash loop: restarting is burning the budget without
+            # progress — stop, leave a postmortem naming the repeat
+            # offender
+            sys.stderr.write(
+                f"[launch] crash loop: {len(recent)} failures within "
+                f"{args.crash_loop_window:g}s (flapping: {flapping}); "
+                f"giving up\n")
+            manager.record_giveup("crash_loop", flapping)
+            _write_giveup_bundle(args, "crash_loop", flapping,
+                                 offender_counts, manager, rc)
+            return rc or 1
+        if not manager.should_restart():
+            manager.record_giveup("restarts_exhausted", flapping)
+            _write_giveup_bundle(args, "restarts_exhausted", flapping,
+                                 offender_counts, manager, rc)
+            return rc
+        manager.record_restart(reason or "crash")
         sys.stderr.write(
             f"[launch] elastic restart "
             f"{manager.restart_count}/{manager.max_restarts}\n")
+        if args.exclude_flapping and offender is not None \
+                and offender_counts.get(offender, 0) \
+                >= args.flap_threshold:
+            shrunk = _drop_trainer_rank(specs, offender)
+            if shrunk is not None:
+                specs = shrunk
+                manager.world_size = sum(
+                    1 for n, _, _ in specs if n != "telemetry")
+                # identities renumbered — restart the flap accounting
+                offender_counts.clear()
+                sys.stderr.write(
+                    f"[launch] excluding flapping rank {offender} "
+                    f"(failed {args.flap_threshold}+ times); "
+                    f"respawning at world {manager.world_size} — "
+                    f"children resume via the cluster-checkpoint "
+                    f"resize path\n")
+        delay = 0.0
+        if args.restart_backoff > 0:
+            delay = min(
+                args.restart_backoff * 2 ** (manager.restart_count - 1),
+                args.restart_backoff_max)
+            sys.stderr.write(
+                f"[launch] backing off {delay:.1f}s before restart\n")
+            time.sleep(delay)
+        manager.reset_epoch()
+
+
+def _heartbeat_rank_names(specs):
+    """Heartbeat rank → child name (ranks come from the child's
+    PADDLE_TRAINER_ID, which is what start_heartbeat writes)."""
+    names = {}
+    for name, env, _argv in specs:
+        if name == "telemetry":
+            continue
+        try:
+            names[int(env.get("PADDLE_TRAINER_ID", "-1"))] = name
+        except ValueError:
+            pass
+    return names
+
+
+def _drop_trainer_rank(specs, offender):
+    """Rebuild collective-trainer specs at world W-1 without
+    ``offender`` (a ``trainer.N`` child name): survivors renumber to
+    ranks 0..W-2 and the endpoint list shrinks, so the respawned gang
+    forms a valid smaller collective and resumes through
+    cluster_ckpt's resize restore. Returns None when not applicable
+    (PS/serving modes, unknown name, or nothing would survive)."""
+    if not offender.startswith("trainer."):
+        return None
+    trainers = [(n, e, a) for n, e, a in specs
+                if n.startswith("trainer.")]
+    others = [s for s in specs if not s[0].startswith("trainer.")]
+    keep = sorted((t for t in trainers if t[0] != offender),
+                  key=lambda t: int(t[0].split(".", 1)[1]))
+    if not keep or len(keep) == len(trainers):
+        return None
+    endpoints = [t[1]["PADDLE_CURRENT_ENDPOINT"] for t in keep]
+    new = []
+    for new_rank, (_old, env, argv) in enumerate(keep):
+        env = dict(env)
+        env.update({
+            "PADDLE_TRAINER_ID": str(new_rank),
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_COORDINATOR": endpoints[0],
+        })
+        new.append((f"trainer.{new_rank}", env, argv))
+    return new + others
+
+
+def _write_giveup_bundle(args, reason, flapping, offender_counts,
+                         manager, rc):
+    """Postmortem for an abandoned job: a PR-5 debug bundle whose
+    manifest reason names the flapping rank (best-effort — only when
+    a debug dir is configured)."""
+    dir_ = args.debug_dir or os.environ.get("PADDLE_TPU_DEBUG_DIR")
+    if not dir_:
+        return
+    try:
+        from ..observability import debug as _debug
+        tag = f"{reason}:{flapping}" if flapping else reason
+        path = _debug.write_bundle(
+            dir_, reason=tag,
+            extra={"flapping": flapping,
+                   "offender_counts": dict(offender_counts),
+                   "restarts": manager.restart_count,
+                   "exit_code": rc})
+        sys.stderr.write(f"[launch] wrote debug bundle {path}\n")
+    except Exception as e:  # never mask the real exit path
+        sys.stderr.write(f"[launch] debug bundle failed: {e}\n")
 
 
 def main():

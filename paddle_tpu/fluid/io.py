@@ -67,6 +67,26 @@ def _prefer_store(root: str, legacy_path: str) -> bool:
     return store_mtime >= os.path.getmtime(legacy_path)
 
 
+def _save_legacy_pickle(obj, path: str):
+    """Write one legacy pickle archive (the PADDLE_TPU_CKPT=off format;
+    incubate's CheckpointSaver routes here to stay import-free of
+    pickle itself)."""
+    with open(path, "wb") as f:
+        pickle.dump(obj, f, protocol=4)
+
+
+def legacy_pickle_load(path: str):
+    """Read one LEGACY on-disk pickle archive (pre-store formats:
+    .pdparams blobs, incubate ckpt-N/params.pkl). Deliberately the
+    only pickle-deserialization entry point outside this module's own
+    loaders: the wire/checkpoint trees (distributed/, checkpoint/,
+    incubate/) are pickle-free by static check, and their legacy
+    back-compat reads route HERE — a local disk archive the operator
+    placed, never wire input."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
 def _load_blob(path: str) -> dict:
     """Auto-detecting load: the newest of {committed store dir, legacy
     archive}; else a clear FileNotFoundError (not a bare KeyError)."""

@@ -236,6 +236,9 @@ class Model:
             loader = list(loader)
         cblist = CallbackList(cbs, model=self)
         self.stop_training = False
+        from ..distributed import elastic
+        elastic.start_heartbeat()  # no-op unless the launcher asked
+        global_step = 0
         cblist.on_train_begin()
         logs = {}
         for epoch in range(epochs):
@@ -245,6 +248,10 @@ class Model:
             n_batches = 0
             for step, batch in enumerate(loader):
                 ins, labs = self._split_batch(batch)
+                # per-step progress for the elastic watchdog (hang vs
+                # slow) + the deterministic trainer fault hooks
+                elastic.note_step(global_step)
+                global_step += 1
                 cblist.on_train_batch_begin(step)
                 logs = self.train_batch(ins, labs)
                 cblist.on_train_batch_end(step, logs)

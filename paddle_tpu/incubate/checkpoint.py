@@ -1,14 +1,24 @@
 """Auto-checkpoint for job recovery (reference
 fluid/incubate/checkpoint/auto_checkpoint.py:71,265 + checkpoint_saver.py).
 
-TPU-native: snapshot = all persistables of the program (+ epoch cursor) saved
-atomically; `TrainEpochRange` wraps the epoch loop and resumes after restart.
+TPU-native: snapshot = all persistables of the program (+ epoch cursor)
+saved atomically; `TrainEpochRange` wraps the epoch loop and resumes
+after restart.
+
+Storage routing (same contract fluid/io got): with ``PADDLE_TPU_CKPT``
+set, saves go through the content-addressed checkpoint store (one
+``store.ckpt`` directory under the checkpoint dir — CRC'd manifests,
+atomic commit, chunk dedup across epochs, pickle-free restore,
+docs/CHECKPOINT.md) with the epoch number as the store step. Loads
+AUTO-DETECT the format: when both a store version and a legacy
+``ckpt-N`` pickle directory exist for the chosen epoch, the newer save
+wins; legacy directories stay readable regardless of the knob (their
+one pickle read routes through ``fluid.io.legacy_pickle_load``).
 """
 from __future__ import annotations
 
 import json
 import os
-import pickle
 import tempfile
 
 
@@ -21,20 +31,34 @@ class CheckpointSaver:
         self.max_keep = max_keep
         os.makedirs(directory, exist_ok=True)
 
+    @property
+    def _store_root(self) -> str:
+        return os.path.join(self.dir, "store.ckpt")
+
+    def _store(self):
+        from ..checkpoint import CheckpointStore
+        return CheckpointStore(self._store_root, keep=self.max_keep)
+
     def _ckpt_path(self, no: int) -> str:
         return os.path.join(self.dir, f"ckpt-{no}")
 
     def save_checkpoint(self, program, epoch_no: int, extra: dict | None = None):
         from ..fluid import core
         from ..fluid.executor import global_scope
+        from .. import checkpoint as ckpt
         scope = global_scope()
         blob = core.batched_to_numpy_dict(
             [(v.name, val) for v in program.list_vars() if v.persistable
              and (val := scope.find_var(v.name)) is not None])
+        if ckpt.enabled():
+            self._store().save(blob, step=epoch_no,
+                               meta={"epoch_no": int(epoch_no),
+                                     "extra": extra or {}})
+            return
         path = self._ckpt_path(epoch_no)
         tmp = tempfile.mkdtemp(dir=self.dir)
-        with open(os.path.join(tmp, "params.pkl"), "wb") as f:
-            pickle.dump(blob, f, protocol=4)
+        from ..fluid.io import _save_legacy_pickle
+        _save_legacy_pickle(blob, os.path.join(tmp, "params.pkl"))
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"epoch_no": epoch_no, "extra": extra or {}}, f)
         if os.path.exists(path):
@@ -44,16 +68,40 @@ class CheckpointSaver:
         self._gc(epoch_no)
 
     def _gc(self, latest: int):
-        kept = sorted(self.list_checkpoints())
+        kept = sorted(self._legacy_checkpoints())
         for no in kept[:-self.max_keep]:
             import shutil
             shutil.rmtree(self._ckpt_path(no), ignore_errors=True)
 
-    def list_checkpoints(self) -> list[int]:
+    def _legacy_checkpoints(self) -> list[int]:
         if not os.path.isdir(self.dir):
             return []
         return [int(d.split("-")[1]) for d in os.listdir(self.dir)
                 if d.startswith("ckpt-")]
+
+    def _store_steps(self) -> list[int]:
+        from ..checkpoint import list_manifests
+        return [s for s, _p in list_manifests(self._store_root)]
+
+    def list_checkpoints(self) -> list[int]:
+        """Epoch numbers restorable from EITHER format."""
+        return sorted(set(self._legacy_checkpoints())
+                      | set(self._store_steps()))
+
+    def _prefer_store(self, no: int, in_store: bool,
+                      in_legacy: bool) -> bool:
+        """Both formats hold this epoch only when a job toggled
+        PADDLE_TPU_CKPT between saves — the NEWER save wins (loading
+        the stale one silently resumes old parameters)."""
+        if not in_store:
+            return False
+        if not in_legacy:
+            return True
+        from ..checkpoint import list_manifests
+        store_mtime = max(os.path.getmtime(p)
+                          for s, p in list_manifests(self._store_root)
+                          if s == no)
+        return store_mtime >= os.path.getmtime(self._ckpt_path(no))
 
     def load_checkpoint(self, program, epoch_no: int | None = None) -> int:
         import jax.numpy as jnp
@@ -62,9 +110,14 @@ class CheckpointSaver:
         if not ckpts:
             return -1
         no = epoch_no if epoch_no is not None else max(ckpts)
-        path = self._ckpt_path(no)
-        with open(os.path.join(path, "params.pkl"), "rb") as f:
-            blob = pickle.load(f)
+        store_steps = self._store_steps()
+        if self._prefer_store(no, no in store_steps,
+                              no in self._legacy_checkpoints()):
+            blob, _meta = self._store().restore(step=no)
+        else:
+            from ..fluid.io import legacy_pickle_load
+            blob = legacy_pickle_load(
+                os.path.join(self._ckpt_path(no), "params.pkl"))
         scope = global_scope()
         for name, arr in blob.items():
             scope.set(name, jnp.asarray(arr))
@@ -87,11 +140,14 @@ class TrainEpochRange:
 
     def __iter__(self):
         from ..fluid.framework import default_main_program
-        from ..distributed.elastic import start_heartbeat
-        start_heartbeat()  # no-op unless the elastic launcher asked
+        from ..distributed import elastic
+        elastic.start_heartbeat()  # no-op unless the launcher asked
         program = self.program or default_main_program()
         start = self.saver.load_checkpoint(program) + 1
         for epoch in range(start, self.max_epoch_num):
+            # epoch progress feeds the heartbeat's step counter (hang
+            # vs slow) and the deterministic fault hooks
+            elastic.note_step(epoch)
             yield epoch
             if epoch % self.inter == 0:
                 self.saver.save_checkpoint(program, epoch)

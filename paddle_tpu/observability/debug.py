@@ -172,7 +172,7 @@ def _bundle_files(bundle: dict) -> dict[str, bytes]:
     def j(obj) -> bytes:
         return json.dumps(obj, indent=1, sort_keys=True).encode("utf-8")
 
-    return {
+    files = {
         "metrics.prom": bundle["metrics_text"].encode("utf-8"),
         "metrics.json": j(bundle["metrics"]),
         "trace.json": j(bundle["trace"]),
@@ -180,6 +180,11 @@ def _bundle_files(bundle: dict) -> dict[str, bytes]:
         "env.json": j(bundle["env"]),
         "requests.json": j(bundle["requests"]),
     }
+    if bundle.get("extra") is not None:
+        # caller-supplied context (e.g. the launcher's crash-loop
+        # postmortem naming the flapping rank) must survive to disk
+        files["extra.json"] = j(bundle["extra"])
+    return files
 
 
 def write_bundle(dir_: str | None = None, reason: str = "manual",

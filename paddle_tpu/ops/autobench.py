@@ -363,17 +363,21 @@ def prefer(key, candidates: dict[str, Callable], make_args: Callable,
     for name, fn in candidates.items():
         try:
             timings[name] = _measure(fn, make_args, reps)
-            # fused-block ops join the perf-plane cost registry on the
-            # same once-per-key measuring path (roofline rows per
-            # candidate; lowering is abstract and never raises)
-            if _perf.costs_enabled():
+        except Exception:  # a candidate that errors never wins
+            timings[name] = float("inf")
+            continue
+        # fused-block ops join the perf-plane cost registry on the same
+        # once-per-key measuring path (roofline rows per candidate); a
+        # failed cost observation must not void a successful timing
+        if _perf.costs_enabled():
+            try:
                 import jax
                 if cost_args is None:
                     cost_args = make_args()
                 _perf.register_jit_cost(f"ops:{name}", str(key),
                                         jax.jit(fn), *cost_args)
-        except Exception:  # a candidate that errors never wins
-            timings[name] = float("inf")
+            except Exception:
+                pass
     winner = min(timings, key=timings.get)
     if not (timings[winner] < float("inf")):
         winner = default

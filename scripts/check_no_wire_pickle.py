@@ -1,6 +1,9 @@
 #!/usr/bin/env python
 """Static check: no pickle deserialization anywhere under
-paddle_tpu/distributed/ or paddle_tpu/checkpoint/.
+paddle_tpu/distributed/, paddle_tpu/checkpoint/ or
+paddle_tpu/incubate/ (the auto-checkpoint restore path joined the
+rule when CheckpointSaver moved onto the store; its legacy-format
+read goes through fluid/io.legacy_pickle_load).
 
 THIN WRAPPER over the unified static-analysis engine — the detection
 logic lives in paddle_tpu/analysis/rules/invariants.py (the
@@ -17,8 +20,9 @@ allow_pickle=True) reappearing under distributed/ or a checkpoint
 RESTORE path is treated as a wire hazard.
 
 Usage: check_no_wire_pickle.py [root_dir ...]   (default:
-<repo>/paddle_tpu/distributed AND <repo>/paddle_tpu/checkpoint).
-Exits 1 listing offending file:line sites.
+<repo>/paddle_tpu/distributed, <repo>/paddle_tpu/checkpoint AND
+<repo>/paddle_tpu/incubate). Exits 1 listing offending file:line
+sites.
 """
 from __future__ import annotations
 

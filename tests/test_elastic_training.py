@@ -1,0 +1,526 @@
+"""Elastic collective training: coordinated cluster checkpoints,
+world-resize resume, progress-aware gang restart (tier-1 chaos drills).
+
+In-process: SampleSchedule determinism, multi-rank save/restore
+roundtrips + resharding, uncommitted-part invisibility, content-based
+staleness (mtime-skew regression), hung-vs-straggler discrimination.
+
+Subprocess drills over tests/fixtures/elastic_trainer.py:
+  - SIGKILL (fault-injected os._exit) one rank mid-step → launcher
+    gang-restarts with backoff → resumed loss curve continues the
+    fault-free run's BIT-FOR-BIT (same world size);
+  - 4→2 world resize resume → curve within fp tolerance;
+  - flapping rank excluded (--exclude_flapping) → job finishes at
+    world−1 via the resize path;
+  - kill mid cluster-save → previous committed version restores.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.checkpoint import CheckpointStore
+from paddle_tpu.checkpoint import manifest as manifest_mod
+from paddle_tpu.distributed import elastic
+from paddle_tpu.distributed.cluster_ckpt import (
+    ClusterCheckpoint, ClusterCheckpointError, SampleSchedule)
+from paddle_tpu.distributed.fleet.runtime.fault_injection import (
+    KILL_EXIT_CODE)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "elastic_trainer.py")
+DRILL_STEPS = 12
+
+
+# ---------------------------------------------------------------------------
+# sample schedule: counter-based, world-invariant
+# ---------------------------------------------------------------------------
+
+def test_sample_schedule_world_invariant_partition():
+    s = SampleSchedule(seed=7, epoch=0, num_samples=64, global_batch=8)
+    for step in (0, 3, 7):
+        g = s.global_indices(step)
+        assert len(g) == 8
+        for world in (1, 2, 4, 8):
+            parts = [s.rank_indices(step, r, world)
+                     for r in range(world)]
+            np.testing.assert_array_equal(np.concatenate(parts), g)
+    # same (seed, epoch) regenerates the identical permutation from
+    # nothing — the property resize resume rests on
+    s2 = SampleSchedule(seed=7, epoch=0, num_samples=64, global_batch=8)
+    np.testing.assert_array_equal(s.perm, s2.perm)
+    assert not np.array_equal(
+        s.perm,
+        SampleSchedule(seed=7, epoch=1, num_samples=64,
+                       global_batch=8).perm)
+
+
+def test_sample_schedule_remaining_and_guards():
+    s = SampleSchedule(seed=1, epoch=0, num_samples=40, global_batch=10)
+    rem = s.remaining(next_step=2)
+    np.testing.assert_array_equal(rem, s.perm[20:40])
+    # epoch fold
+    np.testing.assert_array_equal(s.global_indices(4),
+                                  s.global_indices(0))
+    with pytest.raises(ValueError, match="divisible"):
+        s.rank_indices(0, 0, 3)
+    with pytest.raises(ValueError):
+        s.rank_indices(0, 5, 2)
+    with pytest.raises(ValueError):
+        SampleSchedule(seed=0, epoch=0, num_samples=4, global_batch=8)
+
+
+# ---------------------------------------------------------------------------
+# cluster checkpoint roundtrip + resharding (in-process, sync mode)
+# ---------------------------------------------------------------------------
+
+def _world_state(rank, world, rows=12, dim=3):
+    """Deterministic per-rank share of a cluster state."""
+    w = np.arange(10, dtype=np.float64) * 1.5          # replicated
+    full = (np.arange(rows * dim, dtype=np.float64)
+            .reshape(rows, dim) + 0.25)                # sharded, axis 0
+    piece = np.array_split(full, world, axis=0)[rank]
+    rng = np.array([1000 + rank], dtype=np.int64)      # per-rank
+    return {"replicated": {"w": w}, "sharded": {"emb": piece},
+            "per_rank": {"rng": rng}}, full
+
+
+def _save_world(root, world, step, async_save=False):
+    handles = [ClusterCheckpoint(root, rank=r, world=world,
+                                 every_steps=1, async_save=async_save,
+                                 merge_timeout=10.0)
+               for r in range(world)]
+    # rank 0's sync save polls for every part before merging, so the
+    # non-zero ranks publish first (in a real job they run in parallel)
+    full = None
+    for r in range(world - 1, -1, -1):
+        st, full = _world_state(r, world)
+        handles[r].save(step, **st)
+    for h in handles:
+        h.wait()
+    return handles, full
+
+
+def test_cluster_roundtrip_same_world(tmp_path):
+    root = str(tmp_path)
+    handles, full = _save_world(root, world=2, step=3)
+    for r in range(2):
+        state, info = handles[r].restore()
+        assert info["step"] == 3 and info["saved_world"] == 2
+        st, _ = _world_state(r, 2)
+        np.testing.assert_array_equal(state["w"],
+                                      st["replicated"]["w"])
+        np.testing.assert_array_equal(state["emb"],
+                                      st["sharded"]["emb"])
+        np.testing.assert_array_equal(state["rng"],
+                                      st["per_rank"]["rng"])
+
+
+def test_cluster_resize_restore_4_to_2(tmp_path):
+    root = str(tmp_path)
+    _, full = _save_world(root, world=4, step=5)
+    new = ClusterCheckpoint(root, rank=0, world=2)
+    for r in range(2):
+        state, info = new.restore(rank=r, world=2)
+        assert info["saved_world"] == 4
+        # replicated broadcasts to the new world
+        np.testing.assert_array_equal(state["w"],
+                                      np.arange(10) * 1.5)
+        # sharded pieces stitched and re-cut on the new partition
+        np.testing.assert_array_equal(
+            state["emb"], np.array_split(full, 2, axis=0)[r])
+        # per-rank state has no cross-world meaning: None, re-derive
+        # counter-style (SampleSchedule)
+        assert state["rng"] is None
+
+
+def test_uncommitted_parts_invisible_and_wrong_world_rejected(tmp_path):
+    root = str(tmp_path)
+    handles, _ = _save_world(root, world=2, step=2)
+    before, info = handles[0].restore()
+    assert info["step"] == 2
+
+    # a lone uncommitted part at a later step: restore still serves
+    # the committed version bit-for-bit
+    st1, _ = _world_state(1, 2)
+    handles[1].store.save_part(
+        {"emb@shard0001": st1["sharded"]["emb"] * 7}, 4, 1, 2)
+    after, info2 = ClusterCheckpoint(root, rank=0, world=2).restore()
+    assert info2["step"] == 2
+    for k in before:
+        np.testing.assert_array_equal(after[k], before[k])
+    # restore (rank 0) purged the stale part: a resumed gang can never
+    # merge it into a fresh version
+    assert manifest_mod.list_parts(root, 4) == []
+
+    # and a part written for a DIFFERENT world never merges: stale
+    # old-world geometry must not leak through an elastic resize
+    st0, _ = _world_state(0, 2)
+    handles[0].store.save_part(
+        {"emb@shard0000": st0["sharded"]["emb"]}, 6, 0, 2)
+    with pytest.raises(manifest_mod.ManifestError, match="world"):
+        manifest_mod.merge_parts(root, 6, 1)
+
+
+def test_async_roundtrip_same_world(tmp_path):
+    """Async mode: parts + merge ride the store writer thread; wait()
+    drains and the merged version restores identically."""
+    root = str(tmp_path)
+    handles, _ = _save_world(root, world=2, step=1, async_save=True)
+    state, info = handles[0].restore()
+    assert info["step"] == 1
+    st0, _ = _world_state(0, 2)
+    np.testing.assert_array_equal(state["emb"], st0["sharded"]["emb"])
+
+
+def test_seconds_cadence_via_intent_file(tmp_path):
+    root = str(tmp_path)
+    ck = ClusterCheckpoint(root, rank=0, world=1, every_seconds=0.01,
+                           async_save=False)
+    st, _ = _world_state(0, 1)
+    assert ck.maybe_save(0, **st) is None     # budget not elapsed yet
+    time.sleep(0.03)
+    # elapsed: this call arms an intent for step 2 (one step of lead)
+    assert ck.maybe_save(1, **st) is None
+    assert os.path.exists(os.path.join(root, "intent-0000000002.json"))
+    assert ck.maybe_save(2, **st) == 2        # every rank joins at 2
+    assert not os.path.exists(
+        os.path.join(root, "intent-0000000002.json"))  # consumed
+    assert ck.latest_step() == 2
+
+
+def test_restore_refuses_non_cluster_manifest(tmp_path):
+    st = CheckpointStore(str(tmp_path))
+    st.save({"a": np.zeros(3)}, step=1)
+    with pytest.raises(ClusterCheckpointError, match="cluster"):
+        ClusterCheckpoint(str(tmp_path), rank=0, world=1).restore()
+
+
+# ---------------------------------------------------------------------------
+# staleness from heartbeat CONTENT (mtime-skew regression)
+# ---------------------------------------------------------------------------
+
+def _write_hb(dir_, rank, start, beat, step):
+    os.makedirs(dir_, exist_ok=True)
+    p = os.path.join(dir_, f"rank{rank}.hb")
+    with open(p + ".tmp", "w") as f:
+        f.write(f"{start} {beat} {step}")
+    os.replace(p + ".tmp", p)
+    return p
+
+
+def test_stale_ranks_ignores_skewed_mtime(tmp_path):
+    """Fresh CONTENT with an ancient mtime (NFS granularity, clock
+    skew, archive restore) must NOT read as stale."""
+    dir_ = str(tmp_path)
+    now = time.time()
+    p = _write_hb(dir_, 0, now - 100, now, step=5)
+    os.utime(p, (now - 3600, now - 3600))     # mtime lies: 1h old
+    assert elastic.stale_ranks(dir_, timeout=2.0, expected=1) == []
+
+
+def test_stale_ranks_tracker_catches_frozen_content(tmp_path):
+    """The inverse skew: mtime keeps refreshing but the CONTENT never
+    changes (writer thread wedged mid-loop). The tracker path catches
+    it on the watcher's own monotonic clock."""
+    dir_ = str(tmp_path)
+    now = time.time()
+    p = _write_hb(dir_, 0, now, now, step=5)
+    tracker: dict = {}
+    assert elastic.stale_ranks(dir_, 0.05, 1, tracker=tracker) == []
+    time.sleep(0.12)
+    os.utime(p, None)                         # fresh mtime, same bytes
+    assert elastic.stale_ranks(dir_, 0.05, 1, tracker=tracker) == [0]
+
+
+# ---------------------------------------------------------------------------
+# progress-aware watchdog: hung vs straggler
+# ---------------------------------------------------------------------------
+
+def _mgr(dir_, world, deadline=0.1, lag=10):
+    return elastic.ElasticManager(
+        max_restarts=3, heartbeat_timeout=30.0, heartbeat_dir=dir_,
+        world_size=world, step_deadline=deadline, straggler_lag=lag)
+
+
+def test_straggler_flagged_not_killed(tmp_path):
+    dir_ = str(tmp_path)
+    m = _mgr(dir_, world=2, deadline=30.0, lag=10)
+    now = time.time()
+    _write_hb(dir_, 0, now - 60, now, step=50)
+    _write_hb(dir_, 1, now - 60, now, step=12)   # 38 behind, alive
+    assert m.hung_ranks() == []
+    assert m.stragglers() == [1]
+    from paddle_tpu.observability.registry import REGISTRY
+    assert REGISTRY.get(
+        "paddle_tpu_elastic_straggler_ranks").value == 1
+    assert REGISTRY.get("paddle_tpu_elastic_step_lag").value == 38
+    assert REGISTRY.get("paddle_tpu_elastic_stale_ranks").value == 0
+
+
+def test_step_frozen_rank_is_hung(tmp_path):
+    dir_ = str(tmp_path)
+    m = _mgr(dir_, world=2, deadline=0.08, lag=100)
+    t0 = time.time()
+    _write_hb(dir_, 0, t0 - 60, t0, step=5)
+    _write_hb(dir_, 1, t0 - 60, t0, step=5)
+    assert m.hung_ranks() == []               # first observation
+    time.sleep(0.12)
+    t1 = time.time()
+    _write_hb(dir_, 0, t0 - 60, t1, step=6)   # advances, fresh beat
+    _write_hb(dir_, 1, t0 - 60, t1, step=5)   # beats, step FROZEN
+    assert m.hung_ranks() == [1]
+
+
+def test_frozen_at_max_step_excused_while_others_advance(tmp_path):
+    """A rank parked AT the front (waiting at a collective for the
+    laggards) is not hung — only frozen ranks BEHIND the front are."""
+    dir_ = str(tmp_path)
+    m = _mgr(dir_, world=2, deadline=0.08, lag=100)
+    t0 = time.time()
+    _write_hb(dir_, 0, t0 - 60, t0, step=9)   # front, will freeze
+    _write_hb(dir_, 1, t0 - 60, t0, step=3)   # behind, advancing
+    assert m.hung_ranks() == []
+    time.sleep(0.12)
+    t1 = time.time()
+    _write_hb(dir_, 0, t0 - 60, t1, step=9)   # frozen at the front
+    _write_hb(dir_, 1, t0 - 60, t1, step=4)   # still moving
+    assert m.hung_ranks() == []               # excused: blocked, not hung
+    time.sleep(0.12)
+    t2 = time.time()
+    _write_hb(dir_, 0, t0 - 60, t2, step=9)
+    _write_hb(dir_, 1, t0 - 60, t2, step=4)   # now BOTH frozen
+    assert m.hung_ranks() == [0, 1]           # deadlocked gang: all hung
+
+
+# ---------------------------------------------------------------------------
+# subprocess chaos drills (fixture: tests/fixtures/elastic_trainer.py)
+# ---------------------------------------------------------------------------
+
+def _drill_env(out, ckpt, world=None, rank=None, **extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ELASTIC_DRILL_OUT=str(out),
+               PADDLE_TPU_CLUSTER_CKPT_DIR=str(ckpt),
+               ELASTIC_DRILL_STEPS=str(DRILL_STEPS),
+               ELASTIC_DRILL_SAVE_EVERY="2",
+               ELASTIC_DRILL_STEP_SLEEP="0.02")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    for k in ("PADDLE_PS_FAULT_KILL_AFTER_BYTES",
+              "PADDLE_PS_FAULT_KILL_AT_STEP"):
+        env.pop(k, None)
+    if world is not None:
+        env["PADDLE_TRAINERS_NUM"] = str(world)
+    if rank is not None:
+        env["PADDLE_TRAINER_ID"] = str(rank)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _spawn_world(out, ckpt, world, **extra):
+    """Run one life of a `world`-rank gang directly (no launcher)."""
+    procs = [subprocess.Popen(
+        [sys.executable, FIXTURE],
+        env=_drill_env(out, ckpt, world=world, rank=r, **extra),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(world)]
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    return [p.returncode for p in procs], outs
+
+
+def _curve(out, rank=0):
+    """step -> (loss, world); LAST record per step wins (a killed
+    life's partial tail is recomputed by the resumed one)."""
+    d = {}
+    with open(os.path.join(out, f"loss_rank{rank}.jsonl")) as f:
+        for line in f:
+            r = json.loads(line)
+            d[r["step"]] = (r["loss"], r["world"])
+    return d
+
+
+@pytest.fixture(scope="module")
+def baseline_world2(tmp_path_factory):
+    """Fault-free world-2 run: the reference loss curve + final state."""
+    base = tmp_path_factory.mktemp("elastic_baseline")
+    out, ckpt = base / "out", base / "ckpt"
+    rcs, outs = _spawn_world(out, ckpt, world=2)
+    assert rcs == [0, 0], outs
+    f0 = np.load(os.path.join(str(out), "final_rank0.npz"))
+    f1 = np.load(os.path.join(str(out), "final_rank1.npz"))
+    return {"out": str(out),
+            "curve": _curve(str(out)),
+            "final": f0,
+            "M_full": np.concatenate([f0["M"], f1["M"]], axis=0)}
+
+
+def _run_launcher(args, env, timeout=180):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+        + args + [FIXTURE],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_drill_kill_resume_bit_for_bit(tmp_path, baseline_world2):
+    """SIGKILL (injected os._exit) rank 1 mid-step → launcher
+    gang-restarts with backoff → resumed run recomputes from the
+    committed step and the full loss curve equals the fault-free
+    run's BIT-FOR-BIT (same world size)."""
+    out, ckpt, logs = (tmp_path / d for d in ("out", "ckpt", "logs"))
+    env = _drill_env(out, ckpt, ELASTIC_DRILL_KILL_RANK=1,
+                     ELASTIC_DRILL_KILL_AT=7)
+    res = _run_launcher(
+        ["--nproc_per_node=2", "--log_dir", str(logs),
+         "--max_restarts=2", "--restart_backoff=0.05",
+         f"--cluster_ckpt_dir={ckpt}"], env)
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "elastic restart 1/2" in res.stderr
+    assert "backing off" in res.stderr
+    got = _curve(str(out))
+    want = baseline_world2["curve"]
+    assert set(got) == set(range(DRILL_STEPS))
+    for s in range(DRILL_STEPS):
+        assert got[s][0] == want[s][0], \
+            f"step {s}: {got[s][0]!r} != {want[s][0]!r} (not bit-for-bit)"
+    fin = np.load(os.path.join(str(out), "final_rank0.npz"))
+    np.testing.assert_array_equal(fin["w"], baseline_world2["final"]["w"])
+    np.testing.assert_array_equal(fin["M"], baseline_world2["final"]["M"])
+
+
+def test_drill_resize_4_to_2_continues_loss_curve(tmp_path,
+                                                  baseline_world2):
+    """World 4 trains to a committed version, the job comes back at
+    world 2: shards re-cut, schedule repartitions, and the loss curve
+    continues the fault-free world-2 run's within fp tolerance."""
+    out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+    rcs, outs = _spawn_world(out, ckpt, world=4,
+                             ELASTIC_DRILL_STEPS=6)  # commits step 4
+    assert rcs == [0] * 4, outs
+    rcs, outs = _spawn_world(out, ckpt, world=2)     # resumes at 5
+    assert rcs == [0, 0], outs
+    got = _curve(str(out))
+    assert got[4][1] == 4 and got[5][1] == 2         # resize happened
+    want = baseline_world2["curve"]
+    for s in range(DRILL_STEPS):
+        np.testing.assert_allclose(
+            got[s][0], want[s][0], rtol=1e-6,
+            err_msg=f"step {s} diverged past fp tolerance")
+    # resharded matrix state converges to the same totals
+    f0 = np.load(os.path.join(str(out), "final_rank0.npz"))
+    f1 = np.load(os.path.join(str(out), "final_rank1.npz"))
+    M = np.concatenate([f0["M"], f1["M"]], axis=0)
+    np.testing.assert_allclose(M, baseline_world2["M_full"], rtol=1e-6)
+
+
+def test_drill_exclude_flapping_rank_resumes_at_world_minus_1(
+        tmp_path, baseline_world2):
+    """Rank 1 crashes at step 7 EVERY life: after --flap_threshold
+    offenses the launcher excludes it, respawns at world 1, and the
+    survivors finish via the resize-resume path."""
+    out, ckpt, logs = (tmp_path / d for d in ("out", "ckpt", "logs"))
+    env = _drill_env(out, ckpt, ELASTIC_DRILL_FLAP_RANK=1,
+                     ELASTIC_DRILL_KILL_AT=7)
+    res = _run_launcher(
+        ["--nproc_per_node=2", "--log_dir", str(logs),
+         "--max_restarts=4", "--restart_backoff=0.05",
+         "--exclude_flapping", "--flap_threshold=2",
+         f"--cluster_ckpt_dir={ckpt}"], env)
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "excluding flapping rank trainer.1" in res.stderr
+    got = _curve(str(out))
+    assert got[6][1] == 2 and got[11][1] == 1        # finished at W-1
+    want = baseline_world2["curve"]
+    for s in range(DRILL_STEPS):
+        np.testing.assert_allclose(got[s][0], want[s][0], rtol=1e-6)
+    fin = np.load(os.path.join(str(out), "final_rank0.npz"))
+    assert fin["M"].shape[0] == 24                   # owns every row now
+    np.testing.assert_allclose(fin["M"], baseline_world2["M_full"],
+                               rtol=1e-6)
+
+
+def test_drill_hung_rank_detected_and_job_recovers(tmp_path,
+                                                   baseline_world2):
+    """Fault-injected stall (STALL_POINT=trainer_step) wedges rank 1
+    at step 0 while its heartbeat thread keeps beating: only the STEP
+    content exposes it. The launcher's --step_deadline flags it hung
+    (the advancing rank 0 is NOT a false positive), gang-restarts, and
+    the healthy respawn finishes with the fault-free curve."""
+    out, ckpt, logs = (tmp_path / d for d in ("out", "ckpt", "logs"))
+    env = _drill_env(out, ckpt, ELASTIC_DRILL_STALL_RANK=1,
+                     ELASTIC_DRILL_STALL=60,
+                     ELASTIC_DRILL_STEP_SLEEP="0.3")
+    res = _run_launcher(
+        ["--nproc_per_node=2", "--log_dir", str(logs),
+         "--max_restarts=1", "--restart_backoff=0.05",
+         "--step_deadline=1.0", f"--cluster_ckpt_dir={ckpt}"], env)
+    assert res.returncode == 0, res.stderr[-4000:]
+    assert "ranks [1]" in res.stderr, res.stderr        # the wedged one
+    assert "elastic restart 1/1" in res.stderr
+    got = _curve(str(out))
+    want = baseline_world2["curve"]
+    assert set(got) == set(range(DRILL_STEPS))
+    for s in range(DRILL_STEPS):
+        assert got[s][0] == want[s][0], f"step {s} diverged"
+
+
+def test_drill_kill_mid_cluster_save_keeps_previous_version(tmp_path):
+    """The byte-count kill fires inside the ASYNC cluster save (store
+    writer thread): process dies mid-save, previous committed cluster
+    version stays the restore target bit-for-bit."""
+    out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+    rcs, outs = _spawn_world(out, ckpt, world=1)     # commits thru 10
+    assert rcs == [0], outs
+    ck = ClusterCheckpoint(str(ckpt), rank=0, world=1)
+    before, info = ck.restore()
+    assert info["step"] == 10
+
+    env = _drill_env(out, ckpt, world=1, rank=0,
+                     ELASTIC_DRILL_STEPS=DRILL_STEPS + 6)
+    env["PADDLE_PS_FAULT_KILL_AFTER_BYTES"] = "64"
+    res = subprocess.run([sys.executable, FIXTURE], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == KILL_EXIT_CODE, res.stdout + res.stderr
+
+    after, info2 = ClusterCheckpoint(str(ckpt), rank=0,
+                                     world=1).restore()
+    assert info2["step"] == 10
+    for k in before:
+        np.testing.assert_array_equal(after[k], before[k])
+
+
+# ---------------------------------------------------------------------------
+# metrics surface + lock-order sanitizer rerun
+# ---------------------------------------------------------------------------
+
+def test_elastic_metrics_registered():
+    from paddle_tpu.observability.registry import REGISTRY
+    for name in ("paddle_tpu_elastic_heartbeats_total",
+                 "paddle_tpu_elastic_stale_ranks",
+                 "paddle_tpu_elastic_straggler_ranks",
+                 "paddle_tpu_elastic_step_lag",
+                 "paddle_tpu_elastic_restarts_total",
+                 "paddle_tpu_elastic_crash_loop_giveups_total",
+                 "paddle_tpu_elastic_resume_seconds"):
+        assert REGISTRY.get(name) is not None, name
+
+
+def test_elastic_module_clean_under_lockcheck():
+    """The store writer thread now runs merges and the watchdog keeps
+    cross-poll state: re-run this module's in-process tests with every
+    paddle_tpu lock order-checked."""
+    if os.environ.get("PADDLE_TPU_LOCKCHECK") == "1":
+        pytest.skip("already running under the sanitizer")
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "test_elastic_training.py"),
+         "-q", "-x", "-k", "not drill and not lockcheck",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, \
+        res.stdout[-4000:] + res.stderr[-2000:]

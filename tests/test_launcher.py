@@ -231,3 +231,63 @@ def test_launch_metrics_dir_collects_per_process_dumps(tmp_path):
     by_name = {m["name"]: m for m in agg["metrics"]}
     rec = by_name["paddle_tpu_launchtest_units_total"]
     assert rec["samples"][0]["value"] == 4  # 2 processes x inc(2)
+
+def test_launch_exponential_backoff_between_restarts(tmp_path):
+    """Elastic restarts wait restart_backoff * 2**(n-1) seconds (capped
+    at --restart_backoff_max) so a crashing gang cannot hot-loop."""
+    bad = tmp_path / "always_fail.py"
+    bad.write_text("import sys; sys.exit(7)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    import time
+    t0 = time.monotonic()
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", f"--started_port={_free_port()}",
+         "--max_restarts=3", "--restart_backoff=0.5",
+         "--restart_backoff_max=1.0", str(bad)],
+        env=env, capture_output=True, text=True, timeout=120)
+    wall = time.monotonic() - t0
+    assert res.returncode == 7
+    assert res.stderr.count("elastic restart") == 3, res.stderr
+    delays = [float(line.rsplit(" ", 3)[1].rstrip("s"))
+              for line in res.stderr.splitlines()
+              if "backing off" in line]
+    assert delays == [0.5, 1.0, 1.0], res.stderr   # doubled, then capped
+    assert wall >= 2.5, wall                       # the waits really ran
+
+
+def test_launch_crash_loop_gives_up_with_debug_bundle(tmp_path):
+    """K failures inside the window → stop restarting, name the
+    flapping rank, and write a postmortem debug bundle."""
+    bad = tmp_path / "always_fail.py"
+    bad.write_text(
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_TRAINER_ID'] == '0':\n"
+        "    sys.exit(9)\n"
+        "time.sleep(60)\n")
+    dbg = tmp_path / "debug"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", f"--started_port={_free_port()}",
+         "--max_restarts=10", "--restart_backoff=0.05",
+         "--crash_loop_window=60", "--crash_loop_threshold=3",
+         "--debug_dir", str(dbg), str(bad)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 9
+    assert "crash loop: 3 failures" in res.stderr, res.stderr
+    assert "trainer.0" in res.stderr
+    # gave up well before the restart budget
+    assert res.stderr.count("elastic restart") == 2, res.stderr
+    bundles = [d for d in os.listdir(dbg)
+               if (dbg / d / "MANIFEST.json").exists()]
+    assert len(bundles) == 1, os.listdir(dbg)
+    import json
+    man = json.load(open(dbg / bundles[0] / "MANIFEST.json"))
+    assert "crash_loop" in man["reason"]
+    assert "trainer.0" in man["reason"]
+    extra = json.load(open(dbg / bundles[0] / "extra.json"))
+    assert extra["flapping"] == "trainer.0"
+    assert extra["offender_counts"]["trainer.0"] == 3
