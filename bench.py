@@ -53,7 +53,10 @@ bytes-copied-per-payload-byte on both paths) | online (continuous
 publish pipeline: PS push -> servable-version staleness on the wire,
 streamed-generate max inter-token gap across a staggered 2-replica
 rollout vs steady-state ITL, cross-version chunk dedup ratio on a
-one-row-mutated embedding).
+one-row-mutated embedding) | ps_ha (PS high-availability plane:
+kill-primary -> promoted-standby first-push wall time vs the pre-HA
+snapshot-respawn baseline, semi-sync vs async push-ack tax, and
+steady-state replication lag under a wide&deep-style push stream).
 """
 from __future__ import annotations
 
@@ -1632,6 +1635,207 @@ def bench_elastic(train_steps=120, save_every=30, hidden=512, seed=0):
             "train_steps": train_steps}
 
 
+def bench_ps_ha(n_rows=4096, dim=32, batch=64, lat_pushes=150,
+                stream_pushes=200, seed=0):
+    """BENCH_CONFIG=ps_ha (docs/PS_HA.md): the economics of the PS
+    high-availability plane. Three numbers:
+
+    - failover recovery — kill the primary under a live group client,
+      promote the hot standby (epoch-fenced), and time kill -> first
+      successful push; versus the pre-HA baseline of
+      restart_from_snapshot on the same seeded table (bar: promotion
+      wins — the standby already holds the rows);
+    - semi-sync ack tax — p50 push latency with
+      PADDLE_PS_HA_SEMISYNC=1 vs async replication on an identical
+      pair (bar: <150% — the ack is one replication round-trip
+      overlapped outside the commit scope, so at most ~one extra
+      loopback RTT on top of the push RTT);
+    - steady-state replication lag under a wide&deep-style stream
+      (4 slot tables, 80/20 hot/uniform id batches), sampled per push
+      from the hub's per-peer feeds, plus the drain-to-caught-up time
+      once the stream stops."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient, PSServer
+    from paddle_tpu.distributed.fleet.runtime.ps_ha import promote_best
+
+    root = tempfile.mkdtemp(prefix="bench_ps_ha_")
+    rng = np.random.RandomState(seed)
+    rows = rng.randn(n_rows, dim).astype(np.float32)
+
+    def wait_for(cond, timeout=30.0, what="condition"):
+        deadline = time.perf_counter() + timeout
+        while not cond():
+            if time.perf_counter() > deadline:
+                raise TimeoutError(f"ps_ha bench: timed out on {what}")
+            time.sleep(0.002)
+
+    def pair(tag, semisync=None):
+        env = {} if semisync is None else {
+            "PADDLE_PS_HA_SEMISYNC": str(semisync),
+            "PADDLE_PS_HA_SEMISYNC_TIMEOUT": "10.0"}
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            prim = PSServer(
+                "127.0.0.1:0", wal=True,
+                snapshot_dir=os.path.join(root, tag, "p"))
+            prim.serve_in_thread()
+            stby = PSServer(
+                "127.0.0.1:0", wal=True, primary=prim.endpoint,
+                snapshot_dir=os.path.join(root, tag, "s"))
+            stby.serve_in_thread()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        wait_for(lambda: stby._ha_replicator.synced.is_set(),
+                 what=f"{tag} standby bootstrap")
+        return prim, stby
+
+    def stop(*servers):
+        for s in servers:
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+
+    def seed_table(cl, name):
+        for lo in range(0, n_rows, 256):
+            ids = np.arange(lo, min(lo + 256, n_rows))
+            cl.push(name, dim, ids, rows[ids])
+
+    def push_p50(cl, name):
+        lats = []
+        for _ in range(lat_pushes):
+            ids = np.unique(rng.randint(0, n_rows, batch))
+            vals = rng.randn(len(ids), dim).astype(np.float32)
+            t0 = time.perf_counter()
+            cl.push(name, dim, ids, vals)
+            lats.append(time.perf_counter() - t0)
+        lats.sort()
+        return lats[len(lats) // 2]
+
+    try:
+        # -- semi-sync ack tax: identical pairs, async vs K=1 ---------
+        prim_a, stby_a = pair("async")
+        cl_a = PSClient([prim_a.endpoint])
+        seed_table(cl_a, "emb")
+        push_p50(cl_a, "emb")  # warm
+        async_p50 = push_p50(cl_a, "emb")
+
+        prim_s, stby_s = pair("semi", semisync=1)
+        cl_s = PSClient([prim_s.endpoint])
+        seed_table(cl_s, "emb")
+        push_p50(cl_s, "emb")  # warm
+        semi_p50 = push_p50(cl_s, "emb")
+        semi_degraded = int(prim_s._ha.degraded)
+        cl_s.close()
+        stop(stby_s, prim_s)
+
+        # -- steady-state replication lag under wide&deep-style load --
+        hot = rng.randint(0, n_rows, 1024)
+        lag_samples = []
+        t0 = time.perf_counter()
+        for i in range(stream_pushes):
+            if rng.rand() < 0.8:
+                ids = np.unique(hot[rng.randint(0, len(hot), batch)])
+            else:
+                ids = np.unique(rng.randint(0, n_rows, batch))
+            vals = rng.randn(len(ids), dim).astype(np.float32)
+            cl_a.push(f"slot{i % 4}", dim, ids, vals)
+            st = prim_a._ha.status()
+            if st:
+                lag_samples.append(st[0]["lag_rows"])
+        stream_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        wait_for(lambda: all(f["lag_rows"] == 0
+                             for f in prim_a._ha.status()),
+                 what="replication drain")
+        drain_s = time.perf_counter() - t0
+
+        # -- failover: kill primary, promote, first push lands --------
+        grp = PSClient([prim_a.endpoint + "|" + stby_a.endpoint])
+        probe_ids = np.arange(8)
+        probe = np.ones((8, dim), np.float32)
+        grp.push("emb", dim, probe_ids, probe)
+        wait_for(lambda: (stby_a._ha_replicator.applied_seq
+                          >= prim_a._ha.seq),
+                 what="standby caught up pre-kill")
+        t0 = time.perf_counter()
+        prim_a.kill()
+        new_prim = promote_best([stby_a.endpoint], 2, timeout=10.0)
+        grp.push("emb", dim, probe_ids, probe)
+        failover_s = time.perf_counter() - t0
+        grp.close()
+        cl_a.close()
+        stop(stby_a)
+
+        # -- pre-HA baseline: snapshot-respawn on the same endpoint.
+        # A real respawn is a fresh PROCESS (launcher child) that
+        # restores snapshot+WAL before serving, so the baseline spawns
+        # the killable-server fixture, not an in-process restart.
+        import subprocess
+        solo_dir = os.path.join(root, "solo")
+        srv = PSServer("127.0.0.1:0", wal=True, snapshot_dir=solo_dir)
+        srv.serve_in_thread()
+        cl = PSClient([srv.endpoint])
+        seed_table(cl, "emb")
+        ep = srv.endpoint
+        srv.kill()
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, PS_ENDPOINT=ep, PADDLE_PS_WAL="1",
+                   PADDLE_PS_SNAPSHOT_DIR=solo_dir,
+                   JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = repo + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tests", "fixtures",
+                          "ps_fault_server.py")],
+            env=env, stdout=subprocess.PIPE, text=True)
+        try:
+            proc.stdout.readline()  # READY line: restored + serving
+            cl.push("emb", dim, probe_ids, probe)
+            respawn_s = time.perf_counter() - t0
+        finally:
+            proc.kill()
+            proc.wait()
+        cl.close()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    overhead_pct = ((semi_p50 - async_p50) / async_p50 * 100
+                    if async_p50 > 0 else 0.0)
+    return {"metric": "ps_ha_failover_first_push_s",
+            "value": round(failover_s, 4),
+            "unit": "s",
+            "respawn_first_push_s": round(respawn_s, 4),
+            "promotion_beats_respawn": bool(failover_s < respawn_s),
+            "promoted_ok": bool(new_prim is not None),
+            "async_push_p50_ms": round(async_p50 * 1e3, 4),
+            "semisync_push_p50_ms": round(semi_p50 * 1e3, 4),
+            "semisync_overhead_pct": round(overhead_pct, 2),
+            "semisync_overhead_bar_pct": 150.0,
+            "semisync_bar_ok": bool(overhead_pct <= 150.0),
+            "semisync_degraded_acks": semi_degraded,
+            "stream_lag_rows_mean": round(
+                float(np.mean(lag_samples)), 2) if lag_samples
+            else float("nan"),
+            "stream_lag_rows_max": int(max(lag_samples))
+            if lag_samples else -1,
+            "stream_push_per_s": round(stream_pushes / stream_s, 1),
+            "lag_drain_s": round(drain_s, 4),
+            "rows": n_rows, "dim": dim, "batch": batch,
+            "lat_pushes": lat_pushes, "stream_pushes": stream_pushes}
+
+
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
     predictor parity path): save -> load -> timed ZeroCopyRun.
@@ -1889,6 +2093,8 @@ def main():
         rec = bench_transport()
     elif which == "online":
         rec = bench_online()
+    elif which == "ps_ha":
+        rec = bench_ps_ha()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,

@@ -271,6 +271,23 @@ REQUIRED_METRICS = {
     "paddle_tpu_elastic_restarts_total",
     "paddle_tpu_elastic_crash_loop_giveups_total",
     "paddle_tpu_elastic_resume_seconds",
+    # PS high availability (docs/PS_HA.md): role/epoch/fencing state,
+    # per-standby replication lag, semi-sync degradation and the
+    # promotion/handoff/resync counts are the HA plane's acceptance
+    # contract — the failover drills and the ps_ha bench read these
+    # exact names
+    "paddle_tpu_ps_ha_role",
+    "paddle_tpu_ps_ha_epoch",
+    "paddle_tpu_ps_ha_standbys_connected",
+    "paddle_tpu_ps_ha_replication_lag_rows",
+    "paddle_tpu_ps_ha_replication_lag_bytes",
+    "paddle_tpu_ps_ha_replication_lag_seconds",
+    "paddle_tpu_ps_ha_records_shipped_total",
+    "paddle_tpu_ps_ha_semisync_total",
+    "paddle_tpu_ps_ha_fenced_writes_total",
+    "paddle_tpu_ps_ha_promotions_total",
+    "paddle_tpu_ps_ha_handoffs_total",
+    "paddle_tpu_ps_ha_resyncs_total",
 }
 
 

@@ -67,6 +67,28 @@ while concurrent calls on the same socket complete untouched.
 The frame fault fires ONCE (first matching frame on an injecting side);
 tests can re-arm programmatically via ``set_frame_fault``.
 
+Replication-path faults (PS high availability, docs/PS_HA.md): target
+the primary->standby WAL replication stream instead of the RPC frames.
+
+  PADDLE_PS_FAULT_REPL_ACTION=drop|corrupt|delay   what to do to ONE
+                                matched replication record: skip
+                                shipping it (the standby sees a
+                                sequence gap and resyncs from a fresh
+                                bootstrap), flip its row bytes (the
+                                per-record CRC rejects it -> resync),
+                                or hold it back FRAME_DELAY seconds
+  PADDLE_PS_FAULT_REPL_RECORD=N match: a replication sequence number,
+                                or "any" for the first shipped record
+                                (default any)
+  PADDLE_PS_FAULT_KILL_AT_RECORD=N  standby: os._exit after APPLYING
+                                its N-th replicated record (1-based;
+                                0 disables) — the deterministic
+                                standby-death for semi-sync
+                                degradation drills
+
+Like the frame fault, the replication fault fires ONCE; re-arm with
+``set_repl_fault``.
+
 A PADDLE_PS_FAULT_-prefixed env var that is NOT one of the above is a
 typo (a chaos drill that silently injects nothing is worse than one
 that fails loudly): `from_env` logs a warning naming it.
@@ -98,6 +120,8 @@ KNOWN_FAULT_KNOBS = frozenset({
     "PADDLE_PS_FAULT_STALL_POINT", "PADDLE_PS_FAULT_SIDE",
     "PADDLE_PS_FAULT_SEED", "PADDLE_PS_FAULT_FRAME_ACTION",
     "PADDLE_PS_FAULT_FRAME_REQ", "PADDLE_PS_FAULT_FRAME_DELAY",
+    "PADDLE_PS_FAULT_REPL_ACTION", "PADDLE_PS_FAULT_REPL_RECORD",
+    "PADDLE_PS_FAULT_KILL_AT_RECORD",
 })
 
 logger = logging.getLogger(__name__)
@@ -114,7 +138,9 @@ class FaultInjector:
                  stall_point: str = "dispatch",
                  side: str = "both", seed: int = 0,
                  frame_action: str = "", frame_req: str = "",
-                 frame_delay: float = 0.2):
+                 frame_delay: float = 0.2,
+                 repl_action: str = "", repl_record: str = "any",
+                 kill_at_record: int = 0):
         self.drop = drop
         self.delay = delay
         self.truncate = truncate
@@ -130,13 +156,18 @@ class FaultInjector:
         self.frame_req = frame_req
         self.frame_delay = frame_delay
         self._frame_fired = False
+        self.repl_action = repl_action
+        self.repl_record = repl_record
+        self.kill_at_record = kill_at_record
+        self._repl_fired = False
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._requests = 0
         self._bytes = 0
         self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
                          "corrupted": 0, "requests": 0, "bytes": 0,
-                         "stalled": 0, "frame_faults": 0}
+                         "stalled": 0, "frame_faults": 0,
+                         "repl_faults": 0}
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -168,14 +199,20 @@ class FaultInjector:
             frame_action=e("PADDLE_PS_FAULT_FRAME_ACTION", "") or "",
             frame_req=e("PADDLE_PS_FAULT_FRAME_REQ", "any") or "any",
             frame_delay=float(
-                e("PADDLE_PS_FAULT_FRAME_DELAY", "0.2") or 0.2))
+                e("PADDLE_PS_FAULT_FRAME_DELAY", "0.2") or 0.2),
+            repl_action=e("PADDLE_PS_FAULT_REPL_ACTION", "") or "",
+            repl_record=e("PADDLE_PS_FAULT_REPL_RECORD", "any")
+            or "any",
+            kill_at_record=int(
+                e("PADDLE_PS_FAULT_KILL_AT_RECORD", "0") or 0))
 
     @property
     def active(self) -> bool:
         return bool(self.drop or self.delay or self.truncate
                     or self.corrupt or self.kill_after
                     or self.kill_after_bytes or self.kill_at_step >= 0
-                    or self.stall or self.frame_action)
+                    or self.stall or self.frame_action
+                    or self.repl_action or self.kill_at_record)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
@@ -216,6 +253,43 @@ class FaultInjector:
             self._frame_fired = True
             self.counters["frame_faults"] += 1
             return self.frame_action, self.frame_delay
+
+    # -- replication-stream faults (PS HA, docs/PS_HA.md) ----------------
+    def set_repl_fault(self, action: str, record: str = "any",
+                       delay: float = 0.2):
+        """(Re)arm a one-shot fault against a single primary->standby
+        replication record. `record` is a replication sequence number
+        or "any" for the next shipped record."""
+        with self._lock:
+            self.repl_action = action
+            self.repl_record = str(record)
+            self.frame_delay = delay
+            self._repl_fired = False
+
+    def repl_fault(self, seq: int) -> tuple[str, float] | None:
+        """One-shot fault check for one outgoing replication record.
+        Returns None (ship normally) or (action, delay_seconds) with
+        action in {"drop", "corrupt", "delay"} — consumed by the first
+        matching record."""
+        if not self.repl_action:
+            return None
+        with self._lock:
+            if self._repl_fired:
+                return None
+            spec = self.repl_record
+            if spec not in ("", "any") and int(seq) != int(spec):
+                return None
+            self._repl_fired = True
+            self.counters["repl_faults"] += 1
+            return self.repl_action, self.frame_delay
+
+    def maybe_kill_at_record(self, n: int):
+        """Standby kill switch: dies (os._exit, a SIGKILL stand-in)
+        once it has APPLIED its ``kill_at_record``-th replicated record
+        — the record is in its tables/WAL but possibly un-acked, the
+        exact window the semi-sync degradation drill needs."""
+        if self.kill_at_record and int(n) >= self.kill_at_record:
+            os._exit(KILL_EXIT_CODE)
 
     # -- frame mangling (called from rpc.send_frame) --------------------
     def mangle(self, frame: bytes, body_off: int, side: str | None,

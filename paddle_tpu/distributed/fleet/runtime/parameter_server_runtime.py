@@ -25,6 +25,7 @@ import itertools
 import json
 import os
 import queue
+import re
 import socketserver
 import threading
 import time
@@ -35,7 +36,11 @@ import numpy as np
 from ....observability import (debug as _debug, flight as _flight,
                                registry as _obs, watchdog as _watchdog)
 from .fault_injection import injector
-from .rpc import (RpcClient, RpcServerState, TransportStats,
+from .ps_ha import (ReplicationHub, StandbyReplicator,
+                    note_fenced_write, note_handoff, note_promotion,
+                    set_role_gauges)
+from .rpc import (PSDeadlineError, PSRemoteError, RpcClient,
+                  RpcServerState, TransportStats, _hard_close,
                   serve_connection)
 
 __all__ = ["ParameterServerRuntime", "LargeScaleKV", "PSServer", "PSClient"]
@@ -393,7 +398,13 @@ class PSServer(socketserver.ThreadingTCPServer):
                           # rest are reads — none need replay dedup
                           "tel_push", "tel_ping", "tel_fleet",
                           "tel_trace", "tel_traces", "tel_stats",
-                          "tel_watch"})
+                          "tel_watch",
+                          # HA plane: replication streams/acks and
+                          # status probes must never replay from the
+                          # dedup cache (ha_promote/ha_handoff stay
+                          # mutating — a retried promote must return
+                          # its cached verdict, not re-run)
+                          "repl_watch", "repl_ack", "ha_status"})
     # mutating ops whose effects the snapshot tier persists
     _SNAPSHOT_OPS = frozenset({"push", "send_barrier"})
     # verbs that legitimately block on straggler trainers (or, for
@@ -404,7 +415,16 @@ class PSServer(socketserver.ThreadingTCPServer):
     _BLOCKING_OPS = frozenset({"send_barrier", "fetch_barrier",
                                "dgc_push", "dgc_pull",
                                "subscribe_inval", "pub_watch",
-                               "tel_watch"})
+                               "tel_watch",
+                               # replication streams sit open for the
+                               # standby's lifetime; handoff blocks on
+                               # standby catch-up by design
+                               "repl_watch", "ha_handoff"})
+    # ops a standby (or a fenced ex-primary) still answers: liveness,
+    # observability, the replication/ack plane, and promotion itself
+    _HA_CTRL_OPS = frozenset({"ping", "metrics", "debug_dump",
+                              "heartbeat", "lost_workers", "ha_status",
+                              "ha_promote", "repl_ack", "repl_watch"})
 
     def __init__(self, endpoint: str, worker_timeout: float = 60.0,
                  snapshot_dir: str | None = None,
@@ -417,7 +437,9 @@ class PSServer(socketserver.ThreadingTCPServer):
                  publish_dir: str | None = None,
                  publish_every_steps: int | None = None,
                  publish_every_seconds: float | None = None,
-                 publish_every_rows: int | None = None):
+                 publish_every_rows: int | None = None,
+                 primary: str | None = None,
+                 ha_epoch: int | None = None):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
@@ -471,6 +493,23 @@ class PSServer(socketserver.ThreadingTCPServer):
                 "(PADDLE_PS_SNAPSHOT_DIR) for its base snapshots")
         self._wal = None
         self._wal_pending = False
+        # high-availability plane (docs/PS_HA.md): a shard started
+        # with a primary endpoint is a hot STANDBY — it rejects normal
+        # traffic with not_primary and tracks the primary row-for-row
+        # over the repl_watch stream until promoted. The shard epoch
+        # fences zombie ex-primaries: any request carrying a NEWER
+        # epoch proves a successor exists, so this instance fences
+        # itself and rejects writes with stale_epoch.
+        self.ha_primary = primary if primary is not None \
+            else (env("PADDLE_PS_HA_PRIMARY") or None)
+        self.ha_role = "standby" if self.ha_primary else "primary"
+        self.shard_epoch = int(ha_epoch if ha_epoch is not None
+                               else env("PADDLE_PS_HA_EPOCH", "0")
+                               or 0)
+        self._ha_fenced = False
+        self._ha_replicator: StandbyReplicator | None = None
+        self._ha_replicated_bytes = 0
+        self._ha: ReplicationHub | None = None  # built once port bound
         if fs is None:
             from ....distributed.fs import LocalFS
             fs = LocalFS()
@@ -510,16 +549,26 @@ class PSServer(socketserver.ThreadingTCPServer):
                                    secret=secret,
                                    after_commit=self._after_commit,
                                    commit_scope=self._commit_scope,
-                                   after_retry=self._after_retry)
+                                   after_retry=self._after_retry,
+                                   before_reply=self._ha_before_reply)
         outer = self
+        # every live handler socket, so server_close/kill can sever
+        # them (replication subscribers + inval streams included —
+        # peers must see EOF now, not after a full recv timeout)
+        self._conns_lock = threading.Lock()
+        self._conns: weakref.WeakSet = weakref.WeakSet()
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
                 serve_connection(self.request, outer._dispatch,
                                  outer._rpc)
 
         super().__init__((host, int(port)), Handler)
         self.endpoint = f"{host}:{self.server_address[1]}"
+        self._ha = ReplicationHub(self.endpoint)
+        set_role_gauges(self.endpoint, self.ha_role, self.shard_epoch)
         # stall watchdog: completed dispatches are this shard's
         # progress counter; the shard is idle while no non-barrier op
         # is in flight, so a quiet server never looks stalled but a
@@ -592,6 +641,9 @@ class PSServer(socketserver.ThreadingTCPServer):
         if self.snapshot_dir and self.snapshot_interval > 0:
             threading.Thread(target=self._snapshot_loop,
                              daemon=True).start()
+        if self.ha_role == "standby":
+            self._ha_replicator = StandbyReplicator(
+                self, self.ha_primary).start()
 
     def _bg_replay(self):
         """Background WAL replay (PADDLE_PS_WAL_BG_REPLAY): identical
@@ -627,7 +679,23 @@ class PSServer(socketserver.ThreadingTCPServer):
         # round state is volatile by design (not snapshot-covered)
         if op == "push" and self.snapshot_dir:
             return self._apply_lock
+        if op == "ha_handoff":
+            # handoff IS the drain: dispatching under the apply lock
+            # means every in-flight push has committed + journaled
+            # before the catch-up wait, and new pushes queue on the
+            # lock — after the epoch flip they dispatch against a
+            # demoted server, get not_primary, and redirect with the
+            # SAME request id (zero failed pushes)
+            return self._apply_lock
         return None
+
+    def _ha_before_reply(self, op: str, req_id: int):
+        """RPC-layer hook between dedup commit and reply: semi-sync
+        replication holds the push's ack here — OUTSIDE the commit
+        scope, so a waiting push never serializes other pushes."""
+        if op in self._SNAPSHOT_OPS and self._ha is not None \
+                and self._ha.semisync > 0:
+            self._ha.wait_semisync(req_id)
 
     def _after_commit(self, op: str):
         if op not in self._SNAPSHOT_OPS:
@@ -709,15 +777,23 @@ class PSServer(socketserver.ThreadingTCPServer):
                     continue
         return sorted(out)
 
+    def _make_journal(self, path: str, recover: bool = False):
+        """Every journal on an HA-capable server is a ReplicatedJournal:
+        with no subscribers attached the publish is a few dict ops, and
+        the moment a standby subscribes it sees records in exactly
+        journal append order."""
+        from .ps_ha import ReplicatedJournal
+        return ReplicatedJournal(path, self._ha, recover=recover)
+
     def _open_wal(self):
-        from ....checkpoint.wal import RowJournal
         os.makedirs(self.snapshot_dir, exist_ok=True)
         files = self._wal_files()
         stamp = max(files[-1][0] if files else 0, self._snap_written)
         # recover=True: truncate any torn tail left by the previous
         # incarnation BEFORE appending — records written after garbage
         # would sit beyond every future replay's stop point
-        self._wal = RowJournal(self._wal_path(stamp), recover=True)
+        self._wal = self._make_journal(self._wal_path(stamp),
+                                       recover=True)
 
     def _rotate_wal(self, seq: int):
         """Start journal wal_<seq> (records from now on replay on top
@@ -725,10 +801,14 @@ class PSServer(socketserver.ThreadingTCPServer):
         the superseded journals are deleted only once that base COMMITS
         (_write_snapshot_files), so a failed base write loses nothing."""
         from ....checkpoint.wal import RowJournal
-        old, self._wal = self._wal, RowJournal(self._wal_path(seq))
+        old, self._wal = self._wal, self._make_journal(
+            self._wal_path(seq))
         if old is not None:
             old.close()
         RowJournal.note_compaction()
+        # tell standbys we folded the journal into a fresh base so
+        # they re-anchor (compact their own journal) too
+        self._wal.publish_rotate(seq)
 
     def _replay_wal(self):
         """Rebuild state journaled after the restored base: apply each
@@ -763,7 +843,7 @@ class PSServer(socketserver.ThreadingTCPServer):
         rows/RNG and rotates the journal), and re-raise so the client
         sees the failure."""
         try:
-            append()
+            return append()
         except BaseException:
             with self._snap_lock:
                 self._wal_pending = True
@@ -1075,29 +1155,42 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def _load_snapshot_file(self, path: str, replace: bool = True) -> dict:
         with np.load(path, allow_pickle=False) as blob:
-            meta = json.loads(bytes(blob["meta"]).decode("utf-8"))
-            tables: dict[str, LargeScaleKV] = {}
-            for name, tmeta in meta["tables"].items():
-                t = LargeScaleKV(int(tmeta["dim"]),
-                                 init_std=float(tmeta["init_std"]),
-                                 seed=int(tmeta["seed"]))
-                st = {"dim": tmeta["dim"],
-                      "init_std": tmeta["init_std"],
-                      "seed": tmeta["seed"],
-                      "keys": blob[f"k:{name}"],
-                      "rows": blob[f"r:{name}"]}
-                if "rng" in tmeta:
-                    st["rng"] = dict(tmeta["rng"],
-                                     key=blob[f"s:{name}"])
-                t.import_state(st)
-                tables[name] = t
-            ids = blob["dedup_ids"]
-            lens = blob["dedup_lens"].tolist()
-            raw = blob["dedup_blob"].tobytes()
-            blobs, off = [], 0
-            for n in lens:
-                blobs.append(raw[off:off + n])
-                off += n
+            return self._import_snapshot_blob(blob, replace)
+
+    def _import_snapshot_blob(self, blob, replace: bool = True) -> dict:
+        """Import one exported state blob (an open npz file OR the
+        same arrays as a plain dict — the HA bootstrap arrives as a
+        dict over the wire) into tables + dedup + mutation count."""
+        meta = json.loads(bytes(blob["meta"]).decode("utf-8"))
+
+        def writable(a):
+            # wire-decoded arrays (HA bootstrap) view read-only
+            # frombuffer memory; tables update rows in place
+            a = np.asarray(a)
+            return a if a.flags.writeable else a.copy()
+
+        tables: dict[str, LargeScaleKV] = {}
+        for name, tmeta in meta["tables"].items():
+            t = LargeScaleKV(int(tmeta["dim"]),
+                             init_std=float(tmeta["init_std"]),
+                             seed=int(tmeta["seed"]))
+            st = {"dim": tmeta["dim"],
+                  "init_std": tmeta["init_std"],
+                  "seed": tmeta["seed"],
+                  "keys": writable(blob[f"k:{name}"]),
+                  "rows": writable(blob[f"r:{name}"])}
+            if "rng" in tmeta:
+                st["rng"] = dict(tmeta["rng"],
+                                 key=writable(blob[f"s:{name}"]))
+            t.import_state(st)
+            tables[name] = t
+        ids = blob["dedup_ids"]
+        lens = blob["dedup_lens"].tolist()
+        raw = blob["dedup_blob"].tobytes()
+        blobs, off = [], 0
+        for n in lens:
+            blobs.append(raw[off:off + n])
+            off += n
         with self._tables_lock:
             if replace:
                 self.tables = tables
@@ -1119,11 +1212,29 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def server_close(self):
         self._snap_stop.set()
+        rep = self._ha_replicator
+        if rep is not None:
+            rep.close()
         if self._exporter is not None:
             self._exporter.stop()
         if self._wal is not None:
             self._wal.close()
         super().server_close()
+        # sever every live handler socket (the PR 11 lesson, extended
+        # to the HA plane): replication subscribers and inval streams
+        # must see EOF NOW so a standby detects primary death within
+        # its heartbeat interval, not after a full recv timeout
+        with self._conns_lock:
+            conns = list(self._conns)
+        for s in conns:
+            _hard_close(s)
+
+    def kill(self):
+        """Stop serving AND sever every open connection — the
+        in-process stand-in for shard death (chaos drills): attached
+        standbys see the stream break immediately."""
+        self.shutdown()
+        self.server_close()
 
     def table(self, name: str, dim: int,
               init_std: float = 0.01) -> LargeScaleKV:
@@ -1183,6 +1294,248 @@ class PSServer(socketserver.ThreadingTCPServer):
             with self._inval_lock:
                 self._inval_subs.pop(sid, None)
 
+    # -- high availability (docs/PS_HA.md) ------------------------------
+    def _ha_gate(self, op: str, req_epoch: int):
+        """Role/epoch admission check, before the op switch. Standbys
+        answer only the control plane (everything else redirects via
+        not_primary). A primary that sees a request carrying a NEWER
+        epoch has proof a successor was promoted: it fences itself and
+        rejects writes with stale_epoch — a zombie ex-primary can
+        never fork the shard."""
+        if self.ha_role == "standby":
+            if op in self._HA_CTRL_OPS:
+                return
+            raise ValueError(
+                f"not_primary primary={self.ha_primary or ''} "
+                f"epoch={self.shard_epoch}")
+        if req_epoch > self.shard_epoch and not self._ha_fenced:
+            self._ha_fenced = True
+            _flight.record("ps", "ha_fenced", endpoint=self.endpoint,
+                           epoch=self.shard_epoch,
+                           req_epoch=req_epoch)
+        if self._ha_fenced and op not in self._HA_CTRL_OPS:
+            if op not in self.READ_OPS:
+                note_fenced_write(self.endpoint, op, req_epoch,
+                                  self.shard_epoch)
+            raise ValueError(f"stale_epoch epoch={self.shard_epoch}")
+
+    def _repl_watch(self, req: dict):
+        """Dispatch generator for repl_watch: one standby's replication
+        feed. Subscribing and exporting the bootstrap state under the
+        apply lock guarantees no record committed after the bootstrap
+        can be missed (duplicates across the boundary are benign —
+        the standby skips already-applied sequence numbers)."""
+        # a bg-replaying primary applies journal records WITHOUT
+        # publishing them — bootstrapping mid-replay would hand the
+        # standby partial state with no stream to fill the rest
+        self._replay_done.wait()
+        if self._wal is None:
+            raise ValueError(
+                "repl_watch needs the WAL tier (PADDLE_PS_WAL=1 with "
+                "a snapshot dir) on the primary")
+        name = str(req.get("name", "?"))
+        hub = self._ha
+        sub = None
+        try:
+            with self._apply_lock:
+                sub = hub.subscribe(name)
+                arrays = self._export_arrays(self._snap_seq,
+                                             names=None, kind="base")
+                start_seq = hub.seq
+                epoch = self.shard_epoch
+            yield {"bootstrap": arrays, "seq": start_seq,
+                   "epoch": epoch, "sub": sub.sid,
+                   "primary": self.endpoint}
+            _flight.record("ps", "ha_standby_attach",
+                           endpoint=self.endpoint, peer=name,
+                           seq=start_seq)
+            inj = injector()
+            while True:
+                if sub.broken:
+                    raise ValueError(
+                        "replication queue overflow — resync")
+                try:
+                    rec = sub.q.get(timeout=5.0)
+                except queue.Empty:
+                    yield {"kind": "keepalive",
+                           "epoch": self.shard_epoch}
+                    continue
+                if inj.active:
+                    act = inj.repl_fault(int(rec.get("seq", 0)))
+                    if act is not None:
+                        action, delay = act
+                        if action == "drop":
+                            continue  # standby sees the gap -> resync
+                        if action == "delay":
+                            time.sleep(delay)
+                        elif action == "corrupt" \
+                                and rec.get("kind") == "rows":
+                            bad = np.array(rec["values"], np.float32,
+                                           copy=True)
+                            if bad.size:
+                                bad.flat[0] += 1.0
+                            rec = dict(rec, values=bad)  # crc now lies
+                yield rec
+        finally:
+            if sub is not None:
+                hub.unsubscribe(sub)
+
+    def _ha_import_bootstrap(self, arrays: dict, seq: int, epoch: int):
+        """Standby: replace local state with the primary's bootstrap
+        export (tables + RNG streams + dedup cache), adopt its epoch,
+        and re-anchor our own journal with a fresh full base."""
+        with self._apply_lock:
+            self._import_snapshot_blob(arrays, replace=True)
+            if epoch > self.shard_epoch:
+                self.shard_epoch = int(epoch)
+                set_role_gauges(self.endpoint, self.ha_role,
+                                self.shard_epoch)
+            self._ha_replicated_bytes = 0
+        _flight.record("ps", "ha_bootstrap", endpoint=self.endpoint,
+                       primary=self.ha_primary or "", seq=int(seq),
+                       epoch=int(epoch))
+        if self._wal is not None:
+            self.snapshot(full=True)
+
+    def _ha_apply_record(self, rec: dict):
+        """Standby: apply one replicated journal record through the
+        same ensure+assign path WAL replay uses, journal it to our OWN
+        journal (so a promoted standby restarts from its own disk),
+        and commit the request id + reply into the dedup cache —
+        exactly-once is preserved across failover."""
+        from .rpc import decode_body
+        extra = b""
+        if "extra" in rec and len(rec["extra"]):
+            extra = np.asarray(rec["extra"], np.uint8).tobytes()
+        kind = rec.get("kind")
+        with self._apply_lock:
+            n = 0
+            if kind == "rows":
+                t = self.table(rec["table"], int(rec["dim"]),
+                               float(rec.get("init_std", 0.01)))
+                idx = np.asarray(rec["idx"], np.int64).ravel()
+                t.apply_rows(idx, rec["values"])
+                self._mark_dirty(rec["table"])
+                if self._wal is not None:
+                    n = self._wal_guard(
+                        lambda: self._wal.append_rows(
+                            rec["table"], idx,
+                            np.asarray(rec["values"], np.float32),
+                            dim=int(rec["dim"]),
+                            init_std=float(rec.get("init_std", 0.01)),
+                            seed=int(rec.get("seed", 0)),
+                            req_id=int(rec.get("req_id", 0)),
+                            extra=extra))
+                else:
+                    idx_b = idx.nbytes
+                    n = int(np.asarray(rec["values"]).nbytes + idx_b)
+            elif kind == "mark":
+                if self._wal is not None:
+                    n = self._wal_guard(
+                        lambda: self._wal.append_mark(
+                            int(rec.get("req_id", 0)), extra=extra))
+            rid = int(rec.get("req_id", 0))
+            if rid:
+                self._rpc.dedup.commit(
+                    rid, decode_body(extra) if extra else True)
+                with self._snap_lock:
+                    self._mutations += 1
+            self._ha_replicated_bytes += n
+
+    def _ha_note_rotate(self):
+        """Standby: the primary compacted its journal into a fresh
+        base — compact ours too, so standby disk usage tracks the
+        primary's bound."""
+        if self._wal is not None:
+            self.snapshot(full=True)
+
+    def promote(self, epoch: int) -> dict:
+        """Standby -> primary (launcher failover or handoff target):
+        adopt the bumped epoch, stop replicating, start serving. On an
+        already-primary server this only ratchets the epoch."""
+        epoch = int(epoch)
+        rep = self._ha_replicator
+        applied = int(rep.applied_seq) if rep is not None \
+            else int(self._ha.seq)
+        if self.ha_role != "primary":
+            # order matters: flip the role FIRST so the replicator
+            # loop exits instead of resyncing, then sever its stream
+            self.ha_role = "primary"
+            self.ha_primary = None
+            self._ha_replicator = None
+            if rep is not None:
+                rep.close()
+            note_promotion(self.endpoint, max(self.shard_epoch, epoch))
+        self.shard_epoch = max(self.shard_epoch, epoch)
+        self._ha_fenced = False
+        set_role_gauges(self.endpoint, "primary", self.shard_epoch)
+        return {"role": "primary", "epoch": int(self.shard_epoch),
+                "endpoint": self.endpoint, "applied_seq": applied}
+
+    def _ha_demote(self, new_primary: str, epoch: int):
+        """Handoff tail: this ex-primary becomes a standby of the
+        freshly promoted target, so the shard keeps a hot spare."""
+        self.shard_epoch = int(epoch)
+        self.ha_primary = new_primary
+        self.ha_role = "standby"
+        self._ha_fenced = False
+        set_role_gauges(self.endpoint, "standby", self.shard_epoch)
+        self._ha_replicator = StandbyReplicator(
+            self, new_primary).start()
+
+    def _ha_handoff(self, req: dict) -> dict:
+        """Planned handoff (maintenance / shard rebalancing): runs
+        UNDER the apply lock (commit_scope), so every in-flight push
+        has committed and journaled before the catch-up wait, and new
+        pushes queue on the lock — after the flip they redirect to the
+        new primary with their SAME request ids. Zero failed pushes."""
+        target = str(req.get("target", ""))
+        if self.ha_role != "primary":
+            raise ValueError(
+                f"not_primary primary={self.ha_primary or ''} "
+                f"epoch={self.shard_epoch}")
+        if self._wal is None:
+            raise ValueError("ha_handoff needs the WAL tier")
+        sub = self._ha.find(target)
+        if sub is None:
+            raise ValueError(
+                f"ha_handoff: {target!r} is not an attached standby")
+        last = self._ha.seq
+        if not self._ha.wait_caught_up(
+                sub, last, timeout=float(req.get("timeout", 30.0))):
+            raise RuntimeError(
+                f"ha_handoff: {target} did not catch up to seq "
+                f"{last}")
+        epoch_new = int(self.shard_epoch) + 1
+        cl = RpcClient(target, timeout=10.0, deadline=15.0,
+                       max_retries=1)
+        try:
+            st = cl.call({"op": "ha_promote", "epoch": epoch_new},
+                         timeout=10.0)
+        finally:
+            cl.close()
+        self._ha_demote(target, epoch_new)
+        note_handoff(self.endpoint, target, epoch_new)
+        return {"promoted": target, "epoch": epoch_new,
+                "applied_seq": int(st.get("applied_seq", 0))
+                if isinstance(st, dict) else 0}
+
+    def ha_status(self) -> dict:
+        rep = self._ha_replicator
+        return {"role": self.ha_role,
+                "epoch": int(self.shard_epoch),
+                "endpoint": self.endpoint,
+                "primary": self.ha_primary or "",
+                "fenced": bool(self._ha_fenced),
+                "applied_seq": int(rep.applied_seq)
+                if rep is not None else int(self._ha.seq),
+                "repl_seq": int(self._ha.seq),
+                "resyncs": int(rep.resyncs) if rep is not None else 0,
+                "synced": bool(rep.synced.is_set())
+                if rep is not None else True,
+                "standbys": self._ha.status(),
+                "semisync_degraded": int(self._ha.degraded)}
+
     def _dispatch(self, req: dict):
         """In-flight accounting wrapper around the op switch: arms the
         stall watchdog token (non-barrier ops only), applies the
@@ -1239,6 +1592,24 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def _dispatch_inner(self, req: dict):
         op = req["op"]
+        # shard epoch rides the request skeleton (HA fencing); epoch 0
+        # = legacy client, always admitted on an unfenced primary
+        req_epoch = int(req.pop("_epoch", 0) or 0)
+        if req_epoch or self._ha_fenced or self.ha_role != "primary":
+            self._ha_gate(op, req_epoch)
+        if op == "repl_watch":
+            return self._repl_watch(req)
+        if op == "repl_ack":
+            return self._ha.ack(int(req.get("sub", -1)),
+                                int(req.get("seq", 0)),
+                                int(req.get("bytes", 0)),
+                                float(req.get("t", 0.0)))
+        if op == "ha_status":
+            return self.ha_status()
+        if op == "ha_promote":
+            return self.promote(int(req.get("epoch", 0)))
+        if op == "ha_handoff":
+            return self._ha_handoff(req)
         if not self._replay_done.is_set():
             gated = self._replay_gate(req)
             if gated is not None:
@@ -1415,12 +1786,25 @@ class PSServer(socketserver.ThreadingTCPServer):
         return th
 
 
+_NOT_PRIMARY_RE = re.compile(
+    r"not_primary(?:\s+primary=(\S*))?(?:\s+epoch=(\d+))?")
+_STALE_EPOCH_RE = re.compile(r"stale_epoch\s+epoch=(\d+)")
+
+
 class PSClient:
     """Worker-side stub: key-hash routing across server shards (reference
     ps_dispatcher hash dispatch + Communicator send path), one
     fault-tolerant RpcClient channel per shard (retry with stable
     request ids, per-request deadlines, backoff — reference brpc
-    channel timeout_ms/max_retry)."""
+    channel timeout_ms/max_retry).
+
+    HA (docs/PS_HA.md): a shard entry may be a ``|``-joined member
+    list, ``primary|standby[|standby2]``. Shard routing is unchanged
+    (one ACTIVE endpoint per shard); on a dead or demoted active
+    member the client probes the group, adopts the live primary with
+    the highest epoch, and replays the in-flight call with the SAME
+    request id — server dedup makes the retry exactly-once even
+    across a failover."""
 
     # sync-mode barrier (and DGC round) calls legitimately block
     # server-side for up to 300s waiting on straggler trainers — their
@@ -1432,16 +1816,26 @@ class PSClient:
                  deadline: float | None = None,
                  max_retries: int | None = None,
                  backoff: float | None = None):
-        self.endpoints = list(endpoints)
+        self._groups = [str(ep).split("|") for ep in endpoints]
+        # active member per shard: shard count and key routing see ONE
+        # endpoint per group, exactly the non-HA shape
+        self.endpoints = [g[0] for g in self._groups]
         # wire + fault accounting shared across shard channels
         # (bench/diagnostics read .bytes_out/.bytes_in; robustness
         # tests read .stats)
         self.stats = TransportStats()
-        self._clients = [
-            RpcClient(ep, stats=self.stats, secret=secret,
-                      timeout=timeout, deadline=deadline,
-                      max_retries=max_retries, backoff=backoff)
-            for ep in self.endpoints]
+        self._client_kw = dict(stats=self.stats, secret=secret,
+                               timeout=timeout, deadline=deadline,
+                               max_retries=max_retries,
+                               backoff=backoff)
+        self._ha_lock = threading.RLock()
+        self._cl_cache: dict[str, RpcClient] = {}
+        self._clients = [self._client_for(ep)
+                         for ep in self.endpoints]
+        self._epochs = [0] * len(self._groups)  # newest epoch seen
+        self.failovers = 0        # active-member switches on failure
+        self.redirects = 0        # not_primary redirects followed
+        self.fenced_rejects = 0   # stale_epoch answers seen
         self._pool = None  # lazy persistent fan-out pool
         self._inval_stop: threading.Event | None = None
         self._inval_threads: list[threading.Thread] = []
@@ -1459,8 +1853,150 @@ class PSClient:
     def bytes_in(self) -> int:
         return self.stats.bytes_in
 
+    def _client_for(self, ep: str) -> RpcClient:
+        with self._ha_lock:
+            cl = self._cl_cache.get(ep)
+            if cl is None:
+                cl = self._cl_cache[ep] = RpcClient(
+                    ep, **self._client_kw)
+            return cl
+
     def _call(self, i: int, req: dict, **kw):
-        return self._clients[i].call(req, **kw)
+        if len(self._groups[i]) == 1:
+            # non-HA shard: exactly the pre-HA code path
+            return self._clients[i].call(req, **kw)
+        return self._ha_call(i, req, **kw)
+
+    # -- HA failover path (docs/PS_HA.md) --------------------------------
+    def _set_active(self, i: int, ep: str):
+        with self._ha_lock:
+            if ep not in self._groups[i]:
+                self._groups[i].append(ep)
+            self.endpoints[i] = ep
+            self._clients[i] = self._client_for(ep)
+
+    def _advance(self, i: int):
+        with self._ha_lock:
+            g = self._groups[i]
+            cur = self.endpoints[i]
+            j = (g.index(cur) + 1) % len(g) if cur in g else 0
+            self._set_active(i, g[j])
+
+    def _failover(self, i: int):
+        """Probe the group for a live primary (short single-attempt
+        ha_status calls) and adopt the one with the highest epoch;
+        with none answering yet (promotion in flight) stay put — the
+        caller's retry loop keeps probing until its deadline."""
+        with self._ha_lock:
+            group = list(self._groups[i])
+            cur = self.endpoints[i]
+        best_ep, best_epoch = None, -1
+        for ep in group:
+            if ep == cur:
+                continue
+            try:
+                st = self._client_for(ep).call(
+                    {"op": "ha_status"}, timeout=1.0, deadline=1.5,
+                    max_retries=0)
+            except Exception:
+                continue
+            if isinstance(st, dict) and st.get("role") == "primary" \
+                    and not st.get("fenced"):
+                e = int(st.get("epoch", 0))
+                if e > best_epoch:
+                    best_ep, best_epoch = ep, e
+        with self._ha_lock:
+            if best_ep is not None \
+                    and best_epoch >= self._epochs[i]:
+                self._epochs[i] = max(self._epochs[i], best_epoch)
+                self._set_active(i, best_ep)
+                self.failovers += 1
+                _flight.record("ps_client", "ha_failover", shard=i,
+                               endpoint=best_ep, epoch=best_epoch)
+                return True
+        return False
+
+    def _ha_call(self, i: int, req: dict, timeout: float | None = None,
+                 deadline: float | None = None, req_id=None, **kw):
+        """Group-aware call: pin the request id up front so every
+        retry — including against a freshly promoted standby — is the
+        SAME request to the dedup cache; follow not_primary redirects;
+        adopt newer epochs from stale_epoch answers; probe the group
+        on transport failures. Bounded by the normal call deadline."""
+        cl0 = self._clients[i]
+        budget = deadline if deadline is not None else cl0.deadline
+        deadline_ts = time.monotonic() + budget
+        if req_id is None:
+            req_id = cl0._next_id()
+        barrier = req.get("op") in ("send_barrier", "fetch_barrier",
+                                    "dgc_push", "dgc_pull")
+        probe = float(os.environ.get(
+            "PADDLE_PS_HA_PROBE", "2.0") or 2.0)
+        last: Exception | None = None
+        while True:
+            with self._ha_lock:
+                cl = self._clients[i]
+                epoch = self._epochs[i]
+            r = dict(req)
+            if epoch:
+                r["_epoch"] = epoch
+            left = deadline_ts - time.monotonic()
+            if left <= 0:
+                raise PSDeadlineError(
+                    f"PS {req.get('op')!r} failed across HA group "
+                    f"{self._groups[i]}: {last}") from last
+            if barrier:
+                # barrier dispatch legitimately blocks on stragglers:
+                # a short probing cycle would tear rounds apart
+                cycle = min(left, (timeout or self.BARRIER_TIMEOUT)
+                            + 5.0)
+            else:
+                cycle = min(left, max(probe, 0.2))
+            try:
+                if not barrier and "max_retries" not in kw:
+                    # single attempt per cycle: the OUTER loop owns
+                    # retries here, so a dead active member triggers a
+                    # group probe NOW instead of burning the whole
+                    # probe cycle in reconnect backoff against it
+                    return cl.call(r, timeout=timeout, deadline=cycle,
+                                   req_id=req_id, max_retries=0, **kw)
+                return cl.call(r, timeout=timeout, deadline=cycle,
+                               req_id=req_id, **kw)
+            except PSRemoteError as e:
+                msg = str(e)
+                m = _NOT_PRIMARY_RE.search(msg)
+                if m is not None:
+                    self.redirects += 1
+                    last = e
+                    with self._ha_lock:
+                        if int(m.group(2) or 0) > self._epochs[i]:
+                            self._epochs[i] = int(m.group(2) or 0)
+                    if m.group(1):
+                        self._set_active(i, m.group(1))
+                    else:
+                        self._failover(i) or self._advance(i)
+                    continue
+                m = _STALE_EPOCH_RE.search(msg)
+                if m is None:
+                    raise
+                self.fenced_rejects += 1
+                last = e
+                srv_epoch = int(m.group(1))
+                with self._ha_lock:
+                    behind = srv_epoch > self._epochs[i]
+                    if behind:
+                        # we were behind this server: adopt its epoch
+                        # and retry it
+                        self._epochs[i] = srv_epoch
+                if not behind:
+                    # the server is the stale one (zombie): find the
+                    # successor primary
+                    self._failover(i) or self._advance(i)
+            except (PSDeadlineError, ConnectionError, OSError) as e:
+                last = e
+                if self._failover(i):
+                    continue    # adopted a live primary: retry NOW
+            time.sleep(0.05)
 
     def _route(self, keys: np.ndarray) -> np.ndarray:
         return (keys.astype(np.int64) % len(self.endpoints)).astype(np.int64)
@@ -1682,7 +2218,10 @@ class PSClient:
         if self._pool is not None:
             self._pool.shutdown(wait=False)
             self._pool = None
-        for c in self._clients:
+        with self._ha_lock:
+            clients = list(self._cl_cache.values())
+            self._cl_cache.clear()
+        for c in clients:
             c.close()
 
 
@@ -1701,6 +2240,13 @@ class ParameterServerRuntime:
     def init_server(self, *args, **kwargs):
         eps = self._role_maker.get_pserver_endpoints()
         me = eps[self._role_maker.server_index()]
+        if "|" in me:
+            # HA group entry (docs/PS_HA.md): bind the member matching
+            # this process's identity; primary/standby role comes from
+            # PADDLE_PS_HA_PRIMARY (the launcher sets both)
+            members = me.split("|")
+            mine = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+            me = mine if mine in members else members[0]
         self.server = PSServer(me)
         model_dir = args[0] if args else kwargs.get("dirname")
         if model_dir:

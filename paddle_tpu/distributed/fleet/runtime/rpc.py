@@ -1336,7 +1336,7 @@ class RpcServerState:
     def __init__(self, read_ops=frozenset(), secret: str | None = None,
                  dedup_capacity: int = 65536, after_commit=None,
                  commit_scope=None, after_retry=None,
-                 expose_req_id: bool = False):
+                 expose_req_id: bool = False, before_reply=None):
         self.read_ops = frozenset(read_ops)
         # inject the wire request id into the skeleton as "_req_id"
         # before dispatch: the serving router pins its DOWNSTREAM call
@@ -1370,6 +1370,12 @@ class RpcServerState:
         # on disk before the reply leaves and replay order matches
         # apply order
         self.journal = None
+        # optional (op, req_id) hook called for mutating ops after
+        # after_commit but BEFORE the reply frame is enqueued, OUTSIDE
+        # the commit scope (it may block without serializing other
+        # pushes) — the PS HA semi-sync ack gate waits here until K
+        # standbys hold the journaled record (or degrades to async)
+        self.before_reply = before_reply
 
 
 class _ServerConn:
@@ -1571,6 +1577,8 @@ class _ServerConn:
                 # (e.g. snapshot disk error) propagate and end the
                 # connection for the same reason.
                 state.after_commit(op)
+            if mutating and req_id and state.before_reply is not None:
+                state.before_reply(op, req_id)
             if inj.active:
                 inj.maybe_kill("reply", armed)
             self.enqueue(rep, req_id)
@@ -1614,6 +1622,13 @@ def serve_connection(sock: socket.socket, dispatch, state: RpcServerState):
     F_STREAM frames, the generator's return value is the final
     (dedup-memoised) reply; an F_CANCEL from the client raises
     GeneratorExit into the generator."""
+    try:
+        # server-push streams (replication feeds, invalidations,
+        # pub_watch) are one-directional: without NODELAY, Nagle holds
+        # each small frame for the peer's delayed ACK (~40ms/record)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
     try:
         server_handshake(sock, state.secret)
     except (PSAuthError, WireError, ConnectionError, OSError):

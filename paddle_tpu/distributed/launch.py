@@ -205,8 +205,26 @@ def _spawn_children(specs, log_dir):
             for name, env_over, argv in specs]
 
 
+def _build_ha_state(ha_members):
+    """Per-HA-shard failover bookkeeping for _watch: current epoch,
+    which child name is primary, and every member's endpoint. Only
+    shards with standbys participate (single-member shards keep the
+    snapshot-respawn path)."""
+    ha_state, name_shard = {}, {}
+    for i, members in enumerate(ha_members or []):
+        if len(members) < 2:
+            continue
+        st = {"epoch": 1, "primary": f"server.{i}", "members": {}}
+        for j, ep in enumerate(members):
+            name = f"server.{i}" if j == 0 else f"standby.{i}.{j}"
+            st["members"][name] = ep
+            name_shard[name] = i
+        ha_state[i] = st
+    return ha_state, name_shard
+
+
 def _watch(procs, manager=None, specs=None, log_dir=None,
-           rank_names=None):
+           rank_names=None, ha_state=None, name_shard=None):
     """Poll children; on failure or a hung heartbeat kill the rest
     (reference launch.py:214 watch + terminate_local_trainers). Returns
     (rc, needs_restart, offender, reason): the elastic loop in
@@ -224,7 +242,9 @@ def _watch(procs, manager=None, specs=None, log_dir=None,
     killed."""
     specs = specs or {}
     rank_names = rank_names or {}
+    name_shard = name_shard or {}
     slow_reported: set = set()
+    ha_handled: set = set()  # dead HA members deliberately left down
     try:
         while True:
             alive = False
@@ -234,7 +254,18 @@ def _watch(procs, manager=None, specs=None, log_dir=None,
                 if rc is None:
                     alive = True
                 elif rc != 0:
+                    if name in ha_handled:
+                        continue
                     spec = specs.get(name)
+                    shard = name_shard.get(name)
+                    if shard is not None:
+                        done = _ha_member_died(
+                            entry, rc, ha_state[shard], shard, spec,
+                            specs, manager, log_dir, ha_handled)
+                        if done:
+                            alive = True
+                            continue
+                        # shard unrecoverable: fall through to teardown
                     if spec is not None and manager is not None \
                             and (name.startswith("server.")
                                  or name.startswith("replica.")
@@ -270,10 +301,12 @@ def _watch(procs, manager=None, specs=None, log_dir=None,
             # tears servers down once trainers exit)
             worker_rcs = [p.poll() for name, p, _ in procs
                           if not name.startswith("server.")
+                          and not name.startswith("standby.")
                           and not name.startswith("replica.")
                           and name != "telemetry"]
             if worker_rcs and all(rc == 0 for rc in worker_rcs) \
                     and any(name.startswith("server.")
+                            or name.startswith("standby.")
                             or name == "telemetry"
                             for name, _, _ in procs):
                 sys.stderr.write(
@@ -310,6 +343,66 @@ def _watch(procs, manager=None, specs=None, log_dir=None,
                 fh.close()
 
 
+def _ha_member_died(entry, rc, st, shard, spec, specs, manager,
+                    log_dir, ha_handled):
+    """One member of an HA PS shard exited. A dead PRIMARY is fenced
+    out by promoting the most-caught-up live standby with a bumped
+    epoch — failover costs no restart budget and no snapshot replay.
+    The dead member is then respawned as a fresh standby of the
+    current primary (budget-counted); with no budget left the shard
+    keeps running on its survivors. Returns True when the shard is
+    still served (the caller keeps watching), False when it is lost
+    (no live member, no respawn budget) and the job must tear down."""
+    name = entry[0]
+    if name == st["primary"]:
+        from .fleet.runtime.ps_ha import promote_best
+        others = [ep for n, ep in st["members"].items() if n != name]
+        promoted = promote_best(others, st["epoch"] + 1)
+        if promoted is not None:
+            st["epoch"] += 1
+            st["primary"] = next(n for n, ep in st["members"].items()
+                                 if ep == promoted)
+            sys.stderr.write(
+                f"[launch] {name} (PS shard {shard} primary) exited "
+                f"with code {rc}; promoting standby {promoted} "
+                f"(epoch {st['epoch']})\n")
+    shard_alive = st["primary"] != name
+    if spec is not None and manager is not None \
+            and manager.should_restart_server():
+        manager.record_server_restart()
+        env2 = dict(spec[0])
+        if shard_alive:
+            env2["PADDLE_PS_HA_PRIMARY"] = st["members"][st["primary"]]
+            env2.pop("PADDLE_PS_HA_EPOCH", None)
+            what = (f"respawning it as a standby of "
+                    f"{st['members'][st['primary']]}")
+        else:
+            # no standby answered the promotion probe: bring the dead
+            # primary itself back at the current epoch
+            env2.pop("PADDLE_PS_HA_PRIMARY", None)
+            env2["PADDLE_PS_HA_EPOCH"] = str(st["epoch"])
+            what = "restarting it from snapshot"
+        sys.stderr.write(
+            f"[launch] {name} exited with code {rc}; {what} "
+            f"({manager.server_restart_count}/"
+            f"{manager.max_server_restarts})\n")
+        specs[name] = (env2, spec[1])
+        if entry[2]:
+            entry[2].close()
+        entry[:] = _spawn_one(name, env2, spec[1], log_dir)
+        return True
+    if shard_alive:
+        # no respawn budget, but a promoted/live member carries the
+        # shard — leave this member down and keep the job running
+        ha_handled.add(name)
+        sys.stderr.write(
+            f"[launch] {name} exited with code {rc}; shard {shard} "
+            f"continues on {st['members'][st['primary']]} "
+            f"(no respawn budget left)\n")
+        return True
+    return False
+
+
 def _kill_all(procs):
     for _, p, _ in procs:
         if p.poll() is None:
@@ -327,22 +420,42 @@ def launch(argv=None):
     script = [sys.executable, args.training_script] \
         + args.training_script_args
     specs = []
+    ha_members: list[list[str]] = []
     if args.servers or args.workers:
-        # PS mode (fleetrun --servers/--workers)
+        # PS mode (fleetrun --servers/--workers). A server entry may be
+        # a |-joined HA group, primary|standby[|standby2] (docs/
+        # PS_HA.md): member 0 starts as the shard primary, the rest as
+        # hot standbys replicating its WAL. Workers receive the raw
+        # group string and route pushes to ONE active member per shard.
         servers = [e for e in args.servers.split(",") if e]
         workers = [e for e in args.workers.split(",") if e]
-        for i, ep in enumerate(servers):
-            env = get_cluster_env(0, workers or ["127.0.0.1:6170"],
-                                  role="PSERVER", servers=args.servers,
-                                  workers=args.workers)
-            # a server's identity is its OWN endpoint/index, not worker
-            # 0's (the trainer fields above only give servers the cluster
-            # layout)
-            env.update({"PADDLE_CURRENT_ENDPOINT": ep,
-                        "PADDLE_PORT": ep.rsplit(":", 1)[1],
-                        "POD_IP": ep.rsplit(":", 1)[0],
-                        "PADDLE_SERVER_ID": str(i)})
-            specs.append((f"server.{i}", env, script))
+        ha_members = [s.split("|") for s in servers]
+        for i, members in enumerate(ha_members):
+            for j, ep in enumerate(members):
+                env = get_cluster_env(0, workers or ["127.0.0.1:6170"],
+                                      role="PSERVER",
+                                      servers=args.servers,
+                                      workers=args.workers)
+                # a server's identity is its OWN endpoint/index, not
+                # worker 0's (the trainer fields above only give
+                # servers the cluster layout)
+                env.update({"PADDLE_CURRENT_ENDPOINT": ep,
+                            "PADDLE_PORT": ep.rsplit(":", 1)[1],
+                            "POD_IP": ep.rsplit(":", 1)[0],
+                            "PADDLE_SERVER_ID": str(i)})
+                if len(members) > 1:
+                    # HA shard: replication ships WAL records, so the
+                    # row journal is mandatory; the starting primary
+                    # opens at epoch 1 so fencing can tell its zombies
+                    # from a promoted successor
+                    env["PADDLE_PS_WAL"] = "1"
+                    if j == 0:
+                        env["PADDLE_PS_HA_EPOCH"] = "1"
+                    else:
+                        env["PADDLE_PS_HA_PRIMARY"] = members[0]
+                name = f"server.{i}" if j == 0 \
+                    else f"standby.{i}.{j}"
+                specs.append((name, env, script))
         for i, ep in enumerate(workers):
             env = get_cluster_env(i, workers, role="TRAINER",
                                   servers=args.servers,
@@ -421,14 +534,18 @@ def launch(argv=None):
         for _name, env, _argv in specs:
             env["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
     ps_mode = bool(args.servers or args.workers)
+    has_standbys = any(len(m) > 1 for m in ha_members)
     snap_dir = args.ps_snapshot_dir
-    if ps_mode and args.max_restarts > 0 and snap_dir is None:
+    if ps_mode and (args.max_restarts > 0 or has_standbys) \
+            and snap_dir is None:
+        # HA standbys need the WAL tier (replication ships journal
+        # records), and the WAL needs a snapshot dir for its bases
         import tempfile
         snap_dir = tempfile.mkdtemp(prefix="paddle_ps_snap_")
     server_specs = {}
     if snap_dir:
         for name, env, argv in specs:
-            if name.startswith("server."):
+            if name.startswith(("server.", "standby.")):
                 env["PADDLE_PS_SNAPSHOT_DIR"] = snap_dir
                 env["PADDLE_PS_SNAPSHOT_EVERY"] = \
                     str(args.ps_snapshot_every)
@@ -459,10 +576,16 @@ def launch(argv=None):
 
     fail_times: list[float] = []     # monotonic stamps of job failures
     offender_counts: dict[str, int] = {}
+    server_specs0 = dict(server_specs)  # pristine roles per attempt
     while True:
         if hb_dir:  # fresh heartbeat epoch per attempt
             for f in os.listdir(hb_dir):
                 os.unlink(os.path.join(hb_dir, f))
+        # whole-job (re)start resets HA roles: member 0 is primary at
+        # epoch 1 again (the snapshot dir is cleared below, so there
+        # is no prior shard state for a stale epoch to fence)
+        server_specs = dict(server_specs0)
+        ha_state, name_shard = _build_ha_state(ha_members)
         if snap_dir and os.path.isdir(snap_dir):
             # whole-job (re)start: workers replay from scratch with
             # fresh request ids, so a server resuming mid-run tables
@@ -480,7 +603,8 @@ def launch(argv=None):
                                                   sys.exit(143)))
         rc, needs_restart, offender, reason = _watch(
             procs, manager, specs=server_specs, log_dir=args.log_dir,
-            rank_names=_heartbeat_rank_names(specs))
+            rank_names=_heartbeat_rank_names(specs),
+            ha_state=ha_state, name_shard=name_shard)
         if rc == 0 or manager is None or not needs_restart:
             return rc
         if offender is not None:

@@ -49,6 +49,34 @@ def _stop(srv):
     srv.server_close()
 
 
+def _attach_standby(srv, tmp_path):
+    """Hot standby for the replicated=True re-runs (docs/PS_HA.md):
+    the original suites must hold unchanged with a live replication
+    subscriber attached, and the standby must end bit-for-bit."""
+    d = str(tmp_path / "standby")
+    os.makedirs(d, exist_ok=True)
+    stby = PSServer("127.0.0.1:0", snapshot_dir=d, wal=True,
+                    primary=srv.endpoint)
+    stby.serve_in_thread()
+    return stby
+
+
+def _assert_standby_converged(srv, stby, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    rep = stby._ha_replicator
+    while time.monotonic() < deadline:
+        if rep.synced.is_set() and rep.applied_seq >= srv._ha.seq:
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError("standby never caught up")
+    assert set(stby.tables) == set(srv.tables)
+    for n, t in srv.tables.items():
+        a, b = t.export_state(), stby.tables[n].export_state()
+        np.testing.assert_array_equal(a["keys"], b["keys"])
+        np.testing.assert_array_equal(a["rows"], b["rows"])
+
+
 # ---------------------------------------------------------------------------
 # wire format
 # ---------------------------------------------------------------------------
@@ -221,12 +249,18 @@ def test_remote_errors_raise_without_retry():
 # fault injection + exactly-once dedup
 # ---------------------------------------------------------------------------
 
+@pytest.mark.parametrize("replicated", [False, True])
 def test_injected_corruption_retries_and_applies_exactly_once(
-        monkeypatch):
+        replicated, tmp_path, monkeypatch):
     monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
     fi.reset_injector(fi.FaultInjector(corrupt=0.25, side="both",
                                        seed=11))
-    srv = _serve()
+    if replicated:
+        srv = _serve(snapshot_dir=str(tmp_path / "prim"), wal=True)
+        stby = _attach_standby(srv, tmp_path)
+    else:
+        srv = _serve()
+        stby = None
     try:
         cl = PSClient([srv.endpoint], backoff=0.01)
         base = cl.pull("t", 4, [0]).copy()
@@ -238,8 +272,14 @@ def test_injected_corruption_retries_and_applies_exactly_once(
         np.testing.assert_allclose(base - final, float(n), rtol=1e-6)
         assert cl.stats.retries > 0
         assert fi.injector().counters["corrupted"] > 0
+        if stby is not None:
+            # dedup'd retries ship each record once: the standby sees
+            # the exactly-once history, not the retry storm
+            _assert_standby_converged(srv, stby)
         cl.close()
     finally:
+        if stby is not None:
+            _stop(stby)
         _stop(srv)
 
 
@@ -831,14 +871,20 @@ def test_wal_journals_only_touched_rows(tmp_path, monkeypatch):
     srv.server_close()
 
 
-def test_wal_restore_equals_synchronous_state(tmp_path, monkeypatch):
+@pytest.mark.parametrize("replicated", [False, True])
+def test_wal_restore_equals_synchronous_state(replicated, tmp_path,
+                                              monkeypatch):
     """Acceptance: restore = base + WAL replay equals the synchronous
     server state EXACTLY — rows, key order, and the per-table RNG
     stream (rows lazily created after restore must reproduce the
-    original run bit-for-bit)."""
+    original run bit-for-bit). replicated=True re-runs the suite with
+    a hot standby attached: replication must not perturb the journal,
+    and the standby converges to the same state the restart proves."""
     monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
-    srv = PSServer("127.0.0.1:0", snapshot_dir=str(tmp_path), wal=True)
+    srv = PSServer("127.0.0.1:0", snapshot_dir=str(tmp_path / "prim"),
+                   wal=True)
     srv.serve_in_thread()
+    stby = _attach_standby(srv, tmp_path) if replicated else None
     ep = srv.endpoint
     cl = PSClient([ep])
     rng = np.random.RandomState(7)
@@ -848,11 +894,15 @@ def test_wal_restore_equals_synchronous_state(tmp_path, monkeypatch):
     cl.push("wide", 4, [5], rng.randn(1, 4))
     live = {n: t.export_state() for n, t in srv.tables.items()}
     dedup_ids = len(srv._rpc.dedup._order)
+    if stby is not None:
+        _assert_standby_converged(srv, stby)
+        _stop(stby)
     cl.close()
     srv.shutdown()
     srv.server_close()
 
-    srv2 = PSServer.restart_from_snapshot(ep, str(tmp_path), wal=True)
+    srv2 = PSServer.restart_from_snapshot(ep, str(tmp_path / "prim"),
+                                          wal=True)
     rest = {n: t.export_state() for n, t in srv2.tables.items()}
     assert set(live) == set(rest)
     for n in live:

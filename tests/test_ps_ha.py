@@ -1,0 +1,601 @@
+"""PS high availability (robustness tentpole): live WAL replication
+to hot standbys, semi-sync acks, epoch-fenced failover, zombie
+fencing, and zero-downtime shard handoff (docs/PS_HA.md)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.distributed.fleet.runtime import fault_injection as fi
+from paddle_tpu.distributed.fleet.runtime import rpc
+from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+    import PSClient, PSServer
+from paddle_tpu.distributed.fleet.runtime.ps_ha import promote_best
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "ps_fault_server.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset_injector(fi.FaultInjector())
+    yield
+    fi.reset_injector(fi.FaultInjector())
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _primary(tmp_path, name="prim", **kw):
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    srv = PSServer("127.0.0.1:0", snapshot_dir=d, wal=True, **kw)
+    srv.serve_in_thread()
+    return srv
+
+
+def _standby(primary, tmp_path, name="stby", **kw):
+    d = str(tmp_path / name)
+    os.makedirs(d, exist_ok=True)
+    srv = PSServer("127.0.0.1:0", snapshot_dir=d, wal=True,
+                   primary=primary.endpoint, **kw)
+    srv.serve_in_thread()
+    return srv
+
+
+def _stop(srv):
+    srv.shutdown()
+    srv.server_close()
+
+
+def _wait(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _tables_equal(a, b):
+    if set(a.tables) != set(b.tables):
+        return False
+    for n, t in a.tables.items():
+        sa, sb = t.export_state(), b.tables[n].export_state()
+        if not np.array_equal(sa["keys"], sb["keys"]):
+            return False
+        if not np.array_equal(sa["rows"], sb["rows"]):
+            return False
+    return True
+
+
+def _synced(prim, stby):
+    rep = stby._ha_replicator
+    return (rep is not None and rep.synced.is_set()
+            and rep.applied_seq >= prim._ha.seq
+            and _tables_equal(prim, stby))
+
+
+def _status(ep):
+    cl = rpc.RpcClient(ep, timeout=2.0, deadline=3.0, max_retries=0)
+    try:
+        return cl.call({"op": "ha_status"}, timeout=2.0)
+    finally:
+        cl.close()
+
+
+# ---------------------------------------------------------------------------
+# live replication: the standby tracks the primary row-for-row
+# ---------------------------------------------------------------------------
+
+def test_standby_tracks_primary_row_for_row(tmp_path, monkeypatch):
+    """Every committed WAL record (rows + request id + RNG-consuming
+    lazy inits) replays on the standby through the WAL-replay path:
+    tables, per-table RNG streams, and the dedup cache are bitwise
+    identical once the lag drains."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    try:
+        cl = PSClient([prim.endpoint])
+        rng = np.random.RandomState(3)
+        cl.push("emb", 8, np.arange(40), rng.randn(40, 8))
+        cl.pull("emb", 8, [2, 777])        # 777: lazy init, burns RNG
+        cl.push("emb", 8, [3, 9], rng.randn(2, 8))
+        cl.push("wide", 4, [5], rng.randn(1, 4))
+        _wait(lambda: _synced(prim, stby), what="standby catch-up")
+        for n, t in prim.tables.items():
+            a = t.export_state()
+            b = stby.tables[n].export_state()
+            np.testing.assert_array_equal(a["keys"], b["keys"])
+            np.testing.assert_array_equal(a["rows"], b["rows"])
+            ra, rb = a["rng"], b["rng"]
+            assert ra["pos"] == rb["pos"]
+            np.testing.assert_array_equal(ra["key"], rb["key"])
+        # exactly-once state replicated too: same journaled request ids
+        assert len(stby._rpc.dedup._order) == \
+            len(prim._rpc.dedup._order) > 0
+        # fresh rows after the catch-up point draw the SAME init stream
+        np.testing.assert_array_equal(
+            prim.tables["emb"].pull(np.array([888])),
+            stby.tables["emb"].pull(np.array([888])))
+        # lag gauges drain to zero once the ack round-trips
+        _wait(lambda: all(s["lag_rows"] == 0
+                          for s in prim._ha.status()),
+              what="lag drain")
+        assert stby.ha_status()["synced"]
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+def test_standby_redirects_data_plane_ops(tmp_path):
+    """A standby answers only the control plane; pushes/pulls get a
+    not_primary redirect naming the primary and epoch."""
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    try:
+        cl = rpc.RpcClient(stby.endpoint, deadline=5.0, max_retries=0)
+        with pytest.raises(rpc.PSRemoteError,
+                           match="not_primary primary="):
+            cl.call({"op": "pull", "table": "t", "dim": 4,
+                     "keys": np.array([1], np.int64)})
+        st = cl.call({"op": "ha_status"})       # control plane serves
+        assert st["role"] == "standby"
+        assert st["primary"] == prim.endpoint
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+def test_replication_survives_journal_rotation(tmp_path, monkeypatch):
+    """A primary-side WAL compaction ships a rotate marker; the
+    standby re-anchors (compacts its own journal) and keeps tracking —
+    no resync, no divergence."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path)
+    prim.wal_compact_bytes = 1200
+    stby = _standby(prim, tmp_path)
+    try:
+        cl = PSClient([prim.endpoint])
+        for i in range(30):
+            cl.push("t", 4, [i % 7], np.ones((1, 4)))
+        assert prim.full_snapshots >= 1     # rotation happened
+        _wait(lambda: _synced(prim, stby), what="post-rotate sync")
+        assert stby._ha_replicator.resyncs == 0
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# ack modes: semi-sync holds the push reply, degrades on standby death
+# ---------------------------------------------------------------------------
+
+def test_semisync_acks_with_live_standby_and_degrades(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_HA_SEMISYNC", "1")
+    monkeypatch.setenv("PADDLE_PS_HA_SEMISYNC_TIMEOUT", "1.0")
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    try:
+        assert prim._ha.semisync == 1
+        cl = PSClient([prim.endpoint])
+        _wait(lambda: stby._ha_replicator.synced.is_set(),
+              what="standby bootstrap")
+        for _ in range(5):
+            cl.push("t", 4, [1], np.ones((1, 4)))
+        # live standby acked every record: no degradation, and the
+        # acked record is genuinely ON the standby
+        assert prim._ha.degraded == 0
+        _wait(lambda: _synced(prim, stby), what="semisync catch-up")
+
+        stby.kill()                         # standby dies
+        t0 = time.monotonic()
+        cl.push("t", 4, [1], np.ones((1, 4)))
+        elapsed = time.monotonic() - t0
+        # degraded to async (counted) instead of stalling the trainer
+        assert prim._ha.degraded >= 1
+        assert elapsed < 10.0
+        assert prim.ha_status()["semisync_degraded"] >= 1
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: a zombie ex-primary can never fork the shard
+# ---------------------------------------------------------------------------
+
+def test_zombie_primary_fences_itself(tmp_path, monkeypatch):
+    """A partitioned ex-primary that sees one request carrying a newer
+    epoch fences permanently: even epochless legacy writes bounce with
+    stale_epoch, and the group client fails over to the successor."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path, ha_epoch=1)
+    stby = _standby(prim, tmp_path)
+    try:
+        seed = PSClient([prim.endpoint])
+        seed.push("t", 4, [0], np.ones((1, 4)))
+        _wait(lambda: _synced(prim, stby), what="standby catch-up")
+        seed.close()
+        # failover elsewhere promoted the standby; the old primary is
+        # now a zombie that never noticed
+        assert promote_best([stby.endpoint], epoch=2) == stby.endpoint
+
+        direct = rpc.RpcClient(prim.endpoint, deadline=5.0,
+                               max_retries=0)
+        with pytest.raises(rpc.PSRemoteError, match="stale_epoch"):
+            direct.call({"op": "push", "table": "t", "dim": 4,
+                         "keys": np.array([0], np.int64),
+                         "grads": np.ones((1, 4), np.float32),
+                         "lr": 1.0, "_epoch": 2})
+        assert prim._ha_fenced
+        # the fence latches: an epochless write is rejected too
+        with pytest.raises(rpc.PSRemoteError, match="stale_epoch"):
+            direct.call({"op": "push", "table": "t", "dim": 4,
+                         "keys": np.array([0], np.int64),
+                         "grads": np.ones((1, 4), np.float32),
+                         "lr": 1.0})
+        direct.close()
+
+        # a group client that still targets the zombie rides the
+        # stale_epoch answer to the successor primary
+        cl = PSClient([f"{prim.endpoint}|{stby.endpoint}"],
+                      deadline=30.0, backoff=0.02)
+        cl.push("t", 4, [0], np.ones((1, 4)))
+        assert cl.fenced_rejects >= 1
+        assert cl.failovers == 1
+        assert cl.endpoints[0] == stby.endpoint
+        np.testing.assert_allclose(
+            stby.tables["t"].export_state()["rows"][0].sum(),
+            prim.tables["t"].export_state()["rows"][0].sum() - 4.0,
+            rtol=1e-6)
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: kill the primary mid-push under concurrent pushes,
+# live serving traffic, and a hot-row invalidation subscription —
+# promoted standby serves, exactly-once bit-for-bit vs fault-free
+# ---------------------------------------------------------------------------
+
+def test_chaos_kill_primary_mid_push_bit_for_bit(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    # semi-sync: every acked push is provably on the standby before
+    # the reply, so a primary kill can lose only UNACKED pushes — the
+    # clients still hold those and replay them with the same ids
+    monkeypatch.setenv("PADDLE_PS_HA_SEMISYNC", "1")
+    monkeypatch.setenv("PADDLE_PS_HA_SEMISYNC_TIMEOUT", "10.0")
+    dim, n_workers, n_pushes = 4, 3, 30
+    rngs = [np.random.RandomState(100 + w) for w in range(n_workers)]
+    grads = [[rngs[w].randn(2, dim).astype(np.float32)
+              for _ in range(n_pushes)] for w in range(n_workers)]
+
+    def seed_tables(cl):
+        for w in range(n_workers):
+            cl.push(f"t{w}", dim, np.arange(10),
+                    np.zeros((10, dim), np.float32))
+
+    def worker(cl, w, errs):
+        try:
+            for k in range(n_pushes):
+                cl.push(f"t{w}", dim, [k % 10, (k * 3 + 1) % 10],
+                        grads[w][k], lr=1.0)
+        except Exception as e:              # pragma: no cover
+            errs.append(e)
+
+    def collect(cl):
+        return [cl.pull(f"t{w}", dim, np.arange(10)).copy()
+                for w in range(n_workers)]
+
+    # -- fault-free reference: same per-table push sequences ----------
+    monkeypatch.delenv("PADDLE_PS_HA_SEMISYNC")
+    ref_srv = PSServer("127.0.0.1:0")
+    ref_srv.serve_in_thread()
+    ref_cl = PSClient([ref_srv.endpoint])
+    seed_tables(ref_cl)
+    for w in range(n_workers):
+        worker(ref_cl, w, [])
+    ref = collect(ref_cl)
+    ref_cl.close()
+    _stop(ref_srv)
+
+    # -- chaos run ----------------------------------------------------
+    monkeypatch.setenv("PADDLE_PS_HA_SEMISYNC", "1")
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    cl = PSClient([f"{prim.endpoint}|{stby.endpoint}"],
+                  deadline=60.0, backoff=0.02)
+    inval_events: list = []
+    inval_stop = cl.subscribe_invalidations(
+        lambda table, keys: inval_events.append(table))
+    serve_errs: list = []
+    push_errs: list = []
+    stop_serving = threading.Event()
+
+    def serving():
+        # live read traffic across the failover window
+        while not stop_serving.is_set():
+            try:
+                cl.pull("t0", dim, np.arange(10))
+            except Exception as e:          # pragma: no cover
+                serve_errs.append(e)
+                return
+            time.sleep(0.002)
+
+    try:
+        # seed only once the standby's feed is attached: a semi-sync
+        # push with NO subscriber degrades immediately by design (the
+        # bootstrap still covers it), which would muddy the
+        # degradation-free window asserted below
+        _wait(lambda: len(prim._ha.status()) > 0,
+              what="standby attach")
+        seed_tables(cl)
+        _wait(lambda: _synced(prim, stby), what="standby seed sync")
+        base_degraded = prim._ha.degraded
+        server_thread = threading.Thread(target=serving)
+        server_thread.start()
+        threads = [threading.Thread(target=worker,
+                                    args=(cl, w, push_errs))
+                   for w in range(n_workers)]
+        for t in threads:
+            t.start()
+        # kill the primary mid-stream, at the hardest point: pushes in
+        # flight on every worker
+        _wait(lambda: prim._mutations > 25, what="pushes in flight")
+        degraded_before_kill = prim._ha.degraded
+        prim.kill()
+        promoted = promote_best([stby.endpoint], epoch=2)
+        assert promoted == stby.endpoint
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "push hang"
+        stop_serving.set()
+        server_thread.join(timeout=30)
+
+        assert not push_errs, push_errs
+        assert not serve_errs, serve_errs
+        # no semisync degradation in the synced-standby window before
+        # the kill: every acked push was on the standby, the
+        # precondition for bit-for-bit
+        assert degraded_before_kill == base_degraded
+        assert cl.failovers >= 1
+        assert stby.ha_role == "primary" and stby.shard_epoch == 2
+        assert inval_events, "invalidation stream saw no pushes"
+        final = collect(cl)
+        for w in range(n_workers):
+            np.testing.assert_array_equal(
+                ref[w], final[w],
+                err_msg=f"t{w} diverged — exactly-once violated "
+                        "across failover")
+    finally:
+        stop_serving.set()
+        inval_stop.set()
+        cl.close()
+        _stop(stby)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# planned handoff: drain -> catch-up -> epoch flip, zero failed pushes
+# ---------------------------------------------------------------------------
+
+def test_planned_handoff_zero_failed_pushes(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    cl = PSClient([f"{prim.endpoint}|{stby.endpoint}"],
+                  deadline=60.0, backoff=0.02)
+    errs: list = []
+    n = 60
+    handoff_at = threading.Event()
+
+    def pusher():
+        try:
+            for k in range(n):
+                cl.push("t", 4, [0], np.ones((1, 4)), lr=1.0)
+                if k == 15:
+                    handoff_at.set()
+        except Exception as e:              # pragma: no cover
+            errs.append(e)
+
+    try:
+        base = cl.pull("t", 4, [0]).copy()
+        _wait(lambda: _synced(prim, stby), what="standby catch-up")
+        th = threading.Thread(target=pusher)
+        th.start()
+        assert handoff_at.wait(timeout=60)
+        ctl = rpc.RpcClient(prim.endpoint, timeout=60.0,
+                            deadline=90.0, max_retries=0)
+        rep = ctl.call({"op": "ha_handoff", "target": stby.endpoint},
+                       timeout=60.0)
+        ctl.close()
+        assert rep["promoted"] == stby.endpoint
+        assert rep["epoch"] == 1
+        th.join(timeout=120)
+        assert not th.is_alive(), "pusher hung across handoff"
+        # ZERO failed pushes, each applied exactly once
+        assert not errs, errs
+        final = cl.pull("t", 4, [0])
+        np.testing.assert_allclose(base - final, float(n), rtol=1e-6)
+        assert cl.redirects >= 1
+        # roles flipped; the ex-primary is now the shard's hot spare
+        assert stby.ha_role == "primary"
+        assert prim.ha_role == "standby"
+        assert prim.ha_primary == stby.endpoint
+        # and it tracks the new primary bit-for-bit
+        _wait(lambda: _synced(stby, prim), what="ex-primary re-sync")
+        cl.close()
+    finally:
+        _stop(prim)
+        _stop(stby)
+
+
+# ---------------------------------------------------------------------------
+# replication-stream fault injection (satellite): drop -> gap resync,
+# corrupt -> CRC resync, delay -> lag only
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("action", ["drop", "corrupt", "delay"])
+def test_repl_fault_resyncs_standby(action, tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path)
+    stby = _standby(prim, tmp_path)
+    try:
+        cl = PSClient([prim.endpoint])
+        cl.push("t", 4, [0], np.ones((1, 4)))
+        _wait(lambda: _synced(prim, stby), what="standby catch-up")
+        fi.injector().set_repl_fault(action, record="any", delay=0.3)
+        cl.push("t", 4, [1], np.ones((1, 4)))   # the faulted record
+        cl.push("t", 4, [2], np.ones((1, 4)))   # exposes a drop gap
+        _wait(lambda: _synced(prim, stby),
+              what=f"recovery from {action}", timeout=30.0)
+        assert fi.injector().counters["repl_faults"] == 1
+        rep = stby._ha_replicator
+        if action == "delay":
+            # a held-back record is just lag — no resync
+            assert rep.resyncs == 0
+        else:
+            # gap / CRC mismatch tears the stream down; the fresh
+            # bootstrap restores bit-identical state (asserted above)
+            assert rep.resyncs >= 1
+            assert stby.ha_status()["resyncs"] >= 1
+        cl.close()
+    finally:
+        _stop(stby)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# deterministic standby death (satellite): kill-at-record-N in a real
+# subprocess, then a respawned standby resyncs and can be promoted
+# ---------------------------------------------------------------------------
+
+def _spawn_standby(ep, snap_dir, primary_ep, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PS_ENDPOINT"] = ep
+    env["PADDLE_PS_SNAPSHOT_DIR"] = snap_dir
+    env["PADDLE_PS_WAL"] = "1"
+    env["PADDLE_PS_HA_PRIMARY"] = primary_ep
+    env.update(extra_env or {})
+    p = subprocess.Popen([sys.executable, FIXTURE], env=env,
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True)
+    ready = json.loads(p.stdout.readline())
+    return p, ready
+
+
+@pytest.mark.slow
+def test_kill_standby_at_record_subprocess(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = _primary(tmp_path)
+    stby_ep = f"127.0.0.1:{_free_port()}"
+    snap = str(tmp_path / "stby_sub")
+    os.makedirs(snap, exist_ok=True)
+    p, _ = _spawn_standby(stby_ep, snap, prim.endpoint, extra_env={
+        "PADDLE_PS_FAULT_KILL_AT_RECORD": "3"})
+    p2 = None
+    try:
+        # records only count against the kill threshold once they ride
+        # the live stream (the bootstrap is one blob): wait for attach
+        _wait(lambda: len(prim._ha.status()) > 0,
+              what="subprocess standby attach")
+        cl = PSClient([prim.endpoint])
+        rng = np.random.RandomState(0)
+        for i in range(6):
+            cl.push("t", 4, [i], rng.randn(1, 4))
+        # the standby applied its 3rd replicated record and died the
+        # deterministic death (os._exit, a SIGKILL stand-in)
+        assert p.wait(timeout=60) == fi.KILL_EXIT_CODE
+        ref = cl.pull("t", 4, np.arange(6)).copy()
+
+        # respawn: fresh bootstrap resync, then promotion serves the
+        # identical rows
+        p2, _ = _spawn_standby(stby_ep, snap, prim.endpoint)
+
+        def caught_up():
+            try:
+                st = _status(stby_ep)
+            except Exception:
+                return False
+            return st.get("synced") \
+                and st.get("applied_seq", -1) >= prim._ha.seq
+        _wait(caught_up, timeout=30.0, what="respawned standby sync")
+        ctl = rpc.RpcClient(stby_ep, deadline=10.0, max_retries=1)
+        st = ctl.call({"op": "ha_promote", "epoch": 2}, timeout=5.0)
+        ctl.close()
+        assert st["role"] == "primary" and st["epoch"] == 2
+        cl2 = PSClient([stby_ep])
+        np.testing.assert_array_equal(
+            cl2.pull("t", 4, np.arange(6)), ref)
+        cl2.close()
+        cl.close()
+    finally:
+        for proc in (p, p2):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        _stop(prim)
+
+
+# ---------------------------------------------------------------------------
+# observability + lock-order hygiene
+# ---------------------------------------------------------------------------
+
+def test_ha_metrics_registered():
+    from paddle_tpu.observability.registry import REGISTRY
+    for name in ("paddle_tpu_ps_ha_role",
+                 "paddle_tpu_ps_ha_epoch",
+                 "paddle_tpu_ps_ha_standbys_connected",
+                 "paddle_tpu_ps_ha_replication_lag_rows",
+                 "paddle_tpu_ps_ha_replication_lag_bytes",
+                 "paddle_tpu_ps_ha_replication_lag_seconds",
+                 "paddle_tpu_ps_ha_records_shipped_total",
+                 "paddle_tpu_ps_ha_semisync_total",
+                 "paddle_tpu_ps_ha_fenced_writes_total",
+                 "paddle_tpu_ps_ha_promotions_total",
+                 "paddle_tpu_ps_ha_handoffs_total",
+                 "paddle_tpu_ps_ha_resyncs_total"):
+        assert REGISTRY.get(name) is not None, name
+
+
+@pytest.mark.slow
+def test_ps_ha_module_clean_under_lockcheck():
+    """The replication hub adds real multi-lock surface (order lock +
+    apply lock + hub condition + RPC state): re-run this module's
+    in-process tests with every paddle_tpu lock order-checked."""
+    if os.environ.get("PADDLE_TPU_LOCKCHECK") == "1":
+        pytest.skip("already running under the sanitizer")
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "test_ps_ha.py"),
+         "-q", "-x", "-k",
+         "not subprocess and not lockcheck and not chaos",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, \
+        res.stdout[-4000:] + res.stderr[-2000:]
