@@ -1836,6 +1836,101 @@ def bench_ps_ha(n_rows=4096, dim=32, batch=64, lat_pushes=150,
             "lat_pushes": lat_pushes, "stream_pushes": stream_pushes}
 
 
+def bench_tiered(vocab=1 << 26, dim=8, batch=256, train_steps=400,
+                 serve_steps=400, warm_budget=256 * 1024, seed=0):
+    """BENCH_CONFIG=tiered (docs/PS_TIERED.md): widedeep-style
+    training + serving against a 2^26-row embedding vocab on a tiered
+    parameter server whose warm budget is a tiny fraction of the
+    touched bytes. Ids follow a zipf(1.2) skew, so the hot head lives
+    warm and the long tail demand-pages from the chunk store.
+
+    Headline = serving-phase p99 pull latency (the SLO number a
+    lookup service sees when the tail faults cold rows in). Also
+    records per-tier hit rates, demotion counts, warm residency vs
+    budget after a drain, and client-observed cold-fault totals."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+        import PSClient, PSServer
+
+    root = tempfile.mkdtemp(prefix="bench_tiered_")
+    rng = np.random.default_rng(seed)
+    try:
+        srv = PSServer("127.0.0.1:0", wal=True,
+                       snapshot_dir=os.path.join(root, "snap"),
+                       tier_warm_bytes=warm_budget,
+                       tier_store_dir=os.path.join(root, "store"))
+        srv.serve_in_thread()
+        cl = PSClient([srv.endpoint])
+
+        def ids_for(step):
+            # zipf rank -> id directly: rank 1 is the hottest row and
+            # stays hot across steps, so the head settles warm while
+            # the tail keeps faulting from the chunk store.
+            return (rng.zipf(1.2, batch).astype(np.int64) - 1) % vocab
+
+        # -- train: pull + push per step ------------------------------
+        t0 = time.perf_counter()
+        for step in range(train_steps):
+            ids = ids_for(step)
+            v = cl.pull("emb", dim, ids)
+            cl.push("emb", dim, ids, 0.01 * v)
+        train_s = time.perf_counter() - t0
+        train_faults = cl.cold_faults
+
+        # -- serve: pulls only, timed per call ------------------------
+        lats = []
+        for step in range(serve_steps):
+            ids = ids_for(train_steps + step)
+            t1 = time.perf_counter()
+            cl.pull("emb", dim, ids)
+            lats.append(time.perf_counter() - t1)
+        serve_faults = cl.cold_faults - train_faults
+
+        t = srv.tables["emb"]
+        t.drain()
+        st = t.stats()
+        warm_after_drain = t.warm_resident_bytes()
+        touched = st["warm_rows"] + st["cold_rows"]
+        lookups = st["warm_hits"] + st["cold_faults"]
+        hit_warm = (st["warm_hits"] / lookups) if lookups else 0.0
+        cl.close()
+        srv.kill()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    lats.sort()
+    p50 = lats[len(lats) // 2]
+    p99 = lats[min(int(len(lats) * 0.99), len(lats) - 1)]
+    steps_s = (train_steps + serve_steps) / (
+        train_s + sum(lats)) if lats else 0.0
+    return {"metric": "ps_tier_serve_pull_p99_ms",
+            "value": round(p99 * 1e3, 4),
+            "unit": "ms",
+            "serve_pull_p50_ms": round(p50 * 1e3, 4),
+            "train_examples_per_s": round(
+                train_steps * batch / train_s, 1),
+            "steps_per_s": round(steps_s, 1),
+            "vocab_rows": vocab,
+            "touched_rows": touched,
+            "warm_budget_bytes": warm_budget,
+            "warm_resident_bytes": warm_after_drain,
+            "warm_under_budget": bool(warm_after_drain <= warm_budget),
+            "warm_hit_rate": round(hit_warm, 4),
+            "cold_fault_rate": round(1.0 - hit_warm, 4),
+            "warm_rows": st["warm_rows"],
+            "cold_rows": st["cold_rows"],
+            "segments": st["segments"],
+            "demoted_clean": st["demoted_clean"],
+            "demoted_flush": st["demoted_flush"],
+            "cold_read_errors": st["cold_read_errors"],
+            "client_cold_faults_train": int(train_faults),
+            "client_cold_faults_serve": int(serve_faults),
+            "dim": dim, "batch": batch,
+            "train_steps": train_steps, "serve_steps": serve_steps}
+
+
 def bench_infer_latency(batch=1, seq=128, steps=30, warmup=5):
     """BERT-base inference latency through the Predictor (analysis
     predictor parity path): save -> load -> timed ZeroCopyRun.
@@ -2095,6 +2190,8 @@ def main():
         rec = bench_online()
     elif which == "ps_ha":
         rec = bench_ps_ha()
+    elif which == "tiered":
+        rec = bench_tiered()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,

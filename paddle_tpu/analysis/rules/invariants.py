@@ -288,6 +288,19 @@ REQUIRED_METRICS = {
     "paddle_tpu_ps_ha_promotions_total",
     "paddle_tpu_ps_ha_handoffs_total",
     "paddle_tpu_ps_ha_resyncs_total",
+    # tiered embedding store (docs/PS_TIERED.md): per-tier hit/miss
+    # and residency, demand-page faults, demotions, cold-read errors
+    # and the by-tier pull latency histogram are the tier hierarchy's
+    # acceptance contract — the tiered bench and the collector/top
+    # tier pane read these exact names
+    "paddle_tpu_ps_tier_hits_total",
+    "paddle_tpu_ps_tier_misses_total",
+    "paddle_tpu_ps_tier_resident_rows",
+    "paddle_tpu_ps_tier_resident_bytes",
+    "paddle_tpu_ps_tier_faults_total",
+    "paddle_tpu_ps_tier_demotions_total",
+    "paddle_tpu_ps_tier_cold_read_errors_total",
+    "paddle_tpu_ps_tier_pull_seconds",
 }
 
 

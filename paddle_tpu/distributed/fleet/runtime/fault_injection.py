@@ -89,6 +89,25 @@ the primary->standby WAL replication stream instead of the RPC frames.
 Like the frame fault, the replication fault fires ONCE; re-arm with
 ``set_repl_fault``.
 
+Cold-tier faults (tiered embedding store, docs/PS_TIERED.md): target
+one demand-paged read from the cold chunk store instead of the wire.
+
+  PADDLE_PS_FAULT_COLD_ACTION=delay|error   what to do to ONE matched
+                                cold-tier read: hold it back
+                                COLD_DELAY seconds (slow chunk store),
+                                or fail it (ColdReadError — the server
+                                answers THAT pull with a retryable
+                                error and nothing else wedges)
+  PADDLE_PS_FAULT_COLD_TABLE=name  match: a table name, or "any"
+                                (default any)
+  PADDLE_PS_FAULT_COLD_ROW=key  match: a row key the faulting read
+                                must include, or "any" (default any)
+  PADDLE_PS_FAULT_COLD_DELAY=sec   hold-back for action=delay
+                                (default 0.2)
+
+Like the others, the cold fault fires ONCE; re-arm with
+``set_cold_fault``.
+
 A PADDLE_PS_FAULT_-prefixed env var that is NOT one of the above is a
 typo (a chaos drill that silently injects nothing is worse than one
 that fails loudly): `from_env` logs a warning naming it.
@@ -122,6 +141,8 @@ KNOWN_FAULT_KNOBS = frozenset({
     "PADDLE_PS_FAULT_FRAME_REQ", "PADDLE_PS_FAULT_FRAME_DELAY",
     "PADDLE_PS_FAULT_REPL_ACTION", "PADDLE_PS_FAULT_REPL_RECORD",
     "PADDLE_PS_FAULT_KILL_AT_RECORD",
+    "PADDLE_PS_FAULT_COLD_ACTION", "PADDLE_PS_FAULT_COLD_TABLE",
+    "PADDLE_PS_FAULT_COLD_ROW", "PADDLE_PS_FAULT_COLD_DELAY",
 })
 
 logger = logging.getLogger(__name__)
@@ -140,7 +161,9 @@ class FaultInjector:
                  frame_action: str = "", frame_req: str = "",
                  frame_delay: float = 0.2,
                  repl_action: str = "", repl_record: str = "any",
-                 kill_at_record: int = 0):
+                 kill_at_record: int = 0,
+                 cold_action: str = "", cold_table: str = "any",
+                 cold_row: str = "any", cold_delay: float = 0.2):
         self.drop = drop
         self.delay = delay
         self.truncate = truncate
@@ -160,6 +183,11 @@ class FaultInjector:
         self.repl_record = repl_record
         self.kill_at_record = kill_at_record
         self._repl_fired = False
+        self.cold_action = cold_action
+        self.cold_table = cold_table
+        self.cold_row = cold_row
+        self.cold_delay = cold_delay
+        self._cold_fired = False
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
         self._requests = 0
@@ -167,7 +195,7 @@ class FaultInjector:
         self.counters = {"dropped": 0, "delayed": 0, "truncated": 0,
                          "corrupted": 0, "requests": 0, "bytes": 0,
                          "stalled": 0, "frame_faults": 0,
-                         "repl_faults": 0}
+                         "repl_faults": 0, "cold_faults": 0}
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
@@ -204,7 +232,12 @@ class FaultInjector:
             repl_record=e("PADDLE_PS_FAULT_REPL_RECORD", "any")
             or "any",
             kill_at_record=int(
-                e("PADDLE_PS_FAULT_KILL_AT_RECORD", "0") or 0))
+                e("PADDLE_PS_FAULT_KILL_AT_RECORD", "0") or 0),
+            cold_action=e("PADDLE_PS_FAULT_COLD_ACTION", "") or "",
+            cold_table=e("PADDLE_PS_FAULT_COLD_TABLE", "any") or "any",
+            cold_row=e("PADDLE_PS_FAULT_COLD_ROW", "any") or "any",
+            cold_delay=float(
+                e("PADDLE_PS_FAULT_COLD_DELAY", "0.2") or 0.2))
 
     @property
     def active(self) -> bool:
@@ -212,7 +245,8 @@ class FaultInjector:
                     or self.corrupt or self.kill_after
                     or self.kill_after_bytes or self.kill_at_step >= 0
                     or self.stall or self.frame_action
-                    or self.repl_action or self.kill_at_record)
+                    or self.repl_action or self.kill_at_record
+                    or self.cold_action)
 
     def _applies(self, side: str | None) -> bool:
         return self.side == "both" or side is None or side == self.side
@@ -282,6 +316,40 @@ class FaultInjector:
             self._repl_fired = True
             self.counters["repl_faults"] += 1
             return self.repl_action, self.frame_delay
+
+    # -- cold-tier faults (tiered store, docs/PS_TIERED.md) --------------
+    def set_cold_fault(self, action: str, table: str = "any",
+                       row: str = "any", delay: float = 0.2):
+        """(Re)arm a one-shot fault against a single cold-tier read.
+        `table` matches a table name or "any"; `row` matches a key the
+        faulting read must include, or "any"."""
+        with self._lock:
+            self.cold_action = action
+            self.cold_table = str(table)
+            self.cold_row = str(row)
+            self.cold_delay = delay
+            self._cold_fired = False
+
+    def cold_fault(self, table: str,
+                   keys) -> tuple[str, float] | None:
+        """One-shot fault check for one cold-tier read. Returns None
+        (read normally) or (action, delay_seconds) with action in
+        {"delay", "error"} — consumed by the first matching read."""
+        if not self.cold_action:
+            return None
+        with self._lock:
+            if self._cold_fired:
+                return None
+            if self.cold_table not in ("", "any") \
+                    and str(table) != self.cold_table:
+                return None
+            if self.cold_row not in ("", "any"):
+                want = int(self.cold_row)
+                if not any(int(k) == want for k in keys):
+                    return None
+            self._cold_fired = True
+            self.counters["cold_faults"] += 1
+            return self.cold_action, self.cold_delay
 
     def maybe_kill_at_record(self, n: int):
         """Standby kill switch: dies (os._exit, a SIGKILL stand-in)

@@ -439,7 +439,10 @@ class PSServer(socketserver.ThreadingTCPServer):
                  publish_every_seconds: float | None = None,
                  publish_every_rows: int | None = None,
                  primary: str | None = None,
-                 ha_epoch: int | None = None):
+                 ha_epoch: int | None = None,
+                 tier_warm_bytes: int | None = None,
+                 tier_store_dir: str | None = None,
+                 tier_tables=None):
         host, port = endpoint.rsplit(":", 1)
         self.tables: dict[str, LargeScaleKV] = {}
         self._tables_lock = threading.Lock()
@@ -493,6 +496,35 @@ class PSServer(socketserver.ThreadingTCPServer):
                 "(PADDLE_PS_SNAPSHOT_DIR) for its base snapshots")
         self._wal = None
         self._wal_pending = False
+        # tiered embedding store (docs/PS_TIERED.md): opt-in per
+        # server; tables named in tier_tables (every table when empty)
+        # hold warm rows in RAM under the byte budget and demand-page
+        # cold rows from a local chunk store. Snapshots/WAL/HA are
+        # unchanged: TieredTable exports materialize cold rows, so
+        # every downstream consumer sees flat keys/rows state.
+        self.tier_warm_bytes = int(
+            tier_warm_bytes if tier_warm_bytes is not None
+            else env("PADDLE_PS_TIER_WARM_BYTES", "0") or 0)
+        tt = tier_tables if tier_tables is not None \
+            else env("PADDLE_PS_TIER_TABLES", "")
+        self.tier_tables = {s.strip() for s in tt.split(",")
+                            if s.strip()} \
+            if isinstance(tt, str) else set(tt)
+        self.tier_store_dir = tier_store_dir \
+            if tier_store_dir is not None \
+            else (env("PADDLE_PS_TIER_STORE_DIR") or None)
+        if self.tier_warm_bytes > 0 and not self.tier_store_dir:
+            if not self.snapshot_dir:
+                raise ValueError(
+                    "PADDLE_PS_TIER_WARM_BYTES needs a cold-store "
+                    "dir (PADDLE_PS_TIER_STORE_DIR, or a snapshot "
+                    "dir to default under)")
+            self.tier_store_dir = os.path.join(self.snapshot_dir,
+                                               "tier_store")
+        self.tier_demote_interval = float(
+            env("PADDLE_PS_TIER_DEMOTE_INTERVAL", "0.05") or 0)
+        self._tier_store = None  # lazy CheckpointStore
+        self._tier_lock = threading.Lock()
         # high-availability plane (docs/PS_HA.md): a shard started
         # with a primary endpoint is a hot STANDBY — it rejects normal
         # traffic with not_primary and tracks the primary row-for-row
@@ -849,6 +881,19 @@ class PSServer(socketserver.ThreadingTCPServer):
                 self._wal_pending = True
             raise
 
+    def _tier_pull(self, t, keys):
+        """Pull with cold-fault accounting: a tiered table reports how
+        many rows it demand-paged, and a faulting reply is wrapped
+        ``{"v": rows, "cold_faults": n}`` (the replay-gate dict-reply
+        precedent) so PSClient can count cold faults per pull."""
+        pull_ex = getattr(t, "pull_ex", None)
+        if pull_ex is None:
+            return t.pull(keys)
+        out, faults = pull_ex(keys)
+        if faults:
+            return {"v": out, "cold_faults": int(faults)}
+        return out
+
     def _wal_pull(self, req: dict):
         """WAL-mode pull. Hot path (every key already has a row): only
         the per-table lock, same as non-WAL mode. A pull that must
@@ -860,11 +905,14 @@ class PSServer(socketserver.ThreadingTCPServer):
                        req.get("init_std", 0.01))
         probe = t.missing_keys(req["keys"])
         if probe is not None and len(probe) == 0:
-            return t.pull(req["keys"])
+            # cold faults (tiered tables) happen HERE, off the apply
+            # lock — paging in an existing row creates nothing and
+            # consumes no RNG, so it needs no journaling
+            return self._tier_pull(t, req["keys"])
         with self._apply_lock:
             missing = t.missing_keys(req["keys"])  # re-check under lock
             n0 = t.size()
-            out = t.pull(req["keys"])
+            out = self._tier_pull(t, req["keys"])
             if missing is not None:
                 created = missing
             elif t.size() != n0:  # native: no membership probe —
@@ -1051,6 +1099,14 @@ class PSServer(socketserver.ThreadingTCPServer):
                 self._deltas_since_base += 1
                 self.delta_snapshots += 1
             self.snapshots_taken += 1
+        if do_full and self._tier_store is not None:
+            # fold the cold store's garbage in with base compaction:
+            # chunks no live segment references (age-guarded, so a
+            # segment mid-write is never collected) are dropped here
+            from .tiered_store import gc_cold_store
+            with self._tables_lock:
+                ts = list(self.tables.values())
+            gc_cold_store(self._tier_store, ts)
         dt = time.perf_counter() - t0
         nbytes = sum(a.nbytes for a in arrays.values())
         _SNAPSHOT_SECONDS.labels(kind=kind).observe(dt)
@@ -1171,9 +1227,9 @@ class PSServer(socketserver.ThreadingTCPServer):
 
         tables: dict[str, LargeScaleKV] = {}
         for name, tmeta in meta["tables"].items():
-            t = LargeScaleKV(int(tmeta["dim"]),
-                             init_std=float(tmeta["init_std"]),
-                             seed=int(tmeta["seed"]))
+            t = self._make_table(name, int(tmeta["dim"]),
+                                 init_std=float(tmeta["init_std"]),
+                                 seed=int(tmeta["seed"]))
             st = {"dim": tmeta["dim"],
                   "init_std": tmeta["init_std"],
                   "seed": tmeta["seed"],
@@ -1193,9 +1249,15 @@ class PSServer(socketserver.ThreadingTCPServer):
             off += n
         with self._tables_lock:
             if replace:
-                self.tables = tables
+                old, self.tables = self.tables, tables
             else:
+                old = {}
                 self.tables.update(tables)
+        for t in old.values():
+            # replaced tiered tables must stop their demoter threads
+            close = getattr(t, "close", None)
+            if close is not None:
+                close()
         self._rpc.dedup.import_(ids, blobs)
         with self._snap_lock:
             self._mutations = int(meta.get("mutations", 0))
@@ -1212,6 +1274,12 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def server_close(self):
         self._snap_stop.set()
+        with self._tables_lock:
+            ts = list(self.tables.values())
+        for t in ts:
+            close = getattr(t, "close", None)
+            if close is not None:
+                close()  # stop tiered tables' demoter threads
         rep = self._ha_replicator
         if rep is not None:
             rep.close()
@@ -1236,11 +1304,35 @@ class PSServer(socketserver.ThreadingTCPServer):
         self.shutdown()
         self.server_close()
 
+    def _tier_store_handle(self):
+        """Lazy shared CheckpointStore for every tiered table's cold
+        segments (content-addressed chunks dedup across tables)."""
+        with self._tier_lock:
+            if self._tier_store is None:
+                from ....checkpoint.store import CheckpointStore
+                os.makedirs(self.tier_store_dir, exist_ok=True)
+                self._tier_store = CheckpointStore(self.tier_store_dir,
+                                                   keep=0)
+            return self._tier_store
+
+    def _make_table(self, name: str, dim: int, init_std: float = 0.01,
+                    seed: int = 0) -> LargeScaleKV:
+        if self.tier_warm_bytes > 0 and (
+                not self.tier_tables or name in self.tier_tables):
+            from .tiered_store import TieredTable
+            return TieredTable(
+                dim, init_std=init_std, seed=seed,
+                store=self._tier_store_handle(), name=name,
+                warm_bytes=self.tier_warm_bytes,
+                demote_interval=self.tier_demote_interval)
+        return LargeScaleKV(dim, init_std=init_std, seed=seed)
+
     def table(self, name: str, dim: int,
               init_std: float = 0.01) -> LargeScaleKV:
         with self._tables_lock:
             if name not in self.tables:
-                self.tables[name] = LargeScaleKV(dim, init_std=init_std)
+                self.tables[name] = self._make_table(name, dim,
+                                                     init_std)
             return self.tables[name]
 
     def _mark_dirty(self, name: str):
@@ -1641,7 +1733,7 @@ class PSServer(socketserver.ThreadingTCPServer):
             t = self.table(req["table"], req["dim"],
                            req.get("init_std", 0.01))
             n0 = t.size()
-            out = t.pull(req["keys"])
+            out = self._tier_pull(t, req["keys"])
             if self.snapshot_dir and t.size() != n0:
                 # lazy row init consumed the table's rng stream — the
                 # next delta must carry this table even without a push
@@ -1844,6 +1936,10 @@ class PSClient:
         # content — training tolerates bounded staleness by design
         self.stale_pulls = 0
         self.last_pull_stale = False
+        # rows the tiered store demand-paged to answer our pulls
+        # (docs/PS_TIERED.md): cost visibility for the cold tier
+        self.cold_faults = 0
+        self.last_pull_cold_faults = 0
 
     @property
     def bytes_out(self) -> int:
@@ -2028,14 +2124,18 @@ class PSClient:
                                              "init_std": init_std}))
             for i, m in masks])
         stale = False
+        cold = 0
         for (i, m), r in zip(masks, res):
-            if isinstance(r, dict):  # replay-gate read-through reply
+            if isinstance(r, dict):  # replay-gate / tiered-store reply
                 stale = stale or bool(r.get("stale"))
+                cold += int(r.get("cold_faults", 0))
                 r = r["v"]
             out[m] = r
         if stale:
             self.stale_pulls += 1
         self.last_pull_stale = stale
+        self.cold_faults += cold
+        self.last_pull_cold_faults = cold
         return out
 
     def push(self, table: str, dim: int, keys, grads, lr: float = 1.0,

@@ -122,6 +122,19 @@ def _parse(argv):
                         "durability for throughput — a crash can "
                         "silently drop up to N-1 acked pushes on "
                         "respawn (see docs/PS_WIRE_PROTOCOL.md)")
+    p.add_argument("--ps_tier_warm_bytes", type=int, default=0,
+                   help="PS mode: opt server tables into the tiered "
+                        "embedding store (docs/PS_TIERED.md) with "
+                        "this warm-tier RAM budget in bytes per table "
+                        "(PADDLE_PS_TIER_WARM_BYTES for server/"
+                        "standby children; 0 = all-warm tables). "
+                        "Cold rows demand-page from a chunk store "
+                        "under the snapshot dir (or "
+                        "--ps_tier_store_dir)")
+    p.add_argument("--ps_tier_store_dir", type=str, default=None,
+                   help="PS mode: cold-tier chunk store directory "
+                        "(PADDLE_PS_TIER_STORE_DIR). Default: "
+                        "<snapshot_dir>/tier_store")
     p.add_argument("--publish_dir", type=str, default=None,
                    help="online learning: set PADDLE_TPU_PUBLISH_DIR "
                         "for PS server and serving-replica children. "
@@ -550,6 +563,17 @@ def launch(argv=None):
                 env["PADDLE_PS_SNAPSHOT_EVERY"] = \
                     str(args.ps_snapshot_every)
                 server_specs[name] = (env, argv)
+    if ps_mode and args.ps_tier_warm_bytes > 0:
+        # tiered embedding store (docs/PS_TIERED.md): every server/
+        # standby child opts its tables in under the same budget; the
+        # cold store defaults under the snapshot dir
+        for name, env, argv in specs:
+            if name.startswith(("server.", "standby.")):
+                env["PADDLE_PS_TIER_WARM_BYTES"] = \
+                    str(args.ps_tier_warm_bytes)
+                if args.ps_tier_store_dir:
+                    env["PADDLE_PS_TIER_STORE_DIR"] = \
+                        args.ps_tier_store_dir
     if args.serving_replicas and args.max_restarts > 0:
         # serving replicas respawn ALONE like PS shards: their state is
         # the engine checkpoint the child script restores from, and the

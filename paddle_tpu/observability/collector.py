@@ -342,6 +342,32 @@ class TelemetryCollector:
             perf["kernel_ms"] = kern
         if perf:
             out["perf"] = perf
+        # tiered PS store (docs/PS_TIERED.md): per-tier hits and
+        # residency, faults/demotions, by-tier pull latency — what the
+        # `top` tier columns render per PS shard
+        tier = {}
+        hits = by_labels("paddle_tpu_ps_tier_hits_total", "tier")
+        if hits:
+            tier["hits"] = hits
+        rows = by_labels("paddle_tpu_ps_tier_resident_rows", "tier")
+        if any(rows.values()):
+            tier["resident_rows"] = rows
+            tier["resident_bytes"] = by_labels(
+                "paddle_tpu_ps_tier_resident_bytes", "tier")
+        for key_, name in (("faults",
+                            "paddle_tpu_ps_tier_faults_total"),
+                           ("demotions",
+                            "paddle_tpu_ps_tier_demotions_total"),
+                           ("cold_read_errors",
+                            "paddle_tpu_ps_tier_cold_read_errors_total")):
+            v = total(name)
+            if v:
+                tier[key_] = v
+        q = quantiles("paddle_tpu_ps_tier_pull_seconds")
+        if q and q[0] is not None:
+            tier["pull_p50"], tier["pull_p99"] = q
+        if tier:
+            out["tier"] = tier
         return out
 
     # -- completion + tail sampling --------------------------------------

@@ -29,7 +29,8 @@ import os
 import sys
 import time
 
-__all__ = ["render_fleet", "render_perf", "render_waterfall", "main"]
+__all__ = ["render_fleet", "render_perf", "render_tier",
+           "render_waterfall", "main"]
 
 
 def _f(v, spec="7.1f", dash="      -") -> str:
@@ -160,6 +161,39 @@ def render_perf(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def render_tier(fleet: dict) -> str:
+    """The tiered-PS pane of a ``tel_fleet`` reply: per-shard warm/
+    cold residency, hit split, fault/demotion totals, and the by-tier
+    pull latency quantiles (docs/PS_TIERED.md)."""
+    lines = [f"{'ROLE':<16} {'HOST:PID':<22} {'WARM rows/bytes':>18} "
+             f"{'COLD rows/bytes':>18} {'HIT warm/cold':>15} "
+             f"{'FAULTS':>8} {'DEMOTE':>8} {'ERR':>5} "
+             f"{'PULL p50/p99':>15}"]
+    any_tier = False
+    for p in fleet.get("procs") or ():
+        tier = (p.get("summary") or {}).get("tier") or {}
+        if not tier:
+            continue
+        any_tier = True
+        rows = tier.get("resident_rows") or {}
+        nbytes = tier.get("resident_bytes") or {}
+        hits = tier.get("hits") or {}
+        lines.append(
+            f"{str(p.get('role'))[:16]:<16} "
+            f"{p.get('host')}:{p.get('pid'):<10} "
+            f"{_f(rows.get('warm'), '8.0f')}/{_gb(nbytes.get('warm')):>9} "
+            f"{_f(rows.get('cold'), '8.0f')}/{_gb(nbytes.get('cold')):>9} "
+            f"{_f(hits.get('warm'), '7.0f')}/{_f(hits.get('cold'), '7.0f')} "
+            f"{_f(tier.get('faults'), '8.0f')} "
+            f"{_f(tier.get('demotions'), '8.0f')} "
+            f"{_f(tier.get('cold_read_errors'), '5.0f', '    0')} "
+            f"{_ms(tier.get('pull_p50'))}/{_ms(tier.get('pull_p99')):>7}")
+    if not any_tier:
+        lines.append("(no tiered tables yet — PS shards report after "
+                     "PADDLE_PS_TIER_WARM_BYTES opts a table in)")
+    return "\n".join(lines)
+
+
 def render_waterfall(trace: dict) -> str:
     """The assembled cross-process waterfall of one ``tel_trace``
     reply: spans in aligned start order, indented by span parentage,
@@ -217,7 +251,7 @@ def main(argv=None) -> int:
         prog="paddle_tpu.observability.top",
         description="live fleet dashboard / trace waterfall viewer")
     ap.add_argument("cmd", nargs="?", default="top",
-                    choices=["top", "trace", "perf"])
+                    choices=["top", "trace", "perf", "tier"])
     ap.add_argument("trace_id", nargs="?")
     ap.add_argument("--collector", default=os.environ.get(
         "PADDLE_TPU_TELEMETRY_COLLECTOR") or "127.0.0.1:8600")
@@ -252,7 +286,8 @@ def main(argv=None) -> int:
                 print(f"chrome trace -> {args.out}")
             return 0
         # top/perf: live loop (or one shot)
-        render = render_perf if args.cmd == "perf" else render_fleet
+        render = {"perf": render_perf,
+                  "tier": render_tier}.get(args.cmd, render_fleet)
         while True:
             fleet = cli.call({"op": "tel_fleet"})["fleet"]
             text = render(fleet)

@@ -1,0 +1,532 @@
+"""Tiered embedding parameter store (docs/PS_TIERED.md): eviction and
+admission under a tiny byte budget, bitwise parity against an all-warm
+table (same RNG stream, same rows), WAL-restart and HA-failover drills
+with cold-resident rows, cold-read fault injection, and chunk GC.
+
+The bit-exactness contract under test everywhere: a TieredTable driven
+through any interleaving of pulls, pushes, demotions, and faults holds
+the SAME key->row mapping and the SAME RNG stream position as a plain
+LargeScaleKV fed the identical request sequence — tiering moves bytes
+between tiers, never changes them.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.distributed.fleet.runtime import fault_injection as fi
+from paddle_tpu.distributed.fleet.runtime import rpc
+from paddle_tpu.checkpoint.store import CheckpointStore
+from paddle_tpu.distributed.fleet.runtime.parameter_server_runtime \
+    import LargeScaleKV, PSClient, PSServer
+from paddle_tpu.distributed.fleet.runtime.tiered_store \
+    import ColdReadError, TieredTable, gc_cold_store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DIM = 4
+ROW = DIM * 4  # float32 row bytes
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.reset_injector(fi.FaultInjector())
+    yield
+    fi.reset_injector(fi.FaultInjector())
+
+
+def _store(tmp_path, name="store"):
+    return CheckpointStore(str(tmp_path / name), keep=0)
+
+
+def _table(tmp_path, warm_rows=8, **kw):
+    return TieredTable(DIM, seed=7, store=_store(tmp_path),
+                       name="t", warm_bytes=warm_rows * ROW, **kw)
+
+
+def _state_dict(t):
+    st = t.export_state()
+    return {int(k): st["rows"][i].copy()
+            for i, k in enumerate(st["keys"])}, st["rng"]
+
+
+def _assert_same(a, b):
+    """Bitwise table equality independent of row order, plus RNG
+    stream position (the lazy-init contract)."""
+    da, ra = _state_dict(a)
+    db, rb = _state_dict(b)
+    assert set(da) == set(db)
+    for k in da:
+        assert np.array_equal(da[k], db[k]), f"row {k} diverged"
+    assert ra["pos"] == rb["pos"]
+    assert np.array_equal(ra["key"], rb["key"])
+
+
+def _wait(cond, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# admission / eviction under a tiny byte budget
+# ---------------------------------------------------------------------------
+
+def test_watermark_eviction_respects_budget(tmp_path):
+    t = _table(tmp_path, warm_rows=8)
+    t.pull(np.arange(64))
+    before, _ = _state_dict(t)
+    t.drain()
+    st = t.stats()
+    assert st["warm_bytes"] <= 8 * ROW
+    assert st["cold_rows"] > 0
+    assert st["warm_rows"] + st["cold_rows"] == 64
+    # every row survives demotion bitwise
+    after, _ = _state_dict(t)
+    for k in before:
+        assert np.array_equal(before[k], after[k])
+
+
+def test_pull_only_workload_demotes_clean(tmp_path):
+    """Rows that went cold once and were faulted back untouched revert
+    to their existing cold copy — no store write, no new segment."""
+    t = _table(tmp_path, warm_rows=8)
+    t.pull(np.arange(32))
+    t.drain()                      # first demotion: all dirty (fresh)
+    flush0 = t.stats()["demoted_flush"]
+    t.pull(np.arange(32))          # fault everything back, read-only
+    t.drain()                      # second demotion: mostly clean
+    st = t.stats()
+    # everything faulted back untouched reverts in place; only rows
+    # that never went cold the first time (≤ budget's worth) can
+    # still flush as dirty
+    assert st["demoted_clean"] >= 32 - 2 * 8
+    assert st["demoted_flush"] - flush0 <= 8
+    assert st["warm_bytes"] <= 8 * ROW
+
+
+def test_hot_rows_stay_warm_under_skew(tmp_path):
+    """Frequency-based victim selection: the hammered head survives
+    demotion, the one-touch tail goes cold."""
+    t = _table(tmp_path, warm_rows=8)
+    hot = np.arange(4)
+    for i in range(40):
+        t.pull(hot)
+        t.pull(np.asarray([100 + i]))
+    t.drain()
+    assert t.stats()["warm_bytes"] <= 8 * ROW
+    with t._lock:
+        warm = set(t._index)
+    assert set(int(k) for k in hot) <= warm
+
+
+def test_push_to_cold_row_faults_then_applies(tmp_path):
+    t = _table(tmp_path, warm_rows=4)
+    base = t.pull(np.arange(16)).copy()
+    t.drain()
+    assert t.stats()["cold_rows"] > 0
+    g = np.ones((16, DIM), np.float32)
+    t.push(np.arange(16), g, lr=0.5)
+    np.testing.assert_array_equal(t.pull(np.arange(16)),
+                                  base - 0.5)
+
+
+def test_background_demoter_thread(tmp_path):
+    t = _table(tmp_path, warm_rows=8, demote_interval=0.01)
+    try:
+        t.pull(np.arange(64))
+        _wait(lambda: t.warm_resident_bytes() <= 8 * ROW,
+              what="background demotion under budget")
+    finally:
+        t.close()
+
+
+def test_export_import_round_trip_lands_warm(tmp_path):
+    t = _table(tmp_path, warm_rows=4)
+    t.pull(np.arange(24))
+    t.push(np.arange(12), np.ones((12, DIM), np.float32))
+    t.drain()
+    want, _ = _state_dict(t)
+    t2 = _table(tmp_path, warm_rows=4)
+    t2.import_state(t.export_state())
+    got, _ = _state_dict(t2)
+    assert set(want) == set(got)
+    for k in want:
+        assert np.array_equal(want[k], got[k])
+    assert t2.stats()["cold_rows"] == 0  # import lands everything warm
+    # and the next pull after restore draws the same lazy-init rows
+    np.testing.assert_array_equal(t.pull([900, 901]),
+                                  t2.pull([900, 901]))
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity vs an all-warm LargeScaleKV
+# ---------------------------------------------------------------------------
+
+def test_bitwise_parity_random_interleaving(tmp_path, monkeypatch):
+    # the tier's contract is against the numpy reference path (the
+    # native core keeps its own RNG); pin it for the comparison table
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    ref = LargeScaleKV(DIM, seed=7)
+    t = _table(tmp_path, warm_rows=6)
+    r = np.random.default_rng(3)
+    for step in range(150):
+        ids = r.integers(0, 200, size=r.integers(1, 9))
+        if step % 3 == 2:
+            g = r.normal(size=(len(ids), DIM)).astype(np.float32)
+            ref.push(ids, g, lr=0.1)
+            t.push(ids, g, lr=0.1)
+        else:
+            np.testing.assert_array_equal(ref.pull(ids), t.pull(ids))
+        if step % 10 == 0:
+            t.demote()
+    _assert_same(ref, t)
+    # new keys AFTER the divergent histories still match: the RNG
+    # stream consumed the same draws on both sides
+    np.testing.assert_array_equal(ref.pull([5000, 5001]),
+                                  t.pull([5000, 5001]))
+
+
+def test_apply_rows_admits_cold_without_rng(tmp_path):
+    """WAL replay / HA apply of journaled rows over cold keys installs
+    the journaled bytes directly — no store read, no RNG draw."""
+    t = _table(tmp_path, warm_rows=4)
+    t.pull(np.arange(16))
+    t.drain()
+    pos0 = t.export_state()["rng"]["pos"]
+    rows = np.full((16, DIM), 3.25, np.float32)
+    t.apply_rows(np.arange(16), rows)
+    assert t.export_state()["rng"]["pos"] == pos0
+    np.testing.assert_array_equal(t.pull(np.arange(16)), rows)
+
+
+# ---------------------------------------------------------------------------
+# cold-read fault injection: contained to the faulting pull
+# ---------------------------------------------------------------------------
+
+def test_cold_fault_error_fails_one_pull_only(tmp_path):
+    t = _table(tmp_path, warm_rows=4)
+    t.pull(np.arange(16))
+    t.drain()
+    fi.injector().set_cold_fault("error", table="t", row="0")
+    with pytest.raises(ColdReadError):
+        t.pull([0])
+    assert t.stats()["cold_read_errors"] == 1
+    # one-shot: the retry reads the same immutable segment fine
+    assert t.pull([0]).shape == (1, DIM)
+
+
+def test_cold_fault_delay_slows_not_fails(tmp_path):
+    t = _table(tmp_path, warm_rows=4)
+    base = t.pull(np.arange(16)).copy()
+    t.drain()
+    fi.injector().set_cold_fault("delay", table="t", delay=0.2)
+    t0 = time.perf_counter()
+    out = t.pull(np.arange(16))
+    assert time.perf_counter() - t0 >= 0.2
+    np.testing.assert_array_equal(out, base)
+    assert t.stats()["cold_read_errors"] == 0
+
+
+def test_cold_fault_error_does_not_wedge_server(tmp_path):
+    """A cold-read error fails only the faulting RPC: the client sees
+    one remote error, the shard keeps serving every other request."""
+    srv = PSServer("127.0.0.1:0", wal=True,
+                   snapshot_dir=str(tmp_path / "snap"),
+                   tier_warm_bytes=4 * ROW,
+                   tier_store_dir=str(tmp_path / "store"))
+    srv.serve_in_thread()
+    try:
+        cl = PSClient([srv.endpoint])
+        cl.pull("emb", DIM, np.arange(16))
+        srv.tables["emb"].drain()
+        assert srv.tables["emb"].stats()["cold_rows"] > 0
+        fi.injector().set_cold_fault("error", table="emb", row="0")
+        raw = rpc.RpcClient(srv.endpoint, timeout=5.0, deadline=6.0,
+                            max_retries=0)
+        with pytest.raises(rpc.PSRemoteError):
+            raw.call({"op": "pull", "table": "emb", "dim": DIM,
+                      "keys": np.asarray([0], np.int64)})
+        raw.close()
+        # shard alive: the same pull succeeds, pushes still land
+        v = cl.pull("emb", DIM, [0])
+        assert v.shape == (1, DIM)
+        cl.push("emb", DIM, [1], np.ones((1, DIM), np.float32))
+        cl.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_fault_knobs_parse_from_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_FAULT_COLD_ACTION", "delay")
+    monkeypatch.setenv("PADDLE_PS_FAULT_COLD_TABLE", "emb")
+    monkeypatch.setenv("PADDLE_PS_FAULT_COLD_ROW", "17")
+    monkeypatch.setenv("PADDLE_PS_FAULT_COLD_DELAY", "0.05")
+    inj = fi.FaultInjector.from_env()
+    assert inj.active
+    assert inj.cold_fault("emb", [17]) == ("delay", 0.05)
+    assert inj.cold_fault("emb", [17]) is None  # one-shot
+
+
+# ---------------------------------------------------------------------------
+# PSServer integration: WAL restart, HA failover, handoff
+# ---------------------------------------------------------------------------
+
+def _drive(cl, steps=60, tables=("emb",), seed=11):
+    r = np.random.default_rng(seed)
+    for step in range(steps):
+        for name in tables:
+            ids = r.integers(0, 300, size=8)
+            v = cl.pull(name, DIM, ids)
+            cl.push(name, DIM, ids, 0.1 * v)
+
+
+def test_wal_restart_parity_tiered_vs_all_warm(tmp_path,
+                                               monkeypatch):
+    """The same client history through a tiered shard and an all-warm
+    shard, both killed and restored from snapshot+WAL: bit-identical
+    tables AND bit-identical next lazy-init draw."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    tiered = PSServer("127.0.0.1:0", wal=True,
+                      snapshot_dir=str(tmp_path / "a"),
+                      tier_warm_bytes=8 * ROW,
+                      tier_store_dir=str(tmp_path / "a_store"))
+    plain = PSServer("127.0.0.1:0", wal=True,
+                     snapshot_dir=str(tmp_path / "b"))
+    tiered.serve_in_thread()
+    plain.serve_in_thread()
+    c1 = PSClient([tiered.endpoint])
+    c2 = PSClient([plain.endpoint])
+    _drive(c1)
+    _drive(c2)
+    tiered.tables["emb"].drain()
+    assert tiered.tables["emb"].stats()["cold_rows"] > 0
+    assert c1.cold_faults > 0         # client-side stat wired through
+    ep_a, ep_b = tiered.endpoint, plain.endpoint
+    tiered.kill()
+    plain.kill()
+    ra = PSServer.restart_from_snapshot(
+        ep_a, str(tmp_path / "a"), wal=True,
+        tier_warm_bytes=8 * ROW,
+        tier_store_dir=str(tmp_path / "a_store"))
+    rb = PSServer.restart_from_snapshot(ep_b, str(tmp_path / "b"),
+                                        wal=True)
+    try:
+        ra.serve_in_thread()
+        rb.serve_in_thread()
+        ra._replay_done.wait(30)
+        rb._replay_done.wait(30)
+        assert isinstance(ra.tables["emb"], TieredTable)
+        _assert_same(ra.tables["emb"], rb.tables["emb"])
+        np.testing.assert_array_equal(ra.tables["emb"].pull([7777]),
+                                      rb.tables["emb"].pull([7777]))
+        c1.close()
+        c2.close()
+    finally:
+        for s in (ra, rb):
+            s.shutdown()
+            s.server_close()
+
+
+def test_ha_failover_with_cold_resident_rows(tmp_path, monkeypatch):
+    """Kill the primary while part of the table is cold-resident: the
+    promoted standby serves every row bitwise identical to an all-warm
+    reference fed the same history (replication journals VALUES, so
+    tier placement never leaks into replicated state)."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    tier_kw = dict(tier_warm_bytes=8 * ROW)
+    prim = PSServer("127.0.0.1:0", wal=True,
+                    snapshot_dir=str(tmp_path / "p"),
+                    tier_store_dir=str(tmp_path / "p_store"),
+                    **tier_kw)
+    prim.serve_in_thread()
+    stby = PSServer("127.0.0.1:0", wal=True,
+                    snapshot_dir=str(tmp_path / "s"),
+                    primary=prim.endpoint,
+                    tier_store_dir=str(tmp_path / "s_store"),
+                    **tier_kw)
+    stby.serve_in_thread()
+    ref = PSServer("127.0.0.1:0", wal=True,
+                   snapshot_dir=str(tmp_path / "r"))
+    ref.serve_in_thread()
+    cl = PSClient([prim.endpoint])
+    cr = PSClient([ref.endpoint])
+    try:
+        _wait(lambda: stby._ha_replicator.synced.is_set(),
+              what="standby bootstrap")
+        _drive(cl)
+        _drive(cr)
+        prim.tables["emb"].drain()
+        assert prim.tables["emb"].stats()["cold_rows"] > 0
+        _wait(lambda: (stby._ha_replicator.applied_seq
+                       >= prim._ha.seq), what="standby caught up")
+        prim.kill()
+        stby.promote(prim.shard_epoch + 1)
+        _assert_same(stby.tables["emb"], ref.tables["emb"])
+        # promoted standby serves reads/writes, lazy inits on it draw
+        # the same stream the all-warm reference draws
+        grp = PSClient([prim.endpoint + "|" + stby.endpoint])
+        np.testing.assert_array_equal(
+            grp.pull("emb", DIM, [8888, 8889]),
+            cr.pull("emb", DIM, [8888, 8889]))
+        grp.close()
+    finally:
+        cl.close()
+        cr.close()
+        for s in (stby, ref):
+            s.shutdown()
+            s.server_close()
+        prim.server_close()
+
+
+def test_tiered_handoff_zero_failed_pushes(tmp_path, monkeypatch):
+    """Planned shard rebalancing through ha_handoff with a tiered
+    primary under live pushes: zero failed pushes, each applied
+    exactly once, tiers on the new primary rebuild under budget."""
+    monkeypatch.setenv("PADDLE_TPU_DISABLE_NATIVE", "1")
+    prim = PSServer("127.0.0.1:0", wal=True,
+                    snapshot_dir=str(tmp_path / "p"),
+                    tier_warm_bytes=8 * ROW,
+                    tier_store_dir=str(tmp_path / "p_store"))
+    prim.serve_in_thread()
+    stby = PSServer("127.0.0.1:0", wal=True,
+                    snapshot_dir=str(tmp_path / "s"),
+                    primary=prim.endpoint,
+                    tier_warm_bytes=8 * ROW,
+                    tier_store_dir=str(tmp_path / "s_store"))
+    stby.serve_in_thread()
+    cl = PSClient([f"{prim.endpoint}|{stby.endpoint}"],
+                  deadline=60.0, backoff=0.02)
+    errs: list = []
+    n = 60
+    handoff_at = threading.Event()
+
+    def pusher():
+        try:
+            for k in range(n):
+                cl.push("t", DIM, [0], np.ones((1, DIM)), lr=1.0)
+                if k == 15:
+                    handoff_at.set()
+        except Exception as e:              # pragma: no cover
+            errs.append(e)
+
+    try:
+        base = cl.pull("t", DIM, [0]).copy()
+        # spread rows and push some cold before the handoff
+        cl.pull("t", DIM, np.arange(64))
+        prim.tables["t"].drain()
+        _wait(lambda: (stby._ha_replicator.synced.is_set()
+                       and stby._ha_replicator.applied_seq
+                       >= prim._ha.seq), what="standby catch-up")
+        th = threading.Thread(target=pusher)
+        th.start()
+        assert handoff_at.wait(timeout=60)
+        ctl = rpc.RpcClient(prim.endpoint, timeout=60.0,
+                            deadline=90.0, max_retries=0)
+        rep = ctl.call({"op": "ha_handoff", "target": stby.endpoint},
+                       timeout=60.0)
+        ctl.close()
+        assert rep["promoted"] == stby.endpoint
+        th.join(timeout=120)
+        assert not th.is_alive(), "pusher hung across handoff"
+        assert not errs, errs
+        final = cl.pull("t", DIM, [0])
+        np.testing.assert_allclose(base - final, float(n), rtol=1e-6)
+        assert stby.ha_role == "primary"
+        # the new primary's table is tiered and demotes under budget
+        assert isinstance(stby.tables["t"], TieredTable)
+        stby.tables["t"].drain()
+        assert stby.tables["t"].warm_resident_bytes() <= 8 * ROW
+        cl.close()
+    finally:
+        for s in (prim, stby):
+            s.shutdown()
+            s.server_close()
+
+
+# ---------------------------------------------------------------------------
+# chunk GC, metrics, env knobs
+# ---------------------------------------------------------------------------
+
+def test_gc_cold_store_drops_dead_chunks_only(tmp_path):
+    t = _table(tmp_path, warm_rows=4)
+    t.push(np.arange(32), np.ones((32, DIM), np.float32))
+    t.drain()
+    # churn: re-dirty and re-flush so earlier segments die
+    for _ in range(4):
+        t.push(np.arange(32), np.ones((32, DIM), np.float32))
+        t.drain()
+    store = t._store
+    dead = len(store.chunks.all_digests())
+    removed = gc_cold_store(store, [t], min_age=0.0)
+    assert removed > 0
+    assert len(store.chunks.all_digests()) == dead - removed
+    # every cold row still readable bitwise after GC
+    want, _ = _state_dict(t)
+    got = {int(k): r for k, r in
+           zip(np.arange(32), t.pull(np.arange(32)))}
+    for k in got:
+        assert np.array_equal(want[k], got[k])
+    # age guard: fresh chunks survive a min_age pass
+    t.push(np.arange(32), np.ones((32, DIM), np.float32))
+    t.drain()
+    assert gc_cold_store(store, [t], min_age=3600.0) == 0
+
+
+def test_tier_metrics_registered():
+    from paddle_tpu.observability.registry import REGISTRY
+    for name in ("paddle_tpu_ps_tier_hits_total",
+                 "paddle_tpu_ps_tier_misses_total",
+                 "paddle_tpu_ps_tier_resident_rows",
+                 "paddle_tpu_ps_tier_resident_bytes",
+                 "paddle_tpu_ps_tier_faults_total",
+                 "paddle_tpu_ps_tier_demotions_total",
+                 "paddle_tpu_ps_tier_cold_read_errors_total",
+                 "paddle_tpu_ps_tier_pull_seconds"):
+        assert REGISTRY.get(name) is not None, name
+
+
+def test_env_knob_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_TIER_WARM_BYTES", str(8 * ROW))
+    monkeypatch.setenv("PADDLE_PS_TIER_STORE_DIR",
+                       str(tmp_path / "store"))
+    monkeypatch.setenv("PADDLE_PS_TIER_TABLES", "emb,wide")
+    srv = PSServer("127.0.0.1:0",
+                   snapshot_dir=str(tmp_path / "snap"))
+    srv.serve_in_thread()
+    try:
+        assert isinstance(srv.table("emb", DIM), TieredTable)
+        assert isinstance(srv.table("wide", DIM), TieredTable)
+        assert not isinstance(srv.table("other", DIM), TieredTable)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+@pytest.mark.slow
+def test_tiered_module_clean_under_lockcheck():
+    """The tier adds lock surface on the hottest path there is (every
+    pull crosses the table lock, faulting IO runs off it, the demoter
+    re-takes it): re-run this module's in-process tests with every
+    paddle_tpu lock order-checked."""
+    if os.environ.get("PADDLE_TPU_LOCKCHECK") == "1":
+        pytest.skip("already running under the sanitizer")
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         os.path.join(REPO, "tests", "test_tiered_store.py"),
+         "-q", "-x", "-k", "not lockcheck",
+         "-p", "no:cacheprovider", "-p", "no:randomly"],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 PADDLE_TPU_LOCKCHECK="1"))
+    assert res.returncode == 0, \
+        res.stdout[-4000:] + res.stderr[-2000:]
