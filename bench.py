@@ -56,7 +56,11 @@ rollout vs steady-state ITL, cross-version chunk dedup ratio on a
 one-row-mutated embedding) | ps_ha (PS high-availability plane:
 kill-primary -> promoted-standby first-push wall time vs the pre-HA
 snapshot-respawn baseline, semi-sync vs async push-ack tax, and
-steady-state replication lag under a wide&deep-style push stream).
+steady-state replication lag under a wide&deep-style push stream) |
+tsdb (time-series plane: collector TSDB + alert evaluator toggled
+A/B/A behind a live agent, same <2% decode bar, plus the store's own
+ingest rate, bytes/sample after downsampling, and range/rate/quantile
+query latency).
 """
 from __future__ import annotations
 
@@ -2140,6 +2144,119 @@ def bench_kernels(reps=5):
             "device_kind": str(jax.devices()[0].device_kind)}
 
 
+def bench_tsdb(steps=200, hidden=256, layers=4, heads=4, slots=4,
+               seed=0, ingest_batches=2500, query_reps=50):
+    """Time-series-plane cost guardrail (ISSUE 18 acceptance): a LIVE
+    agent streams to a collector whose TSDB + alert evaluator are
+    toggled A/B/A on the same engine — the toggle isolates the
+    history/alerting cost ON TOP of fleet telemetry (agent stays armed
+    both ways), and all TSDB writes ride the collector's server
+    threads, so the decode hot path sees the same <2% bar as the other
+    observability toggles. Supplementary stats measure the plane
+    itself against a disk-backed store: batch ingest rate, bytes per
+    sample on disk after block sealing + downsampling, and query
+    latency for range/rate/quantile over the ingested history."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.observability import agent as tel_agent
+    from paddle_tpu.observability.collector import (CollectorServer,
+                                                    TelemetryCollector)
+    from paddle_tpu.observability.timeseries import TimeSeriesDB
+
+    col = TelemetryCollector(tsdb=TimeSeriesDB())
+    srv = CollectorServer("127.0.0.1:0", collector=col).start()
+    paused = []
+
+    def set_enabled(on):
+        # ingest() reads tsdb/alerts without holding the collector
+        # lock, so the swap is a plain attribute flip
+        if on:
+            if paused:
+                col.tsdb, col.alerts = paused.pop()
+        else:
+            paused.append((col.tsdb, col.alerts))
+            col.tsdb = col.alerts = None
+
+    tel_agent.arm(srv.endpoint)
+    try:
+        rec = _bench_serving_toggle_overhead(
+            set_enabled, "serving_tsdb_overhead_pct", steps=steps,
+            hidden=hidden, layers=layers, heads=heads, slots=slots,
+            seed=seed)
+    finally:
+        tel_agent.disarm()
+        srv.stop()
+
+    # -- plane economics: a dedicated disk-backed store, block size
+    # shrunk so sealing + downsampling actually fire inside the bench
+    root = tempfile.mkdtemp(prefix="tsdb_bench_")
+    try:
+        db = TimeSeriesDB(dir_=os.path.join(root, "tsdb"),
+                          block_bytes=256 * 1024,
+                          retention_bytes=8 * 2**20)
+        hist_buckets = (0.001, 0.005, 0.025, 0.1, 0.5, 2.5)
+        base_t = 1_700_000_000.0
+        t0 = time.perf_counter()
+        appended = 0
+        for i in range(ingest_batches):
+            # 1s cadence over ~40min of history: crosses the raw
+            # window (900s) so mid-resolution downsampling is exercised
+            t = base_t + i
+            entries = [("bench_counter_total",
+                        {"host": "h", "pid": str(p), "role": "trainer"},
+                        "counter", float(i * 10 + p), None)
+                       for p in range(8)]
+            entries += [("bench_gauge",
+                         {"host": "h", "pid": str(p),
+                          "role": "trainer"},
+                         "gauge", float((i + p) % 97), None)
+                        for p in range(8)]
+            cum = tuple(min(i + 1, (b + 1) * (i + 1) // 7 + 1)
+                        for b in range(len(hist_buckets) + 1))
+            entries.append(("bench_latency_seconds",
+                            {"host": "h", "pid": "0",
+                             "role": "trainer"},
+                            "histogram",
+                            (cum, 0.01 * (i + 1), float(cum[-1])),
+                            hist_buckets))
+            appended += db.append(t, entries)
+        ingest_s = time.perf_counter() - t0
+        st = db.stats()
+        end_t = base_t + ingest_batches - 1
+
+        def timeit(fn):
+            q0 = time.perf_counter()
+            for _ in range(query_reps):
+                fn()
+            return (time.perf_counter() - q0) / query_reps * 1e3
+
+        q_range = timeit(lambda: db.range(
+            "bench_gauge", start=end_t - 300, end=end_t))
+        q_rate = timeit(lambda: db.rate(
+            "bench_counter_total", 300, at=end_t))
+        q_quantile = timeit(lambda: db.quantile(
+            "bench_latency_seconds", 0.99, 300, at=end_t))
+        db.close()
+        rec["tsdb"] = {
+            "ingest_samples_per_s": round(appended / ingest_s),
+            "samples": appended,
+            "series": st["series"],
+            "bytes_on_disk": st["bytes_on_disk"],
+            "bytes_per_sample": round(
+                st["bytes_on_disk"] / max(1, appended), 2),
+            "blocks_sealed": st["counts"]["sealed"],
+            "blocks_compacted": st["counts"]["compacted"],
+            "blocks_deleted": st["counts"]["deleted"],
+            "query_ms": {"range_5m": round(q_range, 3),
+                         "rate_5m": round(q_rate, 3),
+                         "quantile_p99_5m": round(q_quantile, 3)},
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return rec
+
+
 def main():
     which = os.environ.get("BENCH_CONFIG", "bert_base")
     if which == "lenet":
@@ -2192,6 +2309,8 @@ def main():
         rec = bench_ps_ha()
     elif which == "tiered":
         rec = bench_tiered()
+    elif which == "tsdb":
+        rec = bench_tsdb()
     else:
         # batch 64 wins on v5e since the rbg-PRNG switch removed the
         # dropout-mask cost (32.5% MFU vs 31.8% at batch 32; pre-rbg,

@@ -1,3 +1,4 @@
 """Built-in rule modules. Importing this package registers every rule
 with the engine registry (core.all_rules loads it lazily)."""
-from . import concurrency, invariants, jit_hazards  # noqa: F401
+from . import cardinality, concurrency, invariants, \
+    jit_hazards  # noqa: F401

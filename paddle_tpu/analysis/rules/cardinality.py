@@ -1,0 +1,183 @@
+"""metric-label-cardinality: flag ``labels()`` call sites whose label
+values are not drawn from bounded sets.
+
+Label values become series keys in the registry AND in the collector's
+TSDB; an unbounded value (tenant names straight off the wire, request
+ids, file paths) makes series cardinality grow with traffic until the
+TSDB's retention budget is spent evicting *history* to store *keys*.
+The time-series plane's survival constraint is therefore static: every
+``m.labels(k=v)`` value must come from a bounded vocabulary.
+
+What counts as bounded, judged per call site with local inference:
+
+  * string/number literals, and conditionals / ``or``-chains whose
+    arms are all bounded;
+  * calls to the metering plane's sanctioned bounding helpers
+    (``intern`` — cap + overflow bucket, ``normalize_outcome`` /
+    ``_tier`` — fixed vocabularies);
+  * a local name whose every assignment in the enclosing scope is
+    itself bounded (e.g. ``verdict`` chosen from literals).
+
+Everything else — attributes, f-strings, arbitrary calls, parameters —
+is flagged. Legitimately-dynamic-but-bounded sites (an engine id, a
+replica name from the static topology) are baselined in
+``baseline.json`` with one-line justifications; the baseline is
+shrink-only, so new unbounded labels cannot ride in quietly.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, KeyCounter, Rule, register
+
+__all__ = ["MetricLabelCardinalityRule", "BOUNDING_CALLS",
+           "label_cardinality_hits"]
+
+# the metering plane's sanctioned bounding helpers: their return
+# values are bounded by construction (cap + overflow bucket / fixed
+# vocabulary), whatever the argument
+BOUNDING_CALLS = {"intern", "normalize_outcome", "_tier"}
+
+
+def _scopes(tree: ast.AST):
+    """Yield (scope_node, direct_statements) for the module and every
+    function — each statement list excludes nested function bodies, so
+    name inference stays scope-local."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_scope(scope: ast.AST):
+    """ast.walk, but stop at nested function/class boundaries (their
+    bodies are separate scopes with their own assignment maps)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _assignments(scope: ast.AST) -> dict[str, list[ast.AST]]:
+    """name -> every expression assigned to it in this scope."""
+    out: dict[str, list[ast.AST]] = {}
+    for node in _walk_scope(scope):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, []).append(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name):
+                # x += ... — conservatively unbounded
+                out.setdefault(node.target.id, []).append(node)
+    return out
+
+
+def _call_tail(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _bounded(expr: ast.AST, assigns: dict[str, list[ast.AST]],
+             seen: frozenset = frozenset()) -> bool:
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.IfExp):
+        return _bounded(expr.body, assigns, seen) \
+            and _bounded(expr.orelse, assigns, seen)
+    if isinstance(expr, ast.BoolOp):
+        return all(_bounded(v, assigns, seen) for v in expr.values)
+    if isinstance(expr, ast.Call):
+        tail = _call_tail(expr.func)
+        if tail in BOUNDING_CALLS:
+            return True
+        # str(<bounded>) stays bounded
+        if isinstance(expr.func, ast.Name) and expr.func.id == "str" \
+                and len(expr.args) == 1 and not expr.keywords:
+            return _bounded(expr.args[0], assigns, seen)
+        return False
+    if isinstance(expr, ast.Name):
+        if expr.id in seen:         # assignment cycle: give up safely
+            return False
+        vals = assigns.get(expr.id)
+        if not vals:                # parameter / global / closure
+            return False
+        seen = seen | {expr.id}
+        return all(_bounded(v, assigns, seen) for v in vals)
+    return False
+
+
+def _label_desc(expr: ast.AST) -> str:
+    try:
+        return ast.unparse(expr)
+    except Exception:               # pragma: no cover — malformed AST
+        return "<expr>"
+
+
+def label_cardinality_hits(tree: ast.AST) \
+        -> list[tuple[int, str, str, str]]:
+    """(line, metric_recv, label_kw, value_src) for every ``labels()``
+    keyword whose value local inference cannot prove bounded — ONE hit
+    per (metric, label) pair per file: the series family is the unit
+    of cardinality risk, not the call site, and the baseline should
+    carry one justification per family, not one per inc()."""
+    hits = []
+    seen_fam: set[tuple[str, str]] = set()
+    for scope in _scopes(tree):
+        assigns = _assignments(scope)
+        for node in _walk_scope(scope):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "labels"):
+                continue
+            recv = _label_desc(node.func.value)
+            # strip the receiver to the metric object itself so
+            # `self._m_x.labels` and `_M_X.labels` sites family-match
+            fam_recv = recv.split("(")[0]
+            for kw in node.keywords:
+                arg = kw.arg or "**"
+                if kw.arg is not None \
+                        and _bounded(kw.value, assigns):
+                    continue
+                fam = (fam_recv, arg)
+                if fam in seen_fam:
+                    continue
+                seen_fam.add(fam)
+                hits.append((node.lineno, recv, arg,
+                             _label_desc(kw.value)))
+    return sorted(hits)
+
+
+@register
+class MetricLabelCardinalityRule(Rule):
+    name = "metric-label-cardinality"
+    description = ("labels() values not provably drawn from bounded "
+                   "sets (unbounded series cardinality would flood "
+                   "the registry and the collector TSDB)")
+
+    def visit(self, ctx: FileContext):
+        if ctx.tree_rel == "observability/registry.py":
+            # the registry defines labels(); its docstrings/tests
+            # exercise the API with placeholder values
+            return ()
+        dedup = KeyCounter()
+        keypath = ctx.tree_rel or ctx.relpath
+        return [self.finding(
+            ctx, line,
+            f"{recv}.labels({kw}={src}) — value not provably bounded; "
+            f"route dynamic identifiers through meter.intern() (cap + "
+            f"overflow) or a fixed vocabulary, or baseline with a "
+            f"justification",
+            key=dedup(
+                f"{keypath}::{recv.split('(')[0]}.labels({kw})"))
+            for line, recv, kw, src in
+            label_cardinality_hits(ctx.tree)]

@@ -301,6 +301,30 @@ REQUIRED_METRICS = {
     "paddle_tpu_ps_tier_demotions_total",
     "paddle_tpu_ps_tier_cold_read_errors_total",
     "paddle_tpu_ps_tier_pull_seconds",
+    # fleet time-series plane (docs/OBSERVABILITY.md): TSDB
+    # durability/retention accounting, alert lifecycle counts and the
+    # per-tenant usage series are the plane's acceptance contract —
+    # the burn-rate chaos drill, `top history/alerts/tenants` and the
+    # tsdb bench read these exact names
+    "paddle_tpu_tsdb_samples_total",
+    "paddle_tpu_tsdb_series",
+    "paddle_tpu_tsdb_bytes_on_disk",
+    "paddle_tpu_tsdb_blocks_sealed_total",
+    "paddle_tpu_tsdb_blocks_compacted_total",
+    "paddle_tpu_tsdb_blocks_deleted_total",
+    "paddle_tpu_tsdb_torn_tail_truncated_total",
+    "paddle_tpu_alerts_evaluations_total",
+    "paddle_tpu_alerts_transitions_total",
+    "paddle_tpu_alerts_firing",
+    "paddle_tpu_tenant_tokens_in_total",
+    "paddle_tpu_tenant_tokens_out_total",
+    "paddle_tpu_tenant_queue_seconds_total",
+    "paddle_tpu_tenant_kv_page_seconds_total",
+    "paddle_tpu_tenant_flops_total",
+    "paddle_tpu_tenant_requests_total",
+    "paddle_tpu_tenant_router_requests_total",
+    "paddle_tpu_tenant_overflow_total",
+    "paddle_tpu_telemetry_procs_retired_total",
 }
 
 

@@ -398,7 +398,8 @@ class PSServer(socketserver.ThreadingTCPServer):
                           # rest are reads — none need replay dedup
                           "tel_push", "tel_ping", "tel_fleet",
                           "tel_trace", "tel_traces", "tel_stats",
-                          "tel_watch",
+                          "tel_watch", "tsdb_query", "alerts",
+                          "usage_report",
                           # HA plane: replication streams/acks and
                           # status probes must never replay from the
                           # dedup cache (ha_promote/ha_handoff stay
@@ -1676,7 +1677,8 @@ class PSServer(socketserver.ThreadingTCPServer):
             self._replay_done.wait()
             return None
         if op in ("ping", "size", "metrics", "debug_dump",
-                  "heartbeat", "lost_workers", "subscribe_inval") \
+                  "heartbeat", "lost_workers", "subscribe_inval",
+                  "tsdb_query", "alerts", "usage_report") \
                 or op.startswith("pub_") or op.startswith("tel_"):
             return None
         self._replay_done.wait()
@@ -1717,7 +1719,8 @@ class PSServer(socketserver.ThreadingTCPServer):
                     "(set PADDLE_TPU_PUBLISH_DIR or publish_dir=)")
             from ....publish.registry import registry_dispatch
             return registry_dispatch(self._publisher.registry, req)
-        if op.startswith("tel_"):
+        if op.startswith("tel_") \
+                or op in ("tsdb_query", "alerts", "usage_report"):
             # fleet-telemetry verbs (hosted collector): one PS
             # endpoint can double as the collector, the debug_dump /
             # pub_* hosting pattern

@@ -173,6 +173,13 @@ def _parse(argv):
                         "live with `python -m "
                         "paddle_tpu.observability.top --collector "
                         "HOST:PORT` (docs/OBSERVABILITY.md)")
+    p.add_argument("--tsdb-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="with --telemetry: durable metric history — "
+                        "the collector child persists its TSDB blocks "
+                        "here (PADDLE_TPU_TSDB_DIR), so `top history` "
+                        "and SLO burn-rate alerts survive collector "
+                        "restarts; without it history is memory-only")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -534,8 +541,11 @@ def launch(argv=None):
         for name, env, _argv in specs:
             env["PADDLE_TPU_TELEMETRY_COLLECTOR"] = args.telemetry
             env.setdefault("PADDLE_TPU_TELEMETRY_ROLE", name)
-        specs.append(("telemetry",
-                      {"PADDLE_TPU_TELEMETRY_COLLECTOR": ""},
+        tel_env = {"PADDLE_TPU_TELEMETRY_COLLECTOR": ""}
+        if args.tsdb_dir:
+            os.makedirs(args.tsdb_dir, exist_ok=True)
+            tel_env["PADDLE_TPU_TSDB_DIR"] = args.tsdb_dir
+        specs.append(("telemetry", tel_env,
                       [sys.executable, "-m",
                        "paddle_tpu.observability.collector",
                        "--endpoint", args.telemetry]))

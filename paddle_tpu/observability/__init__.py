@@ -51,10 +51,13 @@ import atexit
 import os
 import socket
 
-from . import agent, collector, debug, flight, perf, perfwatch, \
-    registry, tracing, watchdog
+from . import agent, alerts, collector, debug, flight, meter, perf, \
+    perfwatch, registry, timeseries, tracing, watchdog
 from .agent import TelemetryAgent, publish_event
+from .alerts import AlertManager, AlertRule
 from .collector import TelemetryCollector, telemetry_dispatch
+from .meter import METER, UsageMeter, usage_report
+from .timeseries import TimeSeriesDB
 from .debug import collect, load_bundle, write_bundle
 from .flight import RECORDER
 from .registry import (REGISTRY, Counter, Gauge, Histogram, MetricError,
@@ -68,8 +71,11 @@ from .watchdog import WATCHDOG
 __all__ = [
     "registry", "tracing", "flight", "watchdog", "debug",
     "agent", "collector", "perf", "perfwatch",
+    "timeseries", "alerts", "meter",
     "TelemetryAgent", "TelemetryCollector",
     "telemetry_dispatch", "publish_event",
+    "TimeSeriesDB", "AlertManager", "AlertRule",
+    "UsageMeter", "METER", "usage_report",
     "REGISTRY", "MetricsRegistry", "MetricError",
     "Counter", "Gauge", "Histogram",
     "counter", "gauge", "histogram",

@@ -22,7 +22,18 @@ mux RPC wire. The ``TelemetryCollector``:
     evicted traces are counted, never silently gone;
   * tracks **fleet state** per process (role, liveness, drop counts,
     latest metric snapshot, recent watchdog/bundle events) — the feed
-    behind ``python -m paddle_tpu.observability.top``;
+    behind ``python -m paddle_tpu.observability.top``. Processes that
+    stop reporting past ``PADDLE_TPU_TELEMETRY_RETIRE`` are aged out
+    (counted in ``paddle_tpu_telemetry_procs_retired_total``), so the
+    fleet table shows the live fleet, not every process ever seen;
+  * hosts the **time-series plane**: every push's fleet summary and
+    every ride-along registry dump land in an embedded TSDB
+    (``observability.timeseries`` — durable when
+    ``PADDLE_TPU_TSDB_DIR`` is set, queryable via the ``tsdb_query``
+    verb / ``top history``) and an alert engine
+    (``observability.alerts``) evaluates burn-rate/threshold/absence
+    rules over it on a cadence (``alerts`` verb / ``top alerts``),
+    with per-tenant usage aggregation behind ``usage_report``;
   * exports any assembled trace as one merged **Chrome trace** with
     per-rank pid labels (``merge_chrome_traces`` is shared with the
     offline ``python -m paddle_tpu.observability.registry <dir>``
@@ -43,16 +54,22 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from . import alerts as _alerts
+from . import meter as _meter
 from . import registry as _obs
+from . import timeseries as _ts
 
 __all__ = ["TelemetryCollector", "telemetry_dispatch", "TEL_READ_OPS",
            "CollectorServer", "merge_chrome_traces", "main"]
 
 # tel_* verbs never need replay dedup: pushes are single-attempt
-# fire-and-forget, everything else is a read
+# fire-and-forget, everything else is a read. tsdb_query / alerts /
+# usage_report are the time-series plane's read verbs — hosted by the
+# same dispatch, gated into router/PS READ_OPS through this set
 TEL_READ_OPS = frozenset({"tel_push", "tel_ping", "tel_fleet",
                           "tel_trace", "tel_traces", "tel_stats",
-                          "tel_watch"})
+                          "tel_watch",
+                          "tsdb_query", "alerts", "usage_report"})
 
 _PUSHES = _obs.counter(
     "paddle_tpu_telemetry_push_batches_total",
@@ -67,6 +84,9 @@ _TRACES = _obs.counter(
 _EVICTED = _obs.counter(
     "paddle_tpu_telemetry_trace_evicted_total",
     "kept traces evicted from the bounded retention ring")
+_RETIRED = _obs.counter(
+    "paddle_tpu_telemetry_procs_retired_total",
+    "processes aged out of the fleet table after the liveness window")
 
 
 def _env_float(name: str, default: float) -> float:
@@ -155,16 +175,22 @@ class TelemetryCollector:
     def __init__(self, sample: float | None = None,
                  ring_max: int | None = None,
                  linger_s: float | None = None,
-                 reservoir: int = 512, events_max: int = 64):
+                 reservoir: int = 512, events_max: int = 64,
+                 tsdb: "_ts.TimeSeriesDB | None" = None,
+                 alerts: "_alerts.AlertManager | None" = None,
+                 retire_s: float | None = None):
         if sample is None:
             sample = _env_float("PADDLE_TPU_TELEMETRY_SAMPLE", 0.1)
         if ring_max is None:
             ring_max = int(_env_float("PADDLE_TPU_TELEMETRY_RING", 512))
         if linger_s is None:
             linger_s = _env_float("PADDLE_TPU_TELEMETRY_LINGER", 1.0)
+        if retire_s is None:
+            retire_s = _env_float("PADDLE_TPU_TELEMETRY_RETIRE", 120.0)
         self.sample = min(1.0, max(0.0, float(sample)))
         self.ring_max = max(1, int(ring_max))
         self.linger_s = max(0.0, float(linger_s))
+        self.retire_s = max(0.0, float(retire_s))  # 0 disables GC
         self._lock = threading.RLock()
         # (host, pid) -> process record (fleet state)
         self._procs: dict[tuple, dict] = {}
@@ -175,8 +201,37 @@ class TelemetryCollector:
         self.counts = {"batches": 0, "spans": 0, "assembled": 0,
                        "kept_error": 0, "kept_slow": 0,
                        "kept_sampled": 0, "sampled_out": 0,
-                       "evicted": 0}
+                       "evicted": 0, "procs_retired": 0,
+                       "tsdb_errors": 0}
         self._started = time.time()
+        # time-series plane: memory-only TSDB unless PADDLE_TPU_TSDB_DIR
+        # points at a data dir; PADDLE_TPU_TSDB=0 turns the whole plane
+        # off (the bench A/B toggle)
+        if tsdb is None \
+                and os.environ.get("PADDLE_TPU_TSDB", "1") != "0":
+            tsdb = _ts.TimeSeriesDB()
+        self.tsdb = tsdb
+        if alerts is None and self.tsdb is not None \
+                and os.environ.get("PADDLE_TPU_ALERTS", "1") != "0":
+            alerts = _alerts.AlertManager(
+                tsdb=self.tsdb, fleet_fn=self.fleet,
+                event_cb=self._note_alert_event)
+        self.alerts = alerts
+
+    def _note_alert_event(self, ev: dict):
+        """AlertManager transition tap: alert lifecycle shows up in the
+        fleet's recent-events feed (the `top` footer) even when no
+        local agent is armed. Called OUTSIDE the alert manager's lock."""
+        rec = {"host": socket.gethostname(), "pid": os.getpid(),
+               "role": "collector", "wall": time.time(),
+               "kind": str(ev.get("kind", "?")),
+               "attrs": ev.get("attrs") or {}}
+        with self._lock:
+            self._recent_events.append(rec)
+
+    def close(self):
+        if self.tsdb is not None:
+            self.tsdb.close()
 
     # -- ingest (tel_push) ---------------------------------------------
     def ingest(self, batch: dict) -> dict:
@@ -255,7 +310,28 @@ class TelemetryCollector:
             if metrics is not None:
                 proc["metrics"] = metrics
                 proc["summary"] = self._summarize(proc, metrics)
+            role = proc["role"]
+            summary = dict(proc.get("summary") or {})
             self._sweep_locked(now)
+        # TSDB ingest runs outside the collector lock: block seals do
+        # disk IO and the TSDB has its own lock
+        if self.tsdb is not None:
+            try:
+                if metrics is not None:
+                    self.tsdb.ingest_dump(key[0], key[1], role, metrics)
+                scal = {f"paddle_tpu_fleet_{k}": v
+                        for k, v in summary.items()
+                        if isinstance(v, (int, float))}
+                if scal:
+                    self.tsdb.ingest_scalars(
+                        time.time(), scal,
+                        {"host": key[0], "pid": str(key[1]),
+                         "role": role})
+            except Exception:
+                with self._lock:
+                    self.counts["tsdb_errors"] += 1
+        if self.alerts is not None:
+            self.alerts.maybe_evaluate()
         return {"ok": True}
 
     # -- fleet summary ---------------------------------------------------
@@ -382,6 +458,23 @@ class TelemetryCollector:
                 if now - tb.last >= self.linger_s]
         for tid in done:
             self._finalize_locked(tid, self._open.pop(tid))
+        # fleet-state GC: age out processes that stopped reporting —
+        # a dead agent must not pad the fleet table forever (the
+        # absence alert has already had retire_s > its max_age_s to
+        # notice the silence first)
+        if self.retire_s > 0:
+            wall = time.time()
+            stale = [k for k, p in self._procs.items()
+                     if wall - (p.get("last_seen") or wall)
+                     > self.retire_s]
+            for k in stale:
+                p = self._procs.pop(k)
+                self.counts["procs_retired"] += 1
+                _RETIRED.inc()
+                self._recent_events.append(
+                    {"host": k[0], "pid": k[1], "role": p.get("role"),
+                     "wall": wall, "kind": "proc_retired",
+                     "attrs": {"last_seen": p.get("last_seen")}})
 
     def sweep(self, force: bool = False) -> int:
         """Finalize quiescent (or, with ``force``, all) open traces;
@@ -489,13 +582,65 @@ class TelemetryCollector:
 
     def stats(self) -> dict:
         with self._lock:
-            return {"counts": dict(self.counts),
-                    "open": len(self._open), "kept": len(self._kept),
-                    "procs": len(self._procs),
-                    "sample": self.sample, "ring_max": self.ring_max,
-                    "linger_s": self.linger_s,
-                    "p99_threshold_s": self._p99_threshold(),
-                    "started": self._started}
+            out = {"counts": dict(self.counts),
+                   "open": len(self._open), "kept": len(self._kept),
+                   "procs": len(self._procs),
+                   "sample": self.sample, "ring_max": self.ring_max,
+                   "linger_s": self.linger_s,
+                   "retire_s": self.retire_s,
+                   "p99_threshold_s": self._p99_threshold(),
+                   "started": self._started}
+        if self.tsdb is not None:
+            out["tsdb"] = self.tsdb.stats()
+        if self.alerts is not None:
+            out["alerts"] = dict(self.alerts.counts)
+        return out
+
+    # -- TSDB query verb -------------------------------------------------
+    def tsdb_query(self, req: dict) -> dict:
+        """``tsdb_query`` verb body: one query per request.
+
+        ``{"op": "tsdb_query", "query": "rate", "metric": ...,
+           "labels": {...}, "window": 60, "q": 0.99,
+           "start": t, "end": t}``
+
+        queries: series | latest | range | delta | rate | quantile.
+        """
+        if self.tsdb is None:
+            return {"error": "tsdb disabled (PADDLE_TPU_TSDB=0)"}
+        what = str(req.get("query") or "latest")
+        metric = req.get("metric")
+        labels = req.get("labels") or None
+        try:
+            if what == "series":
+                return {"series": self.tsdb.series(metric)}
+            if metric is None:
+                return {"error": "metric required"}
+            window = float(req.get("window") or 300.0)
+            if what == "latest":
+                return {"value": self.tsdb.latest(metric, labels)}
+            if what == "range":
+                end = req.get("end")
+                end = float(end) if end is not None \
+                    else self.tsdb._default_at(metric)
+                start = req.get("start")
+                start = float(start) if start is not None \
+                    else end - window
+                return {"points": self.tsdb.range(
+                    metric, labels, start, end)}
+            if what == "delta":
+                return {"value": self.tsdb.delta(
+                    metric, window, labels)}
+            if what == "rate":
+                return {"value": self.tsdb.rate(
+                    metric, window, labels)}
+            if what == "quantile":
+                return {"value": self.tsdb.quantile(
+                    metric, float(req.get("q") or 0.99), window,
+                    labels)}
+            return {"error": f"unknown query {what!r}"}
+        except Exception as e:          # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
 
     # -- Chrome export ---------------------------------------------------
     def chrome_trace(self, tid: str) -> dict | None:
@@ -539,7 +684,20 @@ def telemetry_dispatch(collector: TelemetryCollector, req: dict,
     if op == "tel_ping":
         return {"ok": True, "t_collector": time.time()}
     if op == "tel_fleet":
+        if collector.alerts is not None:
+            collector.alerts.maybe_evaluate()
         return {"fleet": collector.fleet()}
+    if op == "tsdb_query":
+        return collector.tsdb_query(req)
+    if op == "alerts":
+        if collector.alerts is None:
+            return {"alerts": {"active": [], "history": [],
+                               "rules": []}}
+        collector.alerts.maybe_evaluate()
+        return {"alerts": collector.alerts.state()}
+    if op == "usage_report":
+        return {"usage": _meter.usage_report(
+            collector.tsdb, window=req.get("window"))}
     if op == "tel_trace":
         tid = str(req["trace_id"])
         rep = {"trace": collector.trace(tid)}
@@ -623,6 +781,7 @@ class CollectorServer:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        self.collector.close()
 
     def __enter__(self):
         return self.start()
@@ -649,6 +808,8 @@ def main(argv=None) -> int:
         while True:
             time.sleep(1.0)
             srv.collector.sweep()
+            if srv.collector.alerts is not None:
+                srv.collector.alerts.maybe_evaluate()
     except KeyboardInterrupt:
         pass
     finally:

@@ -18,9 +18,15 @@ sampled step breakdown, compile counts (a rising number mid-run is a
 compile storm), HBM/KV headroom, and the autobench per-kernel
 Pallas-vs-XLA margins.
 
+``... top history <metric>`` renders per-series unicode sparklines
+from the collector's TSDB (`tsdb_query` range); ``... top alerts``
+the alert pane (firing/pending + recent transitions); ``... top
+tenants`` the per-tenant usage pane (`usage_report`).
+
 Rendering is pure (``render_fleet`` / ``render_waterfall`` /
-``render_perf`` take the collector reply dicts), so tests drive it
-without a terminal.
+``render_perf`` / ``render_history`` / ``render_alerts`` /
+``render_tenants`` take the collector reply dicts), so tests drive
+them without a terminal.
 """
 from __future__ import annotations
 
@@ -29,8 +35,9 @@ import os
 import sys
 import time
 
-__all__ = ["render_fleet", "render_perf", "render_tier",
-           "render_waterfall", "main"]
+__all__ = ["render_alerts", "render_fleet", "render_history",
+           "render_perf", "render_tenants", "render_tier",
+           "render_waterfall", "sparkline", "main"]
 
 
 def _f(v, spec="7.1f", dash="      -") -> str:
@@ -194,6 +201,117 @@ def render_tier(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Unicode sparkline, min..max scaled, downsampled to `width` by
+    last-value-per-cell (matching the TSDB's downsampling rule)."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        step = len(vals) / width
+        vals = [vals[min(len(vals) - 1, int((i + 1) * step) - 1)]
+                for i in range(width)]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(_SPARK[min(7, int((v - lo) / span * 8))]
+                   for v in vals)
+
+
+def render_history(reply: dict, metric: str, window: float = 300.0) \
+        -> str:
+    """Sparkline pane from a ``tsdb_query`` range reply: one line per
+    matching series — label set, last value, min..max, sparkline."""
+    pts = reply.get("points") or ()
+    if reply.get("error"):
+        return f"history {metric}: {reply['error']}"
+    if not pts:
+        return f"history {metric}: no samples in the last " \
+               f"{window:.0f}s"
+    lines = [f"history {metric}  last {window:.0f}s  "
+             f"series={len(pts)}"]
+    for s in pts:
+        vals = [v for _, v in (s.get("points") or ())]
+        if not vals:
+            continue
+        labels = s.get("labels") or {}
+        tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        lines.append(
+            f"  {tag[:44]:<44} {sparkline(vals)} "
+            f"last={vals[-1]:g} min={min(vals):g} max={max(vals):g}")
+    return "\n".join(lines)
+
+
+def render_alerts(reply: dict) -> str:
+    """The alert pane from an ``alerts`` verb reply: firing/pending
+    instances first, then recent transitions, then the rule table."""
+    st = reply.get("alerts") or {}
+    active = st.get("active") or ()
+    lines = [f"alerts  active={len(active)}  "
+             f"rules={len(st.get('rules') or ())}"]
+    if active:
+        lines.append(f"  {'STATE':<8} {'SEV':<6} {'RULE':<24} "
+                     f"{'INSTANCE':<28} {'VALUE':>10}  SINCE")
+        for a in active:
+            since = a.get("since")
+            stamp = time.strftime("%H:%M:%S", time.localtime(since)) \
+                if since else "-"
+            lines.append(
+                f"  {a.get('state', '?'):<8} "
+                f"{str(a.get('severity', '-')):<6} "
+                f"{str(a.get('rule'))[:24]:<24} "
+                f"{str(a.get('instance'))[:28]:<28} "
+                f"{_f(a.get('value'), '10.3f')}  {stamp}"
+                + (f"  bundle={a['bundle']}" if a.get("bundle")
+                   else ""))
+    else:
+        lines.append("  (quiet — nothing pending or firing)")
+    hist = st.get("history") or ()
+    if hist:
+        lines.append("recent transitions:")
+        for h in list(hist)[-8:]:
+            w = h.get("at")
+            stamp = time.strftime("%H:%M:%S", time.localtime(w)) \
+                if w else "--:--:--"
+            lines.append(f"  {stamp} {h.get('rule')} "
+                         f"[{h.get('instance')}] -> {h.get('state')}")
+    return "\n".join(lines)
+
+
+def render_tenants(reply: dict) -> str:
+    """The per-tenant usage pane from a ``usage_report`` reply."""
+    usage = reply.get("usage") or {}
+    tenants = usage.get("tenants") or {}
+    lines = [f"tenant usage ({usage.get('scope', '?')})"
+             + (f"  window={usage['window_s']:.0f}s"
+                if usage.get("window_s") else "")]
+    if not tenants:
+        lines.append("  (no tenant traffic metered yet)")
+        return "\n".join(lines)
+    lines.append(f"  {'TENANT':<16} {'TIER':<4} {'TOK IN':>10} "
+                 f"{'TOK OUT':>10} {'QUEUE s':>9} {'KV PAGE s':>10} "
+                 f"{'GFLOPs':>9}  OUTCOMES")
+    for key in sorted(tenants):
+        u = tenants[key]
+        outs = u.get("outcomes") or {}
+        outs_s = " ".join(f"{k}={v:g}" for k, v in sorted(outs.items())
+                          if v) or "-"
+        gflops = (u.get("flops") or 0.0) / 1e9
+        lines.append(
+            f"  {str(u.get('tenant'))[:16]:<16} "
+            f"{str(u.get('tier')):<4} "
+            f"{_f(u.get('tokens_in'), '10.0f')} "
+            f"{_f(u.get('tokens_out'), '10.0f')} "
+            f"{_f(u.get('queue_seconds'), '9.1f')} "
+            f"{_f(u.get('kv_page_seconds'), '10.1f')} "
+            f"{gflops:9.3f}  {outs_s}")
+    return "\n".join(lines)
+
+
 def render_waterfall(trace: dict) -> str:
     """The assembled cross-process waterfall of one ``tel_trace``
     reply: spans in aligned start order, indented by span parentage,
@@ -251,8 +369,10 @@ def main(argv=None) -> int:
         prog="paddle_tpu.observability.top",
         description="live fleet dashboard / trace waterfall viewer")
     ap.add_argument("cmd", nargs="?", default="top",
-                    choices=["top", "trace", "perf", "tier"])
-    ap.add_argument("trace_id", nargs="?")
+                    choices=["top", "trace", "perf", "tier",
+                             "history", "alerts", "tenants"])
+    ap.add_argument("trace_id", nargs="?",
+                    help="trace: trace id; history: metric name")
     ap.add_argument("--collector", default=os.environ.get(
         "PADDLE_TPU_TELEMETRY_COLLECTOR") or "127.0.0.1:8600")
     ap.add_argument("--interval", type=float, default=1.0)
@@ -260,6 +380,8 @@ def main(argv=None) -> int:
                     help="print one snapshot and exit (no ANSI)")
     ap.add_argument("--out", help="trace: write the merged Chrome "
                                   "trace JSON here")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="history/tenants: trailing seconds")
     args = ap.parse_args(argv)
 
     from ..distributed.fleet.runtime.rpc import RpcClient
@@ -285,12 +407,27 @@ def main(argv=None) -> int:
                     json.dump(rep["chrome"], f)
                 print(f"chrome trace -> {args.out}")
             return 0
-        # top/perf: live loop (or one shot)
-        render = {"perf": render_perf,
-                  "tier": render_tier}.get(args.cmd, render_fleet)
+        if args.cmd == "history" and not args.trace_id:
+            print("usage: ... history <metric>", file=sys.stderr)
+            return 2
+        # live loop (or one shot); each pane knows its own verb
         while True:
-            fleet = cli.call({"op": "tel_fleet"})["fleet"]
-            text = render(fleet)
+            if args.cmd == "history":
+                rep = cli.call({"op": "tsdb_query", "query": "range",
+                                "metric": args.trace_id,
+                                "window": args.window})
+                text = render_history(rep, args.trace_id, args.window)
+            elif args.cmd == "alerts":
+                text = render_alerts(cli.call({"op": "alerts"}))
+            elif args.cmd == "tenants":
+                text = render_tenants(cli.call(
+                    {"op": "usage_report", "window": args.window}))
+            else:
+                render = {"perf": render_perf,
+                          "tier": render_tier}.get(args.cmd,
+                                                   render_fleet)
+                fleet = cli.call({"op": "tel_fleet"})["fleet"]
+                text = render(fleet)
             if args.once:
                 print(text)
                 return 0

@@ -36,8 +36,9 @@ import numpy as np
 
 from ..distributed.fleet.runtime import fault_injection as _fi
 from ..observability import (debug as _debug, flight as _flight,
-                             perf as _perf, registry as _obs,
-                             tracing as _tracing, watchdog as _watchdog)
+                             meter as _meter, perf as _perf,
+                             registry as _obs, tracing as _tracing,
+                             watchdog as _watchdog)
 from .kv_cache import PagePool, defrag_plan
 from .scheduler import QueueFull, Request, Scheduler
 
@@ -272,6 +273,10 @@ class Engine:
         # keyed by a trace id even without a wire hop
         req.trace_id = _tracing.TRACER.current_trace_id() \
             or _tracing.new_trace_id()
+        # offered load is metered even if the scheduler rejects below —
+        # billing sees what the tenant *sent*, not what was admitted
+        _meter.METER.note_submitted(req.tenant, req.priority,
+                                    int(req.prompt.size))
         self.scheduler.submit(req)
         self._m_reqs.inc()
         _flight.record("serving", "submit", trace_id=req.trace_id,
@@ -615,6 +620,30 @@ class Engine:
         with self._stats_lock:
             self._tok_window.append((time.monotonic(), n))
 
+    def _req_flops(self, req: Request) -> float:
+        """Metering-grade FLOPs estimate for one finished request from
+        the compiled-cost registry: its prefill bucket's cost plus a
+        per-token share of the decode bucket (a decode step's cost
+        amortizes over the slot batch it ran with)."""
+        if req.started_at is None:
+            return 0.0          # never admitted — nothing executed
+        T = _bucket_len(int(req.prompt.size), self.page_size)
+        T = min(T, self.max_pages_per_req * self.page_size)
+        total = self._bucket_flops.get(f"prefill[{T}]", 0.0)
+        decode_toks = max(0, len(req.generated) - 1)
+        if decode_toks:
+            shares = []
+            for bucket, fl in self._bucket_flops.items():
+                if bucket.startswith("decode[slots="):
+                    s = bucket[len("decode[slots="):].split(",", 1)[0]
+                    try:
+                        shares.append(fl / max(1, int(s)))
+                    except ValueError:
+                        pass
+            if shares:
+                total += decode_toks * (sum(shares) / len(shares))
+        return total
+
     def _note_done(self, req: Request):
         self._wd_progress += 1
         lat = req.latency()
@@ -622,6 +651,8 @@ class Engine:
             self._m_latency_h.observe(lat)
             with self._stats_lock:
                 self._latencies.append(lat)
+        _meter.METER.note_flops(req.tenant, req.priority,
+                                self._req_flops(req))
         with self._stats_lock:
             self._recent.append(_req_summary(req, "finished"))
 

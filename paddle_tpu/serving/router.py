@@ -81,8 +81,8 @@ from ..distributed.fleet.runtime.rpc import (PSRemoteError, RpcClient,
                                              _env_float as _env_f,
                                              serve_connection)
 from ..observability import (debug as _debug, flight as _flight,
-                             registry as _obs, tracing as _tracing,
-                             watchdog as _watchdog)
+                             meter as _meter, registry as _obs,
+                             tracing as _tracing, watchdog as _watchdog)
 from ..observability.collector import (TEL_READ_OPS, TelemetryCollector,
                                        telemetry_dispatch)
 
@@ -676,6 +676,7 @@ class Router(socketserver.ThreadingTCPServer):
 
     def _relay_inner(self, req: dict, rid: int | None):
         fwd = self._forward_req(req)
+        tenant = fwd["tenant"]
         stream_up = bool(req.get("stream"))
         session = req.get("session")
         first_t = float(req.get("timeout") or self.default_timeout) + 5.0
@@ -733,6 +734,7 @@ class Router(socketserver.ThreadingTCPServer):
                 ok = True
                 _R_REQS.labels(router=self.router_id,
                                outcome="error").inc()
+                _meter.METER.note_routed(tenant, "error")
                 return {"status": "error", "error": str(e)}
             except (socket.timeout, WireError, ConnectionError,
                     OSError) as e:
@@ -775,6 +777,7 @@ class Router(socketserver.ThreadingTCPServer):
             if status == "rejected" and sent:
                 break                # partial stream: NOT clean backpressure
             _R_REQS.labels(router=self.router_id, outcome=status).inc()
+            _meter.METER.note_routed(tenant, status)
             return final
         # give-up reply. "rejected" means nothing was admitted ANYWHERE
         # (safe to resubmit); once tokens were streamed upstream the
@@ -785,6 +788,7 @@ class Router(socketserver.ThreadingTCPServer):
                                or last_err == "replica backpressure")
         outcome = "rejected" if clean else "failed"
         _R_REQS.labels(router=self.router_id, outcome=outcome).inc()
+        _meter.METER.note_routed(tenant, outcome)
         detail = "no routable replica with capacity" \
             if last_err is None else last_err
         if sent:
@@ -813,7 +817,8 @@ class Router(socketserver.ThreadingTCPServer):
             return _obs.prometheus_text()
         if op == "debug_dump":
             return _debug.dump_verb(req)
-        if op and op.startswith("tel_"):
+        if op and (op.startswith("tel_")
+                   or op in ("tsdb_query", "alerts", "usage_report")):
             if self.collector is None:
                 raise ValueError("telemetry collector not hosted here "
                                  "(set PADDLE_TPU_TELEMETRY_HOST=1)")

@@ -46,7 +46,8 @@ from collections import deque
 
 import numpy as np
 
-from ..observability import flight as _flight, registry as _obs
+from ..observability import (flight as _flight, meter as _meter,
+                             registry as _obs)
 from .kv_cache import PagePool
 
 __all__ = ["Request", "Scheduler", "QueueFull", "QuotaExceeded",
@@ -355,6 +356,8 @@ class Scheduler:
                 # backpressure reply ("rejected") tells well-behaved
                 # clients and the router to go elsewhere
                 self._m_rejected.inc()
+                _meter.METER.note_outcome(req.tenant, req.priority,
+                                          "rejected")
                 _flight.record("serving", "reject",
                                trace_id=req.trace_id, inst=self.inst,
                                request=req.id, reason="draining")
@@ -369,6 +372,8 @@ class Scheduler:
             if bucket is not None \
                     and bucket.available() < req.total_tokens:
                 self._m_quota_rejected.inc()
+                _meter.METER.note_outcome(req.tenant, req.priority,
+                                          "quota")
                 _flight.record("serving", "reject",
                                trace_id=req.trace_id, inst=self.inst,
                                request=req.id, reason="quota",
@@ -392,6 +397,8 @@ class Scheduler:
                     victim = worst
                 else:
                     self._m_rejected.inc()
+                    _meter.METER.note_outcome(req.tenant, req.priority,
+                                              "rejected")
                     _flight.record("serving", "reject",
                                    trace_id=req.trace_id, inst=self.inst,
                                    request=req.id, reason="queue_full",
@@ -558,11 +565,27 @@ class Scheduler:
             if req._finished:
                 return False
             req._finished = True
+        now = self.now()
+        pages = 0
         if req.table is not None:
+            pages = len(req.table.pages)   # before free() recycles them
             self.pool.free(req.table)
             req.table = None
         req.status = status
-        req.finished_at = self.now()
+        req.finished_at = now
+        # per-tenant accounting: what this request consumed reaching its
+        # terminal state — queue wait, generated tokens, and the HBM it
+        # held (pages × slot residency)
+        queue_s = 0.0
+        if req._queued_at is not None:
+            queue_s = max(0.0, (req.started_at or now) - req._queued_at)
+        kv_page_s = 0.0
+        if req.started_at is not None:
+            kv_page_s = pages * max(0.0, now - req.started_at)
+        _meter.METER.note_outcome(req.tenant, req.priority,
+                                  reason or status,
+                                  tokens_out=len(req.generated),
+                                  queue_s=queue_s, kv_page_s=kv_page_s)
         _EVICTIONS.labels(inst=self.inst,
                           reason=reason or status).inc()
         _flight.record("serving", "evict", trace_id=req.trace_id,
