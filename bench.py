@@ -38,7 +38,10 @@ sampler at its default cadence vs off, same harness and bar) |
 checkpoint (store save/restore MB/s,
 dedup ratio on a 1%-mutated state, async-vs-sync save step overhead,
 <5% bar) | slo (open-loop traffic replay against the serving tier:
-SLO attainment, goodput, p99 TTFT/ITL) | chaos (same seeded traffic +
+SLO attainment, goodput, p99 TTFT/ITL) | prefix (shared-prefix radix
+KV cache A/B, cache on vs off on a system-prompt + unique-suffix mix:
+goodput tokens/s, p99 TTFT, prefill-FLOPs reduction and the measured
+effective-KV-capacity multiplier) | chaos (same seeded traffic +
 a serving_decode stall mid-run: watchdog detection + recovery seconds
 and post-recovery SLO delta vs the fault-free baseline) | router
 (replicated fleet behind the fault-tolerant router: one replica killed
@@ -863,6 +866,124 @@ def bench_slo(duration=6.0, rate=30.0, seed=7):
             "shed": st["shed"], "preemptions": st["preemptions"],
             "expired_in_queue": st["expired_in_queue"],
             "all_finished": bool(finished)}
+
+
+def bench_prefix(num_requests=24, pool_prompts=2, prefix_len=64,
+                 suffix_len=8, max_new=8, num_slots=8, seed=0):
+    """BENCH_CONFIG=prefix (docs/SERVING.md shared-prefix section):
+    the radix prefix cache A/B'd on the workload it exists for — every
+    request is one of `pool_prompts` long system prompts plus a unique
+    user suffix. The SAME request mix runs cache-off then cache-on
+    (both warmed so XLA compiles never land in a timed window) and the
+    record reports goodput tokens/s, p99 TTFT, the prefill-compute
+    reduction (prefill cost is token-proportional at one model config,
+    so saved prefill tokens ARE saved prefill FLOPs), and the measured
+    effective-KV-capacity multiplier: logical KV pages the live batch
+    addresses per physical page allocated (1.0 unshared; the
+    acceptance bar is >= 2x on this mix)."""
+    import threading
+
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving import Engine, GPTDecodeModel
+
+    cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                    max_position_embeddings=256, vocab_size=4096)
+    model = GPTDecodeModel(cfg, seed=seed)
+    rng = np.random.RandomState(seed)
+    pool = [rng.randint(0, cfg.vocab_size,
+                        (prefix_len,)).astype(np.int32)
+            for _ in range(pool_prompts)]
+    prompts = []
+    for i in range(num_requests):
+        sfx = rng.randint(0, cfg.vocab_size,
+                          (suffix_len,)).astype(np.int32)
+        prompts.append(np.concatenate([pool[i % pool_prompts], sfx]))
+    total_prompt_tokens = sum(int(p.size) for p in prompts)
+
+    def run(cache_pages):
+        eng = Engine(model, num_slots=num_slots, num_pages=128,
+                     page_size=8, max_seq_len=96,
+                     prefix_cache_pages=cache_pages)
+        peak = {"mult": 1.0, "used": 0}
+        stop = threading.Event()
+
+        def sampler():
+            # effective KV capacity, measured live: logical pages the
+            # active batch addresses vs DISTINCT physical pages backing
+            # them (shared pages counted once). Read-only racy peek at
+            # the slot array — a torn read mid-admission just skips one
+            # sample.
+            while not stop.is_set():
+                try:
+                    live = [r for r in eng.scheduler.slots
+                            if r is not None]
+                    logical = sum(len(r.table.pages) for r in live)
+                    phys = len({p for r in live for p in r.table.pages})
+                    if phys and len(live) >= num_slots // 2:
+                        peak["mult"] = max(peak["mult"],
+                                           logical / phys)
+                    peak["used"] = max(peak["used"],
+                                       eng.pool.stats()["used_pages"])
+                except Exception:
+                    pass
+                time.sleep(0.002)
+        with eng:
+            # warmup compiles every bucket this mix touches and leaves
+            # the cache hot, so the timed window measures steady-state
+            # serving. The suffixes must DIFFER: a repeat of the same
+            # prompt is a full-prompt match (bootstrap, no prefill at
+            # all), and the prefill_tail bucket would then pay its XLA
+            # compile inside the timed window
+            for pfx in pool:
+                for _ in range(2):
+                    w = np.concatenate([pfx, rng.randint(
+                        0, cfg.vocab_size,
+                        (suffix_len,)).astype(np.int32)])
+                    eng.generate(w, 2)
+            pre = eng.stats()["prefix_cache"] or {}
+            th = threading.Thread(target=sampler, daemon=True)
+            th.start()
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, max_new) for p in prompts]
+            eng.run_until_idle()
+            dt = time.perf_counter() - t0
+            stop.set()
+            th.join(timeout=5)
+            post = eng.stats()["prefix_cache"] or {}
+            st = eng.stats()
+        ntok = sum(len(r.generated) for r in reqs)
+        ttfts = sorted(r.ttft() for r in reqs if r.ttft() is not None)
+        saved = post.get("tokens_saved", 0) - pre.get("tokens_saved", 0)
+        return {
+            "goodput_tokens_per_sec": round(ntok / dt, 1),
+            "ttft_ms_p50": round(ttfts[len(ttfts) // 2] * 1e3, 2),
+            "ttft_ms_p99": round(ttfts[min(len(ttfts) - 1,
+                                           int(0.99 * len(ttfts)))]
+                                 * 1e3, 2),
+            "prefill_tokens_saved": int(saved),
+            "prefill_flops_reduction": round(
+                saved / total_prompt_tokens, 4),
+            "kv_capacity_multiplier": round(peak["mult"], 2),
+            "peak_used_pages": peak["used"],
+            "compiles": st["compiles"],
+            "cache": post or None,
+        }
+
+    off = run(0)
+    on = run(64)
+    off_p99 = off["ttft_ms_p99"]
+    return {"metric": "prefix_cache_kv_capacity_multiplier",
+            "value": on["kv_capacity_multiplier"], "unit": "x logical/physical",
+            "requests": num_requests, "pool_prompts": pool_prompts,
+            "prefix_len": prefix_len, "suffix_len": suffix_len,
+            "max_new": max_new,
+            "goodput_speedup": round(
+                on["goodput_tokens_per_sec"]
+                / max(1e-9, off["goodput_tokens_per_sec"]), 2),
+            "ttft_p99_speedup": round(
+                off_p99 / max(1e-9, on["ttft_ms_p99"]), 2),
+            "prefill_flops_reduction": on["prefill_flops_reduction"],
+            "cache_on": on, "cache_off": off}
 
 
 def bench_chaos(duration=8.0, rate=25.0, seed=7, stall_s=0.8,
@@ -2281,6 +2402,8 @@ def main():
         rec = bench_serving()
     elif which == "slo":
         rec = bench_slo()
+    elif which == "prefix":
+        rec = bench_prefix()
     elif which == "chaos":
         rec = bench_chaos()
     elif which == "router":

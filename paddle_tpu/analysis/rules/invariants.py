@@ -325,6 +325,20 @@ REQUIRED_METRICS = {
     "paddle_tpu_tenant_router_requests_total",
     "paddle_tpu_tenant_overflow_total",
     "paddle_tpu_telemetry_procs_retired_total",
+    # shared-prefix KV reuse + replayable sampling (docs/SERVING.md):
+    # cache effectiveness (hit/miss/tokens-saved), the COW and
+    # eviction safety valves, residency gauges, and how much traffic
+    # rides stochastic decode — the prefix bench and the `top` prefix
+    # row read these exact names
+    "paddle_tpu_prefix_lookup_hits_total",
+    "paddle_tpu_prefix_lookup_misses_total",
+    "paddle_tpu_prefix_prefill_tokens_saved_total",
+    "paddle_tpu_prefix_cow_copies_total",
+    "paddle_tpu_prefix_evicted_pages_total",
+    "paddle_tpu_prefix_cached_pages",
+    "paddle_tpu_prefix_shared_pages",
+    "paddle_tpu_sampling_requests_total",
+    "paddle_tpu_sampling_tokens_total",
 }
 
 

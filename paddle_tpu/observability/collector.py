@@ -444,6 +444,31 @@ class TelemetryCollector:
             tier["pull_p50"], tier["pull_p99"] = q
         if tier:
             out["tier"] = tier
+        # shared-prefix KV cache + stochastic decode (docs/SERVING.md):
+        # hit ratio, prefill tokens the cache absorbed, COW/eviction
+        # churn and residency — what the `top` prefix row renders
+        prefix = {}
+        hits_ = total("paddle_tpu_prefix_lookup_hits_total")
+        misses_ = total("paddle_tpu_prefix_lookup_misses_total")
+        if hits_ or misses_:
+            prefix["lookups"] = (hits_ or 0.0) + (misses_ or 0.0)
+            prefix["hit_ratio"] = (hits_ or 0.0) / prefix["lookups"]
+        for key_, name in (
+                ("tokens_saved",
+                 "paddle_tpu_prefix_prefill_tokens_saved_total"),
+                ("cow_copies", "paddle_tpu_prefix_cow_copies_total"),
+                ("evicted", "paddle_tpu_prefix_evicted_pages_total"),
+                ("cached_pages", "paddle_tpu_prefix_cached_pages"),
+                ("shared_pages", "paddle_tpu_prefix_shared_pages"),
+                ("sampled_requests",
+                 "paddle_tpu_sampling_requests_total"),
+                ("sampled_tokens",
+                 "paddle_tpu_sampling_tokens_total")):
+            v = total(name)
+            if v:
+                prefix[key_] = v
+        if prefix:
+            out["prefix"] = prefix
         return out
 
     # -- completion + tail sampling --------------------------------------

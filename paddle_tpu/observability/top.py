@@ -201,6 +201,40 @@ def render_tier(fleet: dict) -> str:
     return "\n".join(lines)
 
 
+def render_prefix(fleet: dict) -> str:
+    """The prefix-cache pane of a ``tel_fleet`` reply: per-engine hit
+    ratio, prefill tokens the radix cache absorbed, COW/eviction churn,
+    residency, and how much decode rides stochastic sampling
+    (docs/SERVING.md shared-prefix section)."""
+    lines = [f"{'ROLE':<16} {'HOST:PID':<22} {'HIT%':>6} {'LOOKUPS':>8} "
+             f"{'TOK SAVED':>10} {'CACHED':>7} {'SHARED':>7} "
+             f"{'COW':>5} {'EVICT':>6} {'SAMPLED req/tok':>16}"]
+    any_prefix = False
+    for p in fleet.get("procs") or ():
+        pf = (p.get("summary") or {}).get("prefix") or {}
+        if not pf:
+            continue
+        any_prefix = True
+        ratio = pf.get("hit_ratio")
+        lines.append(
+            f"{str(p.get('role'))[:16]:<16} "
+            f"{p.get('host')}:{p.get('pid'):<10} "
+            f"{_f(None if ratio is None else ratio * 100, '6.1f')} "
+            f"{_f(pf.get('lookups'), '8.0f')} "
+            f"{_f(pf.get('tokens_saved'), '10.0f')} "
+            f"{_f(pf.get('cached_pages'), '7.0f', '      0')} "
+            f"{_f(pf.get('shared_pages'), '7.0f', '      0')} "
+            f"{_f(pf.get('cow_copies'), '5.0f', '    0')} "
+            f"{_f(pf.get('evicted'), '6.0f', '     0')} "
+            f"{_f(pf.get('sampled_requests'), '7.0f', '      0')}/"
+            f"{_f(pf.get('sampled_tokens'), '8.0f', '       0')}")
+    if not any_prefix:
+        lines.append("(no prefix-cache traffic yet — engines report "
+                     "after PADDLE_TPU_PREFIX_CACHE_PAGES > 0 sees a "
+                     "lookup)")
+    return "\n".join(lines)
+
+
 _SPARK = "▁▂▃▄▅▆▇█"
 
 
@@ -369,7 +403,7 @@ def main(argv=None) -> int:
         prog="paddle_tpu.observability.top",
         description="live fleet dashboard / trace waterfall viewer")
     ap.add_argument("cmd", nargs="?", default="top",
-                    choices=["top", "trace", "perf", "tier",
+                    choices=["top", "trace", "perf", "tier", "prefix",
                              "history", "alerts", "tenants"])
     ap.add_argument("trace_id", nargs="?",
                     help="trace: trace id; history: metric name")
@@ -424,8 +458,9 @@ def main(argv=None) -> int:
                     {"op": "usage_report", "window": args.window}))
             else:
                 render = {"perf": render_perf,
-                          "tier": render_tier}.get(args.cmd,
-                                                   render_fleet)
+                          "tier": render_tier,
+                          "prefix": render_prefix}.get(args.cmd,
+                                                       render_fleet)
                 fleet = cli.call({"op": "tel_fleet"})["fleet"]
                 text = render(fleet)
             if args.once:

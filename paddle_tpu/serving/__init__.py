@@ -29,6 +29,8 @@ token frames, exactly-once failover, draining, and elastic respawn
 from engine checkpoints — the same ServingClient talks to it.
 """
 from .kv_cache import PagePool, PageTable, defrag_plan, pages_needed
+from .prefix_cache import PrefixCache, PrefixMatch
+from .sampling import SamplingParams, derive_seed
 from .scheduler import (QueueFull, QuotaExceeded, Request, Scheduler,
                         TokenBucket)
 from .model import GPTDecodeModel
@@ -40,6 +42,7 @@ from .router import InProcessReplica, Replica, ReplicaSpec, Router
 
 __all__ = [
     "PagePool", "PageTable", "pages_needed", "defrag_plan",
+    "PrefixCache", "PrefixMatch", "SamplingParams", "derive_seed",
     "Request", "Scheduler", "QueueFull", "QuotaExceeded", "TokenBucket",
     "GPTDecodeModel", "Engine", "ServingServer", "ServingClient",
     "Arrival", "LoadGenerator", "LoadResult", "TrafficConfig",

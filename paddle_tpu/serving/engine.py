@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import threading
 import time
 import weakref
@@ -40,6 +41,8 @@ from ..observability import (debug as _debug, flight as _flight,
                              registry as _obs, tracing as _tracing,
                              watchdog as _watchdog)
 from .kv_cache import PagePool, defrag_plan
+from .prefix_cache import PrefixCache
+from .sampling import sample_tokens, seed_to_key
 from .scheduler import QueueFull, Request, Scheduler
 
 __all__ = ["Engine", "QueueFull"]
@@ -79,13 +82,21 @@ _QUEUE_DEPTH = _obs.gauge(
 _OCCUPANCY = _obs.gauge(
     "paddle_tpu_serving_page_occupancy",
     "fraction of KV pages in use (live)", ["engine"])
+_SAMPLING_REQS = _obs.counter(
+    "paddle_tpu_sampling_requests_total",
+    "requests submitted with temperature > 0", ["engine"])
+_SAMPLING_TOKENS = _obs.counter(
+    "paddle_tpu_sampling_tokens_total",
+    "tokens drawn from the Philox sampler (temperature > 0)",
+    ["engine"])
 
 _engine_ids = itertools.count()
 
 
 def _drop_engine_series(eid: str):
     for m in (_REQS, _TOKENS, _STEPS, _COMPILES, _DECODE_H, _PREFILL_H,
-              _LATENCY_H, _QUEUE_DEPTH, _OCCUPANCY):
+              _LATENCY_H, _QUEUE_DEPTH, _OCCUPANCY, _SAMPLING_REQS,
+              _SAMPLING_TOKENS):
         m.remove_matching(engine=eid)
 
 
@@ -110,7 +121,8 @@ def _req_summary(req: Request, where: str) -> dict:
 class Engine:
     def __init__(self, model, num_slots: int = 8, num_pages: int = 64,
                  page_size: int = 16, max_seq_len: int | None = None,
-                 eos_id: int | None = None, max_queue: int = 256):
+                 eos_id: int | None = None, max_queue: int = 256,
+                 prefix_cache_pages: int | None = None):
         import jax
 
         self.model = model
@@ -142,6 +154,19 @@ class Engine:
                                    inst=self.engine_id)
         self.trash_page = num_pages      # model pools carry P+1 pages
         self.cache = model.init_cache(num_pages, page_size)
+        # shared-prefix KV reuse (serving/prefix_cache.py): 0 pages =
+        # disabled (the default — an idle engine then provably holds no
+        # pages, the PR-2 invariant tests pin that)
+        if prefix_cache_pages is None:
+            prefix_cache_pages = int(os.environ.get(
+                "PADDLE_TPU_PREFIX_CACHE_PAGES", "0") or 0)
+        self.prefix_cache = None
+        if prefix_cache_pages > 0:
+            self.prefix_cache = PrefixCache(
+                self.pool, budget_pages=min(prefix_cache_pages,
+                                            num_pages),
+                inst=self.engine_id)
+            self.scheduler.prefix_cache = self.prefix_cache
 
         self._compiles: dict[str, int] = defaultdict(int)
         self._latencies: deque[float] = deque(maxlen=4096)
@@ -154,6 +179,8 @@ class Engine:
         self._m_decode_h = _DECODE_H.labels(engine=eid)
         self._m_prefill_h = _PREFILL_H.labels(engine=eid)
         self._m_latency_h = _LATENCY_H.labels(engine=eid)
+        self._m_sampling_reqs = _SAMPLING_REQS.labels(engine=eid)
+        self._m_sampling_tokens = _SAMPLING_TOKENS.labels(engine=eid)
         # live gauges read through a weakref so the registry never pins
         # a dead engine (tests build hundreds per process)
         wr = weakref.ref(self)
@@ -217,22 +244,41 @@ class Engine:
             _flight.record("serving", "compile", engine=eid,
                            bucket=bucket)
 
-        def prefill(params, cache, tokens, true_len, page_row):
+        # sampling params ride every program as slot-wide TRACED arrays
+        # (sampling.py): a greedy slot (temperature 0) still takes the
+        # literal argmax path inside sample_tokens, and no sampling
+        # value can ever force a recompile — the one-compile-per-bucket
+        # contract is pinned with sampling enabled
+        def prefill(params, cache, tokens, true_len, page_row,
+                    temps, topks, topps, seeds, steps):
             note_compile(f"prefill[{tokens.shape[0]}]")  # trace-time
             cache, logits = model.prefill(params, cache, tokens,
                                           true_len, page_row)
-            import jax.numpy as jnp
-            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+            tok = sample_tokens(logits[None, :], temps, topks, topps,
+                                seeds, steps)
+            return cache, tok[0]
 
-        def decode(params, cache, tokens, positions, tables):
+        def prefill_tail(params, cache, tokens, start, true_len,
+                         page_row, temps, topks, topps, seeds, steps):
+            note_compile(f"prefill_tail[{tokens.shape[0]}]")
+            cache, logits = model.prefill_tail(params, cache, tokens,
+                                               start, true_len,
+                                               page_row)
+            tok = sample_tokens(logits[None, :], temps, topks, topps,
+                                seeds, steps)
+            return cache, tok[0]
+
+        def decode(params, cache, tokens, positions, tables,
+                   temps, topks, topps, seeds, steps):
             note_compile(f"decode[slots={S},pages={M}]")  # trace-time
             cache, logits = model.decode(params, cache, tokens,
                                          positions, tables)
-            import jax.numpy as jnp
-            return cache, jnp.argmax(logits, -1).astype(jnp.int32)
+            return cache, sample_tokens(logits, temps, topks, topps,
+                                        seeds, steps)
 
         kw = {"donate_argnums": (1,)} if donate else {}
         self._prefill = jax.jit(prefill, **kw)
+        self._prefill_tail = jax.jit(prefill_tail, **kw)
         self._decode = jax.jit(decode, **kw)
 
         # perf plane: per-bucket FLOP costs land in _register_perf_cost
@@ -256,17 +302,27 @@ class Engine:
     def submit(self, prompt, max_new_tokens: int = 16,
                deadline: float | None = None,
                eos_id: int | None = None, priority: int = 1,
-               tenant: str = "default") -> Request:
+               tenant: str = "default", temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0,
+               seed: int | None = None) -> Request:
         """Enqueue a request. `deadline` is RELATIVE seconds from now;
         raises QueueFull (backpressure) when the queue is at capacity
         and QuotaExceeded (a QueueFull) when `tenant` is over its
         token-bucket quota. `priority` is the admission tier
-        (0 = highest; see scheduler.Scheduler)."""
+        (0 = highest; see scheduler.Scheduler). `temperature` 0 is
+        greedy; > 0 samples via the replayable (seed, step) Philox
+        stream (serving/sampling.py) — `seed` defaults to the request
+        id, so an identical resubmission with an explicit seed (or the
+        same wire id through the frontend) replays token-for-token."""
         req = Request(prompt, max_new_tokens,
                       deadline=None if deadline is None
                       else time.monotonic() + deadline,
                       eos_id=eos_id if eos_id is not None else self.eos_id,
-                      priority=priority, tenant=tenant)
+                      priority=priority, tenant=tenant,
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      seed=seed)
+        if req.temperature > 0:
+            self._m_sampling_reqs.inc()
         # carry the caller's trace context (e.g. the frontend handler's
         # wire trace id) onto the request — minting a fresh id for
         # in-process callers, so EVERY request's flight timeline is
@@ -290,12 +346,15 @@ class Engine:
     def generate(self, prompt, max_new_tokens: int = 16,
                  deadline: float | None = None,
                  timeout: float | None = 120.0, priority: int = 1,
-                 tenant: str = "default") -> np.ndarray:
+                 tenant: str = "default", temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: int | None = None) -> np.ndarray:
         """Blocking convenience: submit + wait (requires the scheduler
         thread running, or another thread driving step())."""
         return self.submit(prompt, max_new_tokens, deadline=deadline,
-                           priority=priority,
-                           tenant=tenant).result(timeout)
+                           priority=priority, tenant=tenant,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p, seed=seed).result(timeout)
 
     # -- checkpoint warm-start ------------------------------------------
     def warm_start(self, root: str, step: int | None = None,
@@ -347,16 +406,75 @@ class Engine:
         return req.table.padded(self.max_pages_per_req,
                                 fill=self.trash_page)
 
+    def _req_sampling(self, req: Request):
+        """Shape-[1] traced sampling args for the prefill programs."""
+        seed = req.seed if req.seed is not None else req.id
+        return (np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_k], np.int32),
+                np.asarray([req.top_p], np.float32),
+                seed_to_key(seed).reshape(1, 2),
+                np.asarray([len(req.generated)], np.int32))
+
+    def _apply_cow(self, req: Request):
+        """Full-prompt bootstrap admission: copy the last matched page
+        (the decode step will rewrite the last prompt position's KV
+        there) into the request's private page, then drop the lookup
+        ref the scheduler kept pinned for exactly this copy."""
+        src, dst = req.prefix_cow
+        self.cache = self.model.copy_pages(self.cache, [src], [dst])
+        req.prefix_cow = None
+        self.pool.free([src])
+        if self.prefix_cache is not None:
+            self.prefix_cache.note_cow()
+        _flight.record("serving", "prefix_cow", trace_id=req.trace_id,
+                       engine=self.engine_id, request=req.id,
+                       src=src, dst=dst)
+
+    def _cache_insert_prompt(self, req: Request):
+        """Publish the freshly prefilled prompt's full pages (existing
+        cached prefixes dedupe inside insert)."""
+        if self.prefix_cache is None:
+            return
+        n = int(req.prompt.size) // self.page_size
+        if n:
+            self.prefix_cache.insert(req.prompt[:n * self.page_size],
+                                     req.table.pages[:n])
+
     def _run_prefill(self, req: Request):
         import jax.numpy as jnp
-        T = _bucket_len(req.prompt.size, self.page_size)
-        T = min(T, self.max_pages_per_req * self.page_size)
+        if req.prefix_cow is not None:
+            self._apply_cow(req)
+        m = req.prefix_match
+        if m is not None and m.full:
+            # bootstrap: the WHOLE prompt was cached — no prefill at
+            # all. The request enters the decode batch with no
+            # generated tokens; the next decode step feeds the last
+            # prompt token at position prompt_len-1 (re-deriving that
+            # position's KV into the COW page, bit-identical in the
+            # parity regime) and samples the first token there.
+            _flight.record("serving", "prefill_skipped",
+                           trace_id=req.trace_id, engine=self.engine_id,
+                           request=req.id,
+                           cached_tokens=m.tokens)
+            return
+        start = m.tokens if m is not None else 0
+        tail = req.prompt[start:] if start else req.prompt
+        T = _bucket_len(tail.size, self.page_size)
+        T = min(T, self.max_pages_per_req * self.page_size - start)
         toks = np.zeros((T,), np.int32)
-        toks[:req.prompt.size] = req.prompt
-        bucket = f"prefill[{T}]"
-        targs = (self.model.params, self.cache, jnp.asarray(toks),
-                 np.int32(req.prompt.size),
-                 jnp.asarray(self._row(req), dtype=jnp.int32))
+        toks[:tail.size] = tail
+        row = jnp.asarray(self._row(req), dtype=jnp.int32)
+        samp = self._req_sampling(req)
+        if start:
+            bucket = f"prefill_tail[{T}]"
+            fn = self._prefill_tail
+            targs = (self.model.params, self.cache, jnp.asarray(toks),
+                     np.int32(start), np.int32(tail.size), row, *samp)
+        else:
+            bucket = f"prefill[{T}]"
+            fn = self._prefill
+            targs = (self.model.params, self.cache, jnp.asarray(toks),
+                     np.int32(tail.size), row, *samp)
         # read BEFORE the cost registration: lower() traces the fn and
         # seeds the jit cache, so the note_compile side effect fires
         # there, not on the timed first call
@@ -364,12 +482,13 @@ class Engine:
         if bucket not in self._compiles:
             # first call of this bucket pays the compile anyway; the
             # abstract lowering for cost analysis rides the same path
-            self._register_perf_cost(bucket, self._prefill, targs, T, T)
+            self._register_perf_cost(bucket, fn, targs, T, start + T)
         t0 = time.perf_counter()
         with _tracing.span("engine.prefill", trace_id=req.trace_id,
                            engine=self.engine_id, request=req.id,
-                           prompt_len=int(req.prompt.size), bucket=T):
-            self.cache, tok = self._prefill(*targs)
+                           prompt_len=int(req.prompt.size), bucket=T,
+                           cached_tokens=start):
+            self.cache, tok = fn(*targs)
             tok = int(tok)
         dt = time.perf_counter() - t0
         self._m_prefill_h.observe(dt)
@@ -379,7 +498,10 @@ class Engine:
         _flight.record("serving", "prefill", trace_id=req.trace_id,
                        engine=self.engine_id, request=req.id,
                        bucket=T, seconds=round(dt, 6))
+        self._cache_insert_prompt(req)
         self._note_tokens(1)
+        if req.temperature > 0:
+            self._m_sampling_tokens.inc()
         if self.scheduler.record_token(req, tok):
             self._note_done(req)
 
@@ -410,10 +532,29 @@ class Engine:
             positions = np.zeros((S,), np.int32)
             tables = np.full((S, self.max_pages_per_req), self.trash_page,
                              np.int32)
+            temps = np.zeros((S,), np.float32)
+            topks = np.zeros((S,), np.int32)
+            topps = np.ones((S,), np.float32)
+            seeds = np.zeros((S, 2), np.uint32)
+            steps = np.zeros((S,), np.int32)
+            sampled_n = 0
             for i, r in active:
-                tokens[i] = r.generated[-1]
+                # a bootstrap admission (whole prompt cached, prefill
+                # skipped) reaches its first decode with NOTHING
+                # generated: feed the last prompt token at position
+                # prompt_len-1, exactly where prefill would have left it
+                tokens[i] = r.generated[-1] if r.generated \
+                    else int(r.prompt[-1])
                 positions[i] = r.position
                 tables[i] = self._row(r)
+                temps[i] = r.temperature
+                topks[i] = r.top_k
+                topps[i] = r.top_p
+                seeds[i] = seed_to_key(r.seed if r.seed is not None
+                                       else r.id)
+                steps[i] = len(r.generated)
+                if r.temperature > 0:
+                    sampled_n += 1
             # hang injection (chaos drills): PADDLE_PS_FAULT_STALL with
             # PADDLE_PS_FAULT_STALL_POINT=serving_decode wedges the
             # step thread here — inside the step lock, exactly like a
@@ -422,7 +563,10 @@ class Engine:
             _fi.injector().maybe_stall("serving_decode")
             bucket = f"decode[slots={S},pages={self.max_pages_per_req}]"
             targs = (self.model.params, self.cache, jnp.asarray(tokens),
-                     jnp.asarray(positions), jnp.asarray(tables))
+                     jnp.asarray(positions), jnp.asarray(tables),
+                     jnp.asarray(temps), jnp.asarray(topks),
+                     jnp.asarray(topps), jnp.asarray(seeds),
+                     jnp.asarray(steps))
             # as in _run_prefill: read before lower() runs the trace
             pre_compiles = self._compiles.get(bucket, 0)
             if bucket not in self._compiles:
@@ -471,6 +615,8 @@ class Engine:
                     "transfer": t3 - t2,
                 })
             self._note_tokens(len(active))
+            if sampled_n:
+                self._m_sampling_tokens.inc(sampled_n)
             self._note_flops(self._bucket_flops.get(bucket))
             self._m_steps.inc()
             _flight.record("serving", "step", engine=self.engine_id,
@@ -522,10 +668,23 @@ class Engine:
         raise RuntimeError(f"not idle after {max_steps} steps")
 
     def defrag(self):
-        """Compact live pages to the low end of the pool (between steps)."""
+        """Compact live pages to the low end of the pool (between steps).
+        Shared pages move once; every holder — tables, the prefix
+        cache's runs, and any pending COW source — is rewritten through
+        the same mapping."""
         with self._lock:
-            tables = [r.table for r in self.scheduler.active_requests()]
-            mapping = defrag_plan(self.pool, tables)
+            active = list(self.scheduler.active_requests())
+            tables = [r.table for r in active]
+            extra = self.prefix_cache.pages() if self.prefix_cache \
+                else ()
+            mapping = defrag_plan(self.pool, tables, extra_pages=extra)
+            if self.prefix_cache is not None:
+                self.prefix_cache.remap(mapping)
+            for r in active:
+                if r.prefix_cow is not None:
+                    src, dst = r.prefix_cow
+                    r.prefix_cow = (mapping.get(src, src),
+                                    mapping.get(dst, dst))
             self.cache = self.model.apply_defrag(self.cache, mapping)
             return mapping
 
@@ -698,6 +857,8 @@ class Engine:
         rates = self.perf_rates()
         return {**self.scheduler.stats(),
                 "pool": self.pool.stats(),
+                "prefix_cache": self.prefix_cache.stats()
+                if self.prefix_cache is not None else None,
                 "model_version": self.model_version,
                 "steps": int(self._m_steps.value),
                 "tokens_generated": total,

@@ -10,7 +10,8 @@ Ops:
   {"op": "generate", "prompt": <int ndarray>, "max_new_tokens": n,
    "deadline": seconds|None, "timeout": seconds,
    "priority": tier (0 = highest, default 1), "tenant": str,
-   "stream": bool}
+   "temperature": f (0 = greedy), "top_k": n, "top_p": f,
+   "seed": int|absent, "stream": bool}
       -> {"status": "done"|"deadline"|"timeout"|"rejected"|"shed"|
                     "error",
           "tokens": <int32 ndarray>, ...}
@@ -72,6 +73,7 @@ from ..distributed.fleet.runtime.rpc import (RpcClient, RpcServerState,
                                              serve_connection)
 from ..observability import (debug as _debug, registry as _obs,
                              tracing as _tracing)
+from .sampling import SamplingParams, derive_seed
 from .scheduler import QueueFull
 
 __all__ = ["ServingServer", "ServingClient"]
@@ -95,7 +97,13 @@ class ServingServer(socketserver.ThreadingTCPServer):
         # wire-chosen path — same rule as debug_dump's destination
         self.publish_root = publish_root if publish_root is not None \
             else (os.environ.get("PADDLE_TPU_PUBLISH_DIR") or None)
-        self._rpc = RpcServerState(read_ops=self.READ_OPS, secret=secret)
+        # expose_req_id: the wire request id seeds stochastic sampling
+        # when the client sent none — a transport retry AND a router
+        # failover both relay the ORIGINAL id, so a replayed request
+        # derives the same seed and emits the identical token sequence
+        # (serving/sampling.py replay contract)
+        self._rpc = RpcServerState(read_ops=self.READ_OPS, secret=secret,
+                                   expose_req_id=True)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -166,6 +174,8 @@ class ServingServer(socketserver.ThreadingTCPServer):
 
     def _dispatch(self, req: dict):
         op = req.get("op")
+        rid = req.pop("_req_id", None) if isinstance(req, dict) \
+            else None
         if op == "ping":
             # the router's combined health + load probe: queue depth and
             # occupancy WITHOUT engine.stats()'s latency sort, so a
@@ -232,11 +242,21 @@ class ServingServer(socketserver.ThreadingTCPServer):
             with _tracing.span("frontend.generate",
                                prompt_len=int(prompt.size)) as sp:
                 try:
+                    sp_params = SamplingParams.from_request(req)
+                    seed = sp_params.seed
+                    if seed is None and sp_params.temperature > 0 \
+                            and rid:
+                        # no client seed: key the Philox stream by the
+                        # STABLE wire id (retries/failovers relay it)
+                        seed = derive_seed(rid)
                     h = self.engine.submit(
                         prompt, int(req.get("max_new_tokens", 16)),
                         deadline=req.get("deadline"),
                         priority=int(req.get("priority", 1)),
-                        tenant=str(req.get("tenant", "default")))
+                        tenant=str(req.get("tenant", "default")),
+                        temperature=sp_params.temperature,
+                        top_k=sp_params.top_k, top_p=sp_params.top_p,
+                        seed=seed)
                 except QueueFull as e:
                     sp.attrs["status"] = "rejected"
                     return {"status": "rejected", "error": str(e)}
@@ -395,7 +415,9 @@ class ServingClient:
                  deadline: float | None = None,
                  timeout: float = 120.0, priority: int = 1,
                  tenant: str = "default", session: str | None = None,
-                 stream: bool = False, on_token=None) -> dict:
+                 stream: bool = False, on_token=None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int | None = None) -> dict:
         """One generation round-trip. ``stream=True`` asks the server
         to push tokens as they decode; ``on_token(tokens, index)`` is
         called per pushed frame on this thread and delivers every token
@@ -411,6 +433,9 @@ class ServingClient:
                "max_new_tokens": int(max_new_tokens),
                "deadline": deadline, "timeout": timeout,
                "priority": int(priority), "tenant": str(tenant)}
+        # only non-default sampling knobs go on the wire (validated
+        # here so a bad temperature fails client-side, not mid-stream)
+        SamplingParams(temperature, top_k, top_p, seed).to_request(req)
         if session is not None:
             req["session"] = str(session)
         if not stream:

@@ -7,11 +7,16 @@ sequences grow), addressed through a per-request page table. This module
 is the host-side accountant:
 
   * `PagePool` — free-list allocator over `num_pages` page slots with
-    capacity-based admission control (`can_admit`) and occupancy stats;
+    capacity-based admission control (`can_admit`), occupancy stats, and
+    REFCOUNTS: a page handed out once may be shared by several holders
+    (page tables of requests with a common prompt prefix, plus the
+    prefix cache itself — serving/prefix_cache.py); `free` decrements
+    and the page only returns to the free list at zero;
   * `PageTable` — one request's ordered page list + logical length;
   * `defrag` — compacts live pages to the low end of the pool (device
     gather + table rewrite) so a long-running engine can shrink its pool
-    or snapshot a dense prefix.
+    or snapshot a dense prefix. Shared pages move once and every holder
+    is rewritten through the same mapping.
 
 The device arrays themselves ([L, P, ps, H, d] pools) are built by the
 model adapter (serving/model.py); the pool hands out page INDICES only,
@@ -92,6 +97,11 @@ class PagePool:
         self.page_size = page_size
         self._lock = threading.Lock()
         self._free = list(range(num_pages - 1, -1, -1))  # pop() -> low idx
+        # refcount per ALLOCATED page (absent = free). alloc() starts a
+        # page at 1; ref() adds holders (prefix-cache hits); free()
+        # decrements and recycles only at zero — the invariant the
+        # shared-prefix machinery rests on.
+        self._refs: dict[int, int] = {}
         # stats — registry-backed series labeled per pool instance
         # (`inst` lets an Engine align the pool's label with its own)
         self.inst = inst if inst is not None else f"p{next(_pool_ids)}"
@@ -137,6 +147,20 @@ class PagePool:
         an admitted request can never deadlock the pool mid-decode."""
         return pages_needed(total_tokens, self.page_size) <= self.free_pages
 
+    @property
+    def shared_pages(self) -> int:
+        """Pages currently held by more than one holder."""
+        with self._lock:
+            return sum(1 for c in self._refs.values() if c > 1)
+
+    def is_shared(self, page: int) -> bool:
+        with self._lock:
+            return self._refs.get(page, 0) > 1
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
+
     # -- alloc/free ----------------------------------------------------
     def alloc(self, n: int) -> list[int] | None:
         """n pages, or None (and no partial allocation) if unavailable."""
@@ -145,8 +169,21 @@ class PagePool:
                 self._m_alloc_failures.inc()
                 return None
             got = [self._free.pop() for _ in range(n)]
+            for p in got:
+                self._refs[p] = 1
         self._m_allocs.inc(n)
         return got
+
+    def ref(self, pages) -> None:
+        """Add one holder to each (already-allocated) page — the
+        prefix-cache hit path: a request admitted onto cached pages
+        shares them until its own `free`."""
+        with self._lock:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(f"ref of free page {p}")
+            for p in pages:
+                self._refs[p] += 1
 
     def alloc_table(self, total_tokens: int) -> PageTable | None:
         pages = self.alloc(pages_needed(total_tokens, self.page_size))
@@ -157,46 +194,72 @@ class PagePool:
         return t
 
     def free(self, table_or_pages) -> None:
+        """Drop one holder per page; pages whose refcount reaches zero
+        return to the free list (freed-page metric counts only those)."""
         pages = table_or_pages.pages if isinstance(table_or_pages, PageTable) \
             else list(table_or_pages)
         with self._lock:
-            live = set(self._free)
             for p in pages:
                 if not 0 <= p < self.num_pages:
                     raise ValueError(f"page {p} outside pool")
-                if p in live:
+                if p not in self._refs:
                     raise ValueError(f"double free of page {p}")
-            self._free.extend(sorted(pages, reverse=True))
-        self._m_frees.inc(len(pages))
+            recycled = []
+            for p in pages:
+                c = self._refs[p] - 1
+                if c:
+                    self._refs[p] = c
+                else:
+                    del self._refs[p]
+                    recycled.append(p)
+            self._free.extend(sorted(recycled, reverse=True))
+        self._m_frees.inc(len(recycled))
         if isinstance(table_or_pages, PageTable):
             table_or_pages.pages = []
 
     def stats(self) -> dict:
         with self._lock:
             free = len(self._free)
+            shared = sum(1 for c in self._refs.values() if c > 1)
         return {"num_pages": self.num_pages, "page_size": self.page_size,
                 "free_pages": free,
                 "used_pages": self.num_pages - free,
                 "occupancy": round(1 - free / self.num_pages, 4),
+                "shared_pages": shared,
                 "alloc_count": self.alloc_count,
                 "free_count": self.free_count,
                 "alloc_failures": self.alloc_failures}
 
 
-def defrag_plan(pool: PagePool, tables: list[PageTable]) -> dict[int, int]:
+def defrag_plan(pool: PagePool, tables: list[PageTable],
+                extra_pages=()) -> dict[int, int]:
     """Mapping old_page -> new_page that compacts all live pages into the
-    lowest indices (stable: table order, then page order). The caller
-    applies it to the device pools (serving/model.py
-    `apply_defrag`) and this function rewrites tables + the free list.
+    lowest indices (stable: table order, then page order, first holder
+    wins for a shared page). The caller applies it to the device pools
+    (serving/model.py `apply_defrag`) and this function rewrites tables,
+    refcounts, and the free list. `extra_pages` names live pages held
+    outside any table (the prefix cache's runs) — the caller must remap
+    its own holders with the returned mapping.
 
     Safe only while the engine step is quiesced (the scheduler calls it
     between steps)."""
-    live: list[int] = [p for t in tables for p in t.pages]
-    if len(set(live)) != len(live):
-        raise ValueError("page shared by two tables — corrupt state")
-    mapping = {old: new for new, old in enumerate(live)}
+    order: list[int] = []
+    seen: set[int] = set()
+    for p in itertools.chain((p for t in tables for p in t.pages),
+                             extra_pages):
+        if p not in seen:
+            seen.add(p)
+            order.append(p)
+    mapping = {old: new for new, old in enumerate(order)}
     for t in tables:
         t.pages = [mapping[p] for p in t.pages]
     with pool._lock:
-        pool._free = list(range(pool.num_pages - 1, len(live) - 1, -1))
+        if seen != set(pool._refs):
+            missing = sorted(set(pool._refs) - seen)
+            raise ValueError(
+                f"defrag plan covers {len(seen)} pages but the pool has "
+                f"{len(pool._refs)} allocated (unaccounted: "
+                f"{missing[:8]}) — pass every holder's pages")
+        pool._refs = {mapping[p]: c for p, c in pool._refs.items()}
+        pool._free = list(range(pool.num_pages - 1, len(order) - 1, -1))
     return mapping

@@ -102,7 +102,11 @@ class TrafficConfig:
                  burst_factor: float = 4.0,
                  prompt_lens=None, output_lens=None,
                  tenants=None, tiers=None, deadlines=None,
-                 vocab_size: int = 256, seed: int = 0):
+                 vocab_size: int = 256, seed: int = 0,
+                 prefix_pool: int = 0, prefix_len: int = 0,
+                 prefix_zipf: float = 1.1,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0):
         if arrival not in ("constant", "diurnal", "bursty"):
             raise ValueError(f"unknown arrival process {arrival!r}")
         if not 0.0 <= diurnal_depth < 1.0:
@@ -127,6 +131,26 @@ class TrafficConfig:
             else {0: 30.0, 1: 60.0, 2: None}
         self.vocab_size = int(vocab_size)
         self.seed = int(seed)
+        # shared-prefix traffic (PR 19): each arrival prepends a
+        # zipf-popular system prompt from a pool of `prefix_pool`
+        # fixed prefixes of `prefix_len` tokens, then its own unique
+        # suffix — the fleet-shaped workload the radix prefix cache
+        # exists for. 0/0 (the default) leaves every existing config's
+        # schedule byte-identical.
+        self.prefix_pool = int(prefix_pool)
+        self.prefix_len = int(prefix_len)
+        self.prefix_zipf = float(prefix_zipf)
+        if self.prefix_pool < 0 or self.prefix_len < 0:
+            raise ValueError("prefix_pool/prefix_len must be >= 0")
+        if self.prefix_zipf <= 0:
+            raise ValueError("prefix_zipf must be > 0")
+        # stochastic decode knobs stamped onto every arrival
+        # (serving/sampling.py validates the same ranges server-side)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
 
     # -- time-varying offered rate --------------------------------------
     def rate_at(self, t: float) -> float:
@@ -152,10 +176,12 @@ class Arrival:
     """One scheduled request: offset seconds from run start + tags."""
 
     __slots__ = ("index", "t", "prompt", "max_new_tokens", "tenant",
-                 "tier", "deadline")
+                 "tier", "deadline", "temperature", "top_k", "top_p",
+                 "seed")
 
     def __init__(self, index, t, prompt, max_new_tokens, tenant, tier,
-                 deadline):
+                 deadline, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=None):
         self.index = index
         self.t = t
         self.prompt = prompt
@@ -163,6 +189,13 @@ class Arrival:
         self.tenant = tenant
         self.tier = tier
         self.deadline = deadline
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        # per-arrival seed (from the per-index Philox stream): the
+        # whole point is that a same-config rerun resubmits the SAME
+        # seed, so stochastic decode replays token-for-token
+        self.seed = seed
 
     def __repr__(self):
         return (f"Arrival({self.index}, t={self.t:.4f}, "
@@ -216,6 +249,21 @@ class LoadGenerator:
         cfg = self.cfg
         rng = np.random.Generator(np.random.Philox(key=cfg.seed))
         lam = cfg.peak_rate
+        # shared system-prompt pool: its own key word ((1<<64)-1 can
+        # never collide with a per-index stream), zipf-ranked weights
+        # (entry 0 most popular) drawn per arrival from the main stream
+        pool: list[np.ndarray] = []
+        pool_w = None
+        if cfg.prefix_pool > 0 and cfg.prefix_len > 0:
+            prng0 = np.random.Generator(np.random.Philox(
+                key=np.array([cfg.seed, (1 << 64) - 1], np.uint64)))
+            pool = [prng0.integers(0, cfg.vocab_size,
+                                   size=cfg.prefix_len,
+                                   dtype=np.int64).astype(np.int32)
+                    for _ in range(cfg.prefix_pool)]
+            pool_w = 1.0 / np.arange(
+                1, cfg.prefix_pool + 1) ** cfg.prefix_zipf
+            pool_w = pool_w / pool_w.sum()
         out: list[Arrival] = []
         t = 0.0
         i = 0
@@ -238,8 +286,21 @@ class LoadGenerator:
                 key=(cfg.seed, i)))
             prompt = prng.integers(0, cfg.vocab_size, size=plen,
                                    dtype=np.int64).astype(np.int32)
+            if pool:
+                # zipf-popular shared head + this request's unique
+                # suffix (the suffix is the plen draw above, so prompt
+                # content without a pool is unchanged byte-for-byte)
+                j = int(rng.choice(len(pool), p=pool_w))
+                prompt = np.concatenate([pool[j], prompt])
+            seed = None
+            if cfg.temperature > 0:
+                # per-index stream again: the i-th arrival's seed never
+                # depends on thinning, so a rerun replays it exactly
+                seed = int(prng.integers(0, 1 << 62))
             out.append(Arrival(i, t, prompt, mnt, tenant, tier,
-                               deadline))
+                               deadline, temperature=cfg.temperature,
+                               top_k=cfg.top_k, top_p=cfg.top_p,
+                               seed=seed))
             i += 1
         return out
 
@@ -279,7 +340,10 @@ class LoadGenerator:
         def submit(arr: Arrival):
             return engine.submit(arr.prompt, arr.max_new_tokens,
                                  deadline=arr.deadline,
-                                 priority=arr.tier, tenant=arr.tenant)
+                                 priority=arr.tier, tenant=arr.tenant,
+                                 temperature=arr.temperature,
+                                 top_k=arr.top_k, top_p=arr.top_p,
+                                 seed=arr.seed)
         return self.run(submit, **kw)
 
     def run_client(self, client, timeout: float = 120.0,
@@ -353,7 +417,9 @@ class LoadGenerator:
                         deadline=arr.deadline, timeout=timeout,
                         priority=arr.tier, tenant=arr.tenant,
                         stream=stream,
-                        on_token=h.on_tokens if stream else None)
+                        on_token=h.on_tokens if stream else None,
+                        temperature=arr.temperature, top_k=arr.top_k,
+                        top_p=arr.top_p, seed=arr.seed)
                     h.status = rep.get("status", "error")
                     h.trace_id = rep.get("trace_id")
                     h.generated = list(np.asarray(

@@ -219,6 +219,77 @@ class GPTDecodeModel:
             @ params["wte"].T.astype(jnp.float32)
         return {"k": ck, "v": cv}, logits
 
+    # -- tail prefill (shared-prefix admission) ------------------------
+    def prefill_tail(self, params, cache, tokens, start, true_len,
+                     page_row):
+        """Prefill ONLY the unmatched tail of a prompt whose first
+        `start` tokens (page-aligned) were found in the prefix cache
+        with their KV already resident: tokens [T] int32 (padded tail
+        bucket), start scalar int32 (page-aligned logical offset),
+        true_len scalar int32 (real tail length), page_row [M] int32
+        (matched + owned pages, fill = trash). Returns
+        (cache, logits [V]) — the logits of the last real tail position.
+
+        Numerics: each tail position is computed exactly like a decode
+        step for that position — K/V scattered into its page, then
+        ragged paged attention over the request's own history with
+        ctx = position + 1 — so the greedy-parity contract the decode
+        path pins (bit-match vs the dense forward) carries over to
+        shared-prefix admissions unchanged."""
+        import jax
+        cfg = self.cfg
+        H, d = cfg.num_heads, self.head_dim
+        T = tokens.shape[0]
+        ps = cache["k"].shape[2]
+        n_pages = T // ps
+        positions = start + jnp.arange(T, dtype=jnp.int32)
+        x = jnp.take(params["wte"], tokens, axis=0) \
+            + jnp.take(params["wpe"], positions, axis=0)       # [T, D]
+        # every tail token shares the request's page row; per-token
+        # causal masking rides the ctx lengths, as in decode
+        tables = jnp.broadcast_to(page_row[None, :],
+                                  (T, page_row.shape[0]))
+        ctx = positions + 1
+        # the tail's own pages: page_row[start//ps : start//ps + T//ps]
+        tail_pages = jax.lax.dynamic_slice_in_dim(
+            page_row, start // ps, n_pages)
+
+        def body(carry, xs):
+            x, ck, cv = carry
+            p, l = xs
+            h = _ln(x, p["ln1_s"], p["ln1_b"], cfg.layer_norm_eps)
+            q, k, v = self._qkv(p, h)
+            kp = k.reshape(n_pages, ps, H, d).astype(ck.dtype)
+            vp = v.reshape(n_pages, ps, H, d).astype(cv.dtype)
+            ck = ck.at[l, tail_pages].set(kp)
+            cv = cv.at[l, tail_pages].set(vp)
+            a = paged_attention_decode(
+                q.reshape(T, H, d), ck[l], cv[l], tables, ctx,
+                scale=1.0 / math.sqrt(d), impl=self.attn_impl)
+            x = decoder_tail(p, a.reshape(T, -1), x, cfg)
+            return (x, ck, cv), None
+
+        L = cfg.num_layers
+        (x, ck, cv), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["blocks"], jnp.arange(L)))
+        xlast = jax.lax.dynamic_index_in_dim(x, true_len - 1, 0,
+                                             keepdims=False)
+        xlast = _ln(xlast, params["lnf_s"], params["lnf_b"],
+                    cfg.layer_norm_eps)
+        logits = xlast.astype(jnp.float32) \
+            @ params["wte"].T.astype(jnp.float32)
+        return {"k": ck, "v": cv}, logits
+
+    def copy_pages(self, cache, src, dst):
+        """Copy page contents src[i] -> dst[i] across every layer pool —
+        the copy-on-write step for a full-prompt bootstrap admission
+        (one small device gather/scatter per pool, outside jit)."""
+        src = jnp.asarray(src, jnp.int32)
+        dst = jnp.asarray(dst, jnp.int32)
+        return {"k": cache["k"].at[:, dst].set(cache["k"][:, src]),
+                "v": cache["v"].at[:, dst].set(cache["v"][:, src])}
+
     # -- decode --------------------------------------------------------
     def decode(self, params, cache, tokens, positions, tables):
         """tokens/positions [S] int32, tables [S, M] int32 (fill = trash;

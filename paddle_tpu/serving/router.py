@@ -65,6 +65,7 @@ Env knobs (constructor kwargs win; docs/ENV_KNOBS.md):
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import os
 import socket
@@ -305,6 +306,13 @@ class Router(socketserver.ThreadingTCPServer):
         self._pick_seq = itertools.count(1)
         self._sessions: OrderedDict[str, str] = OrderedDict()
         self._session_cap = 4096
+        # prefix-affinity (PR 19): sessionless requests sharing a
+        # prompt prefix prefer the replica that served it last, so the
+        # replica's radix prefix cache keeps hitting. A HINT only —
+        # capacity/spill/failover rules are unchanged, and a miss just
+        # falls through to least-loaded.
+        self._prefix_affinity: OrderedDict[str, str] = OrderedDict()
+        self._prefix_cap = 4096
         self._lock = threading.Lock()
         self._stop_ev = threading.Event()
         self._bg_threads: list[threading.Thread] = []
@@ -575,10 +583,24 @@ class Router(socketserver.ThreadingTCPServer):
                 ).start()
 
     # -- dispatch -------------------------------------------------------
-    def _pick(self, session: str | None, exclude: set) \
-            -> Replica | None:
+    @staticmethod
+    def _prefix_key(prompt) -> str:
+        """Stable hash of the prompt's leading tokens (the shared
+        system-prompt region). 64 tokens comfortably covers the page-
+        aligned prefixes the replica-side radix cache can actually
+        reuse without the router knowing any replica's page size."""
+        head = np.ascontiguousarray(np.asarray(prompt).ravel()[:64],
+                                    dtype=np.int64)
+        return hashlib.blake2b(head.tobytes(), digest_size=8).hexdigest()
+
+    def _pick(self, session: str | None, exclude: set,
+              prefix: str | None = None) -> Replica | None:
         """Reserve the least-loaded routable replica (None = nothing
-        routable with capacity). Pure in-memory under the router lock."""
+        routable with capacity). Pure in-memory under the router lock.
+        Sticky preferences, strongest first: an established session,
+        then the prompt-prefix affinity hint — both only when the
+        preferred replica is routable with capacity, never overriding
+        spill or failover exclusion."""
         with self._lock:
             owner = None
             if session is not None:
@@ -594,12 +616,33 @@ class Router(socketserver.ThreadingTCPServer):
                                        replica=owner.name
                                        ).set(owner.inflight)
                     return owner
+            if session is None and prefix is not None:
+                name = self._prefix_affinity.get(prefix)
+                pref = self._replicas.get(name) if name else None
+                if pref is not None and pref.routable \
+                        and pref.name not in exclude \
+                        and pref.has_capacity():
+                    self._prefix_affinity.move_to_end(prefix)
+                    pref.inflight += 1
+                    pref.last_pick = next(self._pick_seq)
+                    _R_INFLIGHT.labels(router=self.router_id,
+                                       replica=pref.name
+                                       ).set(pref.inflight)
+                    return pref
             cands = [r for r in self._replicas.values()
                      if r.routable and r.name not in exclude
                      and r.has_capacity()]
             if not cands:
                 return None
             r = min(cands, key=Replica.load_key)
+            if session is None and prefix is not None:
+                # remember where this prefix landed (dead/at-capacity
+                # preferred replicas get overwritten here, so the hint
+                # self-heals after failover)
+                self._prefix_affinity[prefix] = r.name
+                self._prefix_affinity.move_to_end(prefix)
+                while len(self._prefix_affinity) > self._prefix_cap:
+                    self._prefix_affinity.popitem(last=False)
             if session is not None and (owner is None
                                         or not owner.routable):
                 # remap the session only when its replica stopped
@@ -658,6 +701,13 @@ class Router(socketserver.ThreadingTCPServer):
                # the inter-frame gap is the router's only mid-generation
                # stall signal, and TTFT becomes wire-observable
                "stream": True}
+        # sampling knobs relay verbatim — the replica (not the router)
+        # resolves a missing seed from the wire request id, and the
+        # router pins that id across failover, so a relayed retry on a
+        # survivor replica replays the identical token stream
+        for key in ("temperature", "top_k", "top_p", "seed"):
+            if key in req:
+                fwd[key] = req[key]
         return fwd
 
     def _relay(self, req: dict, rid: int | None):
@@ -683,8 +733,9 @@ class Router(socketserver.ThreadingTCPServer):
         sent = 0                     # tokens already relayed upstream
         tried: set[str] = set()
         last_err: str | None = None
+        pfx = self._prefix_key(req["prompt"]) if session is None else None
         for _attempt in range(self.failover_retries + 1):
-            r = self._pick(session, tried)
+            r = self._pick(session, tried, prefix=pfx)
             if r is None:
                 break
             tried.add(r.name)

@@ -48,7 +48,7 @@ import numpy as np
 
 from ..observability import (flight as _flight, meter as _meter,
                              registry as _obs)
-from .kv_cache import PagePool
+from .kv_cache import PagePool, PageTable, pages_needed
 
 __all__ = ["Request", "Scheduler", "QueueFull", "QuotaExceeded",
            "TokenBucket"]
@@ -153,7 +153,9 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens: int, deadline: float | None
                  = None, eos_id: int | None = None, priority: int = 1,
-                 tenant: str = "default"):
+                 tenant: str = "default", temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: int | None = None):
         self.id = next(_req_ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -165,6 +167,22 @@ class Request:
         self.eos_id = eos_id
         self.priority = max(0, int(priority))
         self.tenant = str(tenant)
+        # stochastic decode (serving/sampling.py): temperature 0 =
+        # greedy; seed None = keyed by the request identity (engine)
+        self.temperature = float(temperature)
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        self.top_k = max(0, int(top_k))
+        self.top_p = float(top_p)
+        if not 0 < self.top_p <= 1:
+            raise ValueError("top_p must be in (0, 1]")
+        self.seed = None if seed is None else int(seed)
+        # shared-prefix admission (serving/prefix_cache.py): the match
+        # this request was admitted onto, and — for a full-prompt
+        # bootstrap — the pending (src, dst) copy-on-write pair whose
+        # src ref is pinned until the engine's device copy
+        self.prefix_match = None
+        self.prefix_cow: tuple[int, int] | None = None
         self.trace_id: str | None = None  # set by Engine.submit
         self.generated: list[int] = []
         self.status = "queued"
@@ -276,6 +294,9 @@ class Scheduler:
         self.slots: list[Request | None] = [None] * num_slots
         self.queue: deque[Request] = deque()
         self.quotas: dict[str, TokenBucket] = {}
+        # shared-prefix admission: installed by the Engine when
+        # PADDLE_TPU_PREFIX_CACHE_PAGES > 0 (serving/prefix_cache.py)
+        self.prefix_cache = None
         # graceful drain: True = admit nothing new, finish what's here
         # (the router stops routing to a draining replica; docs/SERVING.md)
         self.draining = False
@@ -471,6 +492,41 @@ class Scheduler:
                    key=lambda r: (self.effective_priority(r, t), r.id),
                    default=None)
 
+    def _alloc_for(self, req: Request):
+        """The request's PageTable: a prefix-cache hit charges only the
+        unshared tail (+1 COW page when the whole prompt matched — the
+        bootstrap decode rewrites the last prompt position); a miss (or
+        no cache) pays the full worst case, as always. Lookup refs are
+        either installed in the table (retired with it) or released
+        here when the tail allocation fails; a pool-blocked allocation
+        retries once after shedding cold cache-only pages, so the cache
+        can never starve live admissions."""
+        ps = self.pool.page_size
+        cache = self.prefix_cache
+        match = cache.lookup(req.prompt) if cache is not None else None
+        total = pages_needed(req.total_tokens, ps)
+        matched = 0 if match is None else len(match.pages)
+        need = total - matched + (1 if match is not None and match.full
+                                  else 0)
+        pages = self.pool.alloc(need)
+        if pages is None and cache is not None and cache.reclaim(need):
+            pages = self.pool.alloc(need)
+        if pages is None:
+            if match is not None:
+                self.pool.free(match.pages)   # release the lookup refs
+            return None
+        table = PageTable(ps)
+        if match is None:
+            table.pages = pages
+        elif match.full:
+            table.pages = match.pages[:-1] + [pages[0]] + pages[1:]
+            req.prefix_cow = (match.pages[-1], pages[0])
+            req.prefix_match = match
+        else:
+            table.pages = match.pages + pages
+            req.prefix_match = match
+        return table
+
     def admit(self) -> list[Request]:
         """Admit queued requests into free slots in effective-priority
         order (tier after aging, FIFO within a tier) while the pool can
@@ -484,7 +540,7 @@ class Scheduler:
                 if not self.queue:
                     break
                 head = self._pick_head(self.now())
-                table = self.pool.alloc_table(head.total_tokens)
+                table = self._alloc_for(head)
                 if table is None:
                     # the scheduler DECIDED to block admission: the
                     # reason belongs in the flight record, it is what a
@@ -508,8 +564,10 @@ class Scheduler:
             self._m_admitted.inc()
             _flight.record("serving", "admit", trace_id=head.trace_id,
                            inst=self.inst, request=head.id, slot=i,
-                           pages=len(table.pages), tier=head.priority,
-                           tenant=head.tenant)
+                           pages=len(table.pages),
+                           cached_pages=0 if head.prefix_match is None
+                           else len(head.prefix_match.pages),
+                           tier=head.priority, tenant=head.tenant)
             out.append(head)
         return out
 
@@ -566,8 +624,29 @@ class Scheduler:
                 return False
             req._finished = True
         now = self.now()
+        if req.prefix_cow is not None:
+            # bootstrap admission that died before the engine's COW
+            # copy: drop the pinned lookup ref on the source page
+            self.pool.free([req.prefix_cow[0]])
+            req.prefix_cow = None
         pages = 0
         if req.table is not None:
+            if status == "done" and self.prefix_cache is not None:
+                # retirement insert: publish prompt+generated pages so
+                # a follow-up turn reuses this conversation's KV. The
+                # LAST generated token's KV is never written (decode
+                # writes token t's KV while generating t+1), hence the
+                # total-1 page ceiling.
+                total = int(req.prompt.size) + len(req.generated)
+                n = min((total - 1) // self.pool.page_size,
+                        len(req.table.pages))
+                if n > 0:
+                    toks = np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.generated, np.int32)])
+                    self.prefix_cache.insert(
+                        toks[:n * self.pool.page_size],
+                        req.table.pages[:n])
             pages = len(req.table.pages)   # before free() recycles them
             self.pool.free(req.table)
             req.table = None

@@ -300,10 +300,15 @@ ENGINE_STATS_KEYS = {
     "model_version",
     # PR-14 perf plane: live efficiency surface — a fleet scrape
     # answers the MFU question without a profiler
-    "tokens_per_s_per_chip", "mfu"}
+    "tokens_per_s_per_chip", "mfu",
+    # PR-19 shared-prefix KV reuse: cache stats block (None when the
+    # cache is disabled, which is the default)
+    "prefix_cache"}
 POOL_STATS_KEYS = {
     "num_pages", "page_size", "free_pages", "used_pages", "occupancy",
-    "alloc_count", "free_count", "alloc_failures"}
+    "alloc_count", "free_count", "alloc_failures",
+    # PR-19: pages referenced by >1 holder (prefix sharing)
+    "shared_pages"}
 
 
 @pytest.fixture(scope="module")

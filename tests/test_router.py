@@ -318,6 +318,62 @@ def test_session_affinity_sticks_until_unroutable(bare_router):
     assert bare_router._pick("sess", set()) is other
 
 
+def test_prefix_affinity_hint_sticks_and_self_heals(bare_router):
+    """PR 19: sessionless requests sharing a prompt prefix prefer the
+    replica that served the prefix last, so that replica's radix
+    prefix cache keeps hitting — but the hint never overrides
+    capacity, failover exclusion, or session affinity, and it
+    re-learns (self-heals) whenever the pick falls through."""
+    a, b = _fake_replicas(bare_router, 2)
+    pfx = Router._prefix_key([3, 1, 4, 1, 5, 9, 2, 6])
+    assert pfx != Router._prefix_key([9, 9, 9])      # prefixes hash apart
+    first = bare_router._pick(None, set(), prefix=pfx)
+    other = b if first is a else a
+    bare_router._release(first, True)
+    # repeat picks with the same prefix stick to the learned replica,
+    # even though the peer is equally idle
+    for _ in range(3):
+        got = bare_router._pick(None, set(), prefix=pfx)
+        assert got is first
+        bare_router._release(got, True)
+    # failover exclusion beats the hint — and the fallback pick
+    # REWRITES it, so the affinity follows the surviving replica
+    got = bare_router._pick(None, {first.name}, prefix=pfx)
+    assert got is other
+    bare_router._release(got, True)
+    got = bare_router._pick(None, set(), prefix=pfx)
+    assert got is other
+    bare_router._release(got, True)
+    # at-capacity preferred replica: the request spills sideways (no
+    # hot-replica pile-up) and the hint moves with the spill
+    other.inflight = other.max_inflight
+    got = bare_router._pick(None, set(), prefix=pfx)
+    assert got is first
+    bare_router._release(got, True)
+    other.inflight = 0
+    got = bare_router._pick(None, set(), prefix=pfx)
+    assert got is first
+    bare_router._release(got, True)
+    # a dead preferred replica falls through the same way
+    for _ in range(3):
+        bare_router._note_failure(first, "ping")
+    assert first.state == "dead"
+    got = bare_router._pick(None, set(), prefix=pfx)
+    assert got is other
+    bare_router._release(got, True)
+    # session affinity outranks the prefix hint: a session pinned to
+    # one replica keeps landing there whatever the prefix learned
+    first.state = "healthy"
+    bare_router._sessions["chat-9"] = first.name
+    got = bare_router._pick("chat-9", set(), prefix=pfx)
+    assert got is first
+    bare_router._release(got, True)
+    # and a session-keyed pick never overwrites the prefix hint
+    got = bare_router._pick(None, set(), prefix=pfx)
+    assert got is other
+    bare_router._release(got, True)
+
+
 def test_relay_rejects_when_no_capacity(bare_router):
     (a,) = _fake_replicas(bare_router, 1)
     a.state = "dead"
@@ -503,7 +559,12 @@ def test_failover_on_replica_kill_exactly_once(ckpt_root,
             for t in ths:
                 t.start()
             time.sleep(0.4)                  # streams mid-flight
-            reps[1].kill()                   # crash, no drain
+            # prefix affinity (PR 19) converges same-prompt traffic on
+            # ONE replica — kill exactly the one holding the streams
+            infl = router.stats()["replicas"]
+            victim = max(reps, key=lambda r: infl[r.name]["inflight"])
+            assert infl[victim.name]["inflight"] > 0
+            victim.kill()                    # crash, no drain
             for t in ths:
                 t.join(180)
             assert len(results) == 4
@@ -522,17 +583,17 @@ def test_failover_on_replica_kill_exactly_once(ckpt_root,
             fo_n = sum(s.value for _, s in fo._series()
                        if _[0] == router.router_id)
             assert fo_n >= 1
-            # elastic respawn: rep1 rebuilt from its checkpoint,
+            # elastic respawn: the victim rebuilt from its checkpoint,
             # readmitted after ready pings, epoch bumped
             t0 = time.monotonic()
             st = router.stats()
             while time.monotonic() - t0 < 30:
                 st = router.stats()
-                if st["replicas"]["rep1"]["state"] == "healthy":
+                if st["replicas"][victim.name]["state"] == "healthy":
                     break
                 time.sleep(0.1)
-            assert st["replicas"]["rep1"]["state"] == "healthy", st
-            assert st["replicas"]["rep1"]["epoch"] >= 1
+            assert st["replicas"][victim.name]["state"] == "healthy", st
+            assert st["replicas"][victim.name]["epoch"] >= 1
             # and it actually serves again (slow-start caps respect)
             cli = ServingClient(router.endpoint)
             try:
@@ -544,6 +605,87 @@ def test_failover_on_replica_kill_exactly_once(ckpt_root,
     finally:
         for r in reps:
             r.stop()
+
+
+def test_failover_sampled_stream_replays_bit_identical(ckpt_root):
+    """PR 19 chaos drill: kill a replica mid-stream with temperature>0.
+    The router replays the request on a survivor with the same wire id
+    and the same explicit seed, and the Philox sampler is keyed by
+    (seed, step) — so every relayed stream must be contiguous,
+    duplicate-free, AND bit-identical to the same-seed run against a
+    fault-free fleet. Replayability under failover is the whole point
+    of counter-based sampling: no RNG state dies with the replica."""
+    from paddle_tpu.observability import REGISTRY
+    seeds = [1000 + i for i in range(4)]
+    samp = dict(temperature=0.8, top_k=20, top_p=0.95)
+
+    def run_fleet(kill):
+        router, reps = _fleet(ckpt_root)
+        outs = [None] * len(seeds)
+        logs = [None] * len(seeds)
+        try:
+            with router:
+                if kill:
+                    for r in reps:
+                        _slow_decode(r.engine, 0.03)
+
+                def gen(i):
+                    c = ServingClient(router.endpoint)
+                    frames = []
+                    rep = c.generate([7, 8], 30, timeout=120,
+                                     stream=True, seed=seeds[i], **samp,
+                                     on_token=lambda t, idx:
+                                     frames.append((idx, list(t))))
+                    c.close()
+                    outs[i] = rep
+                    logs[i] = frames
+
+                ths = [threading.Thread(target=gen, args=(i,))
+                       for i in range(len(seeds))]
+                for t in ths:
+                    t.start()
+                if kill:
+                    time.sleep(0.4)          # streams mid-flight
+                    # same-prompt traffic converges on one replica via
+                    # the prefix-affinity hint: kill THAT one
+                    infl = router.stats()["replicas"]
+                    victim = max(reps, key=lambda r:
+                                 infl[r.name]["inflight"])
+                    assert infl[victim.name]["inflight"] > 0
+                    victim.kill()            # crash, no drain
+                for t in ths:
+                    t.join(180)
+                fo = REGISTRY.get("paddle_tpu_router_failovers_total")
+                fo_n = sum(s.value for lbl, s in fo._series()
+                           if lbl[0] == router.router_id)
+        finally:
+            for r in reps:
+                r.stop()
+        return outs, logs, fo_n
+
+    base_out, _, base_fo = run_fleet(kill=False)
+    assert base_fo == 0                      # baseline really fault-free
+    baseline = []
+    for rep in base_out:
+        assert rep["status"] == "done", rep
+        baseline.append(np.asarray(rep["tokens"]).tolist())
+        assert len(baseline[-1]) == 30
+    # sampling is actually live end-to-end: distinct seeds diverge
+    assert len({tuple(t) for t in baseline}) > 1
+    chaos_out, chaos_logs, chaos_fo = run_fleet(kill=True)
+    assert chaos_fo >= 1
+    for i, (rep, frames) in enumerate(zip(chaos_out, chaos_logs)):
+        assert rep["status"] == "done", rep
+        final = np.asarray(rep["tokens"]).tolist()
+        # relayed stream contiguous across the failover: no dropped
+        # and no duplicated tokens
+        streamed = []
+        for idx, toks in frames:
+            assert idx == len(streamed)
+            streamed.extend(int(t) for t in toks)
+        assert streamed == final
+        # and bit-identical to the same-seed fault-free run
+        assert final == baseline[i]
 
 
 def test_upstream_death_mid_stream_releases_reservation(ckpt_root):
@@ -629,6 +771,11 @@ def test_stream_stall_knob_fails_over_subprocess(ckpt_root,
                                 engine_kw=ENGINE_KW)
     survivor.start()
     try:
+        # warm the survivor's prefill+decode executables up front: the
+        # failover replay must keep streaming within token_stall, and a
+        # first-decode compile on a loaded CPU can exceed 1s — the
+        # router would read that gap as a second stall and give up
+        survivor.engine.generate([7, 8], 2, timeout=120)
         ready = json.loads(proc.stdout.readline())
         router = Router(
             "127.0.0.1:0",

@@ -57,8 +57,13 @@ def test_page_table_padding_and_defrag_plan():
     pool.free(a)
     c = pool.alloc_table(4)    # reuses a freed page
     assert b.padded(4, fill=99) == [2, 99, 99, 99]
+    wide = pool.alloc_table(16)
     with pytest.raises(ValueError, match="bucket width"):
-        (pool.alloc_table(16)).padded(1)
+        wide.padded(1)
+    # defrag_plan requires EVERY allocated page to be declared by a
+    # holder (unaccounted pages would be silently dropped from the
+    # device copy) — retire the throwaway table first
+    pool.free(wide)
     mapping = defrag_plan(pool, [b, c])
     # live pages now occupy the lowest indices, tables rewritten
     assert sorted(b.pages + c.pages) == [0, 1]
